@@ -1,0 +1,27 @@
+"""tpu-ps: a TPU-native distributed-training framework.
+
+Rebuilt from scratch on JAX/XLA/pjit with the capabilities of the reference
+``stsievert/pytorch_ps_mpi`` (a mpi4py parameter-server layer for PyTorch,
+see ``/root/reference``):
+
+- a drop-in optimizer-style API (``MPI_PS`` / ``SGD`` / ``Adam``, mirroring
+  the reference's public surface, reference ``__init__.py:1``) whose ``step``
+  aggregates gradients across workers,
+- two aggregation topologies (decentralized allgather-sum — the reference's
+  live path, ``ps.py:75,140-161`` — and leader-PS gather+broadcast,
+  ``mpi_comms.py:60-133``),
+- an asynchronous bounded-staleness mode (AsySG-InCon, reference README),
+- a pluggable gradient-codec interface (reference ``codings`` hook,
+  ``ps.py:94,166``) with identity / top-k / random-k / int8 / sign codecs,
+- fused SGD + Adam update rules (reference ``ps.py:195-261``),
+- the per-step timing/bytes metrics schema (reference ``ps.py:116-148``).
+
+Everything on-device runs under ``jax.jit``/``shard_map`` over a
+``jax.sharding.Mesh``; collectives ride ICI (``psum``/``all_gather``/
+``ppermute``) instead of MPI over Ethernet.
+"""
+
+from pytorch_ps_mpi_tpu.ps import MPI_PS, Adam, SGD
+
+__all__ = ["MPI_PS", "Adam", "SGD"]
+__version__ = "0.1.0"

@@ -1,0 +1,32 @@
+"""Gradient codecs: the pluggable compression surface.
+
+Re-designs the reference's import-by-convention ``codings`` hook
+(``ps.py:18``, interface inferred at ``ps.py:94,165-167``) as a real plugin
+registry. A codec turns a gradient array into a static-shape payload pytree
+before the collective and back after it — replacing the reference's
+host-side pickle+blosc wire compression (``mpi_comms.py:18-30,186-193``)
+with on-device sparsification/quantization, which is what actually saves
+ICI bandwidth (byte-level entropy coding is pointless when the interconnect
+outruns any host CPU compressor — SURVEY §2.4).
+"""
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, get_codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.identity import IdentityCodec
+from pytorch_ps_mpi_tpu.codecs.topk import TopKCodec
+from pytorch_ps_mpi_tpu.codecs.randomk import RandomKCodec
+from pytorch_ps_mpi_tpu.codecs.quant import Int8Codec, QSGDCodec
+from pytorch_ps_mpi_tpu.codecs.sign import SignCodec
+from pytorch_ps_mpi_tpu.codecs.error_feedback import ErrorFeedback
+
+__all__ = [
+    "Codec",
+    "get_codec",
+    "register_codec",
+    "IdentityCodec",
+    "TopKCodec",
+    "RandomKCodec",
+    "Int8Codec",
+    "QSGDCodec",
+    "SignCodec",
+    "ErrorFeedback",
+]

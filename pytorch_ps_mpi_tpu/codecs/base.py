@@ -1,0 +1,91 @@
+"""Codec interface + registry.
+
+Contract (the TPU-native version of the reference's inferred hook interface,
+SURVEY §2.2):
+
+- ``encode(grad, state, rng) -> (payload, new_state)`` — called per
+  parameter on each worker's *local* gradient, before the collective
+  (reference ``code.encode``, ``ps.py:94``, ran in the autograd-hook thread
+  pool). Payload is a pytree of arrays with **static shapes** so it can ride
+  ``lax.all_gather`` under jit — the analog of the reference's fixed
+  ``max_bytes`` padding for ragged messages (``mpi_comms.py:82-85``).
+- ``decode(payload, shape, dtype) -> grad`` — inverse, called per
+  (parameter × rank) on the receive side (reference ``code.decode``,
+  ``ps.py:166``).
+- ``decode_sum(payloads, shape, dtype) -> grad`` — decode a stacked
+  ``[world, ...]`` payload batch and sum over ranks in one shot (the
+  reference's ``sum(grads)`` loop, ``ps.py:176``); the default is
+  vmap(decode).sum(0) and codecs override it when a fused form exists
+  (e.g. top-k scatter-add).
+- ``init_state(shape, dtype)`` — per-leaf codec state (e.g. error-feedback
+  memory); ``()`` for stateless codecs. Explicit state threading replaces
+  the reference's mutable ``code.codes`` side channel (``ps.py:165``).
+- ``payload_bits(shape, dtype)`` — wire size in bits, for the
+  ``msg_bytes``/``packaged_bytes`` metrics (reference ``ps.py:135-136``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+class Codec:
+    """Base codec: subclasses override encode/decode (+ optionally
+    decode_sum, init_state, payload_bits)."""
+
+    #: identity-like codecs set this so the train step can use a single
+    #: fused psum instead of all_gather + decode + sum.
+    supports_psum: bool = False
+    #: codecs that consume randomness (random-k, QSGD) set this so the
+    #: train step threads a per-worker PRNG key in.
+    needs_rng: bool = False
+
+    def init_state(self, shape: Tuple[int, ...], dtype) -> PyTree:
+        return ()
+
+    def encode(self, grad: jax.Array, state: PyTree = (),
+               rng: Optional[jax.Array] = None) -> Tuple[PyTree, PyTree]:
+        raise NotImplementedError
+
+    def decode(self, payload: PyTree, shape: Tuple[int, ...], dtype) -> jax.Array:
+        raise NotImplementedError
+
+    def decode_sum(self, payloads: PyTree, shape: Tuple[int, ...], dtype) -> jax.Array:
+        """Decode a [world, ...]-stacked payload pytree, summed over ranks."""
+        decoded = jax.vmap(lambda p: self.decode(p, shape, dtype))(payloads)
+        return decoded.sum(axis=0)
+
+    def payload_bits(self, shape: Tuple[int, ...], dtype) -> int:
+        """Encoded wire size in bits per gradient (for metrics)."""
+        payload, _ = jax.eval_shape(
+            lambda: self.encode(jnp.zeros(shape, dtype), self.init_state(shape, dtype),
+                                jax.random.key(0) if self.needs_rng else None)
+        )
+        return sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize * 8
+            for leaf in jax.tree.leaves(payload)
+        )
+
+
+_REGISTRY: Dict[str, Type[Codec]] = {}
+
+
+def register_codec(name: str) -> Callable[[Type[Codec]], Type[Codec]]:
+    def deco(cls: Type[Codec]) -> Type[Codec]:
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def get_codec(name: str, **kwargs) -> Codec:
+    """Instantiate a registered codec by name (e.g. ``get_codec('topk',
+    fraction=0.01)``)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown codec {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)

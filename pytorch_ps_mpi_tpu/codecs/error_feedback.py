@@ -1,0 +1,43 @@
+"""Error-feedback wrapper (EF-SGD, Karimireddy et al. 2019).
+
+Wraps any codec: the quantization/sparsification residual is accumulated
+into per-worker memory and added back before the next encode, restoring
+convergence for biased codecs (sign, top-k). The memory is explicit codec
+state threaded through the train step — the principled replacement for the
+reference's mutable ``code.codes`` side channel (``ps.py:165``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+
+
+@register_codec("ef")
+class ErrorFeedback(Codec):
+    def __init__(self, inner: Codec = None, inner_name: str = None, **inner_kwargs):
+        if inner is None:
+            from pytorch_ps_mpi_tpu.codecs.base import get_codec
+            inner = get_codec(inner_name or "topk", **inner_kwargs)
+        self.inner = inner
+        self.needs_rng = inner.needs_rng
+
+    def init_state(self, shape, dtype):
+        return {"memory": jnp.zeros(shape, dtype), "inner": self.inner.init_state(shape, dtype)}
+
+    def encode(self, grad, state=(), rng=None):
+        corrected = grad + state["memory"]
+        payload, inner_state = self.inner.encode(corrected, state["inner"], rng)
+        transmitted = self.inner.decode(payload, grad.shape, grad.dtype)
+        new_state = {"memory": corrected - transmitted, "inner": inner_state}
+        return payload, new_state
+
+    def decode(self, payload, shape, dtype):
+        return self.inner.decode(payload, shape, dtype)
+
+    def decode_sum(self, payloads, shape, dtype):
+        return self.inner.decode_sum(payloads, shape, dtype)
+
+    def payload_bits(self, shape, dtype):
+        return self.inner.payload_bits(shape, dtype)

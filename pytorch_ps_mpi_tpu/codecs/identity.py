@@ -1,0 +1,27 @@
+"""Identity codec: no compression, gradients ride the wire as-is.
+
+The default when the reference is constructed without a ``code`` (its
+``codings`` default was an identity-style passthrough). Signals
+``supports_psum`` so the train step can lower aggregation to a single
+fused ``lax.psum`` instead of all_gather + decode + sum.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+
+
+@register_codec("identity")
+class IdentityCodec(Codec):
+    supports_psum = True
+
+    def encode(self, grad, state=(), rng=None):
+        return grad, state
+
+    def decode(self, payload, shape, dtype):
+        return payload.astype(dtype).reshape(shape)
+
+    def decode_sum(self, payloads, shape, dtype):
+        return payloads.sum(axis=0).astype(dtype).reshape(shape)

@@ -1,0 +1,59 @@
+"""Random-k sparsification codec (unbiased: kept entries are scaled by n/k).
+
+Companion to top-k in the reference's codings research surface (SURVEY
+§2.2). Needs per-worker randomness: the train step threads a PRNG key
+folded with the worker's axis index so ranks sample different coordinates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+
+
+@register_codec("randomk")
+class RandomKCodec(Codec):
+    needs_rng = True
+
+    def __init__(self, k: int = 0, fraction: float = 0.0, unbiased: bool = True):
+        if (k <= 0) == (fraction <= 0.0):
+            raise ValueError("give exactly one of k>0 or 0<fraction<=1")
+        self.k = int(k)
+        self.fraction = float(fraction)
+        self.unbiased = unbiased
+
+    def _k_for(self, shape) -> int:
+        n = int(np.prod(shape)) if shape else 1
+        k = self.k if self.k > 0 else max(1, int(round(n * self.fraction)))
+        return min(k, n)
+
+    def encode(self, grad, state=(), rng=None):
+        assert rng is not None, "RandomKCodec needs a PRNG key"
+        flat = grad.reshape(-1)
+        n = flat.shape[0]
+        k = self._k_for(grad.shape)
+        indices = jax.random.choice(rng, n, shape=(k,), replace=False).astype(jnp.int32)
+        values = jnp.take(flat, indices)
+        if self.unbiased:
+            values = values * (n / k)
+        return {"values": values, "indices": indices}, state
+
+    def decode(self, payload, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        flat = jnp.zeros((n,), dtype)
+        flat = flat.at[payload["indices"]].set(payload["values"].astype(dtype))
+        return flat.reshape(shape)
+
+    def decode_sum(self, payloads, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        flat = jnp.zeros((n,), dtype)
+        idx = payloads["indices"].reshape(-1)
+        val = payloads["values"].reshape(-1).astype(dtype)
+        return flat.at[idx].add(val).reshape(shape)
+
+    def payload_bits(self, shape, dtype):
+        k = self._k_for(shape)
+        return k * (jnp.dtype(dtype).itemsize * 8 + 32)

@@ -1,0 +1,47 @@
+"""SignSGD codec: 1 bit per element + a mean-|g| scale.
+
+The most aggressive point on the compression curve the reference's codings
+hook was built to explore (SURVEY §2.2). Payload packs 8 signs per byte —
+a true 32× wire reduction, not just a narrower dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+
+
+def _packed_len(n: int) -> int:
+    return (n + 7) // 8
+
+
+@register_codec("sign")
+class SignCodec(Codec):
+    def encode(self, grad, state=(), rng=None):
+        flat = grad.reshape(-1)
+        n = flat.shape[0]
+        scale = jnp.mean(jnp.abs(flat))
+        bits = (flat >= 0).astype(jnp.uint8)
+        pad = _packed_len(n) * 8 - n
+        bits = jnp.pad(bits, (0, pad)).reshape(-1, 8)
+        weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+        packed = (bits * weights).sum(axis=1).astype(jnp.uint8)
+        return {"packed": packed, "scale": scale.astype(jnp.float32)}, state
+
+    def _unpack(self, packed, n):
+        weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+        bits = (packed[:, None] & weights[None, :]) > 0
+        return bits.reshape(-1)[:n]
+
+    def decode(self, payload, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        signs = self._unpack(payload["packed"], n)
+        g = jnp.where(signs, payload["scale"], -payload["scale"]).astype(dtype)
+        return g.reshape(shape)
+
+    def payload_bits(self, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        return _packed_len(n) * 8 + 32

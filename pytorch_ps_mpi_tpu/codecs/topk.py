@@ -1,0 +1,63 @@
+"""Top-k sparsification codec.
+
+The BASELINE "top-k gradient compression" slot (BASELINE.json config #4;
+the reference reached it through the external ``codings`` hook, SURVEY
+§2.2). Keeps the k largest-magnitude entries of the flattened gradient.
+
+Static shapes: k is fixed at trace time, so the payload (values[k],
+indices[k]) needs no size exchange — the compile-time analog of the
+reference's two-phase ``prepare``/``Iallgatherv`` ragged protocol
+(``mpi_comms.py:144-174``). ``true_length`` is carried anyway to exercise
+the ragged sidecar convention (``comms.ragged_all_gather``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+
+
+@register_codec("topk")
+class TopKCodec(Codec):
+    def __init__(self, k: int = 0, fraction: float = 0.0):
+        if (k <= 0) == (fraction <= 0.0):
+            raise ValueError("give exactly one of k>0 or 0<fraction<=1")
+        self.k = int(k)
+        self.fraction = float(fraction)
+
+    def _k_for(self, shape) -> int:
+        n = int(np.prod(shape)) if shape else 1
+        k = self.k if self.k > 0 else max(1, int(round(n * self.fraction)))
+        return min(k, n)
+
+    def encode(self, grad, state=(), rng=None):
+        flat = grad.reshape(-1)
+        k = self._k_for(grad.shape)
+        values, indices = jax.lax.top_k(jnp.abs(flat), k)
+        payload = {
+            "values": jnp.take(flat, indices),
+            "indices": indices.astype(jnp.int32),
+        }
+        return payload, state
+
+    def decode(self, payload, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        flat = jnp.zeros((n,), dtype)
+        flat = flat.at[payload["indices"]].set(payload["values"].astype(dtype))
+        return flat.reshape(shape)
+
+    def decode_sum(self, payloads, shape, dtype):
+        # Fused scatter-add across all ranks' payloads: one segment-sum
+        # instead of the reference's per-rank decode loop (ps.py:161-176).
+        n = int(np.prod(shape)) if shape else 1
+        flat = jnp.zeros((n,), dtype)
+        idx = payloads["indices"].reshape(-1)
+        val = payloads["values"].reshape(-1).astype(dtype)
+        return flat.at[idx].add(val).reshape(shape)
+
+    def payload_bits(self, shape, dtype):
+        k = self._k_for(shape)
+        return k * (jnp.dtype(dtype).itemsize * 8 + 32)

@@ -1,0 +1,180 @@
+"""Comms layer: typed collective wrappers over a device mesh.
+
+The TPU-native replacement for the reference's ``mpi_comms.py``. Every MPI
+collective the reference uses maps to an XLA collective over ICI:
+
+=====================================  =======================================
+reference (mpi4py, host bytes)          here (XLA, on-device arrays)
+=====================================  =======================================
+``Iallgatherv`` of pickled grads        ``lax.all_gather`` (``all_gather_tree``)
+(``mpi_comms.py:162``)
+``Iallgather`` of int32 sizes           compile-time static shapes; ragged
+(``mpi_comms.py:153``, the "prepare"    payloads use max-size padding + a
+phase)                                  true-length sidecar (``ragged_all_gather``)
+``Igatherv`` to rank 0                  ``gather_to_leader``
+(``mpi_comms.py:88``)
+``Ibcast`` from rank 0                  ``broadcast_from_leader``
+(``mpi_comms.py:132``)
+sum of per-rank grads (``ps.py:176``)   ``lax.psum`` (``allreduce_sum_tree``)
+``Request.Wait``                        XLA schedules/overlaps async
+(``ps.py:146``)                         collectives; no explicit waits
+pickle+blosc wire format                none: gradients stay typed on-device
+(``mpi_comms.py:186-193``)              arrays; see ``utils/serialization.py``
+                                        for the host-side pytree wire format
+=====================================  =======================================
+
+All functions here are pure and meant to be called *inside* ``shard_map``
+(or any context where ``axis_name`` is bound). The two-phase size exchange
+of the reference (``mpi_comms.py:144-174``) disappears entirely: shapes are
+static under XLA, so "send sizes first" is a compile-time property. Only
+ragged *encoded* payloads (top-k with data-dependent true length) need the
+max-size + length-sidecar convention, mirroring the reference's ``max_bytes``
+high-water padding (``mpi_comms.py:82-85``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# Primitives (call inside shard_map / pmapped code)
+# ---------------------------------------------------------------------------
+
+def allreduce_sum(x: jax.Array, axis_name: str) -> jax.Array:
+    """Sum ``x`` across the mesh axis. Fuses the reference's allgather +
+    host-side ``sum(grads)`` (``ps.py:161,176``) into one ICI collective."""
+    return lax.psum(x, axis_name)
+
+
+def allreduce_sum_tree(tree: PyTree, axis_name: str) -> PyTree:
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def all_gather(x: jax.Array, axis_name: str) -> jax.Array:
+    """Every rank receives every rank's ``x``, stacked on a new leading
+    axis — the reference's ``Iallgatherv`` (``mpi_comms.py:160-163``) minus
+    the bytes/size dance."""
+    return lax.all_gather(x, axis_name)
+
+
+def all_gather_tree(tree: PyTree, axis_name: str) -> PyTree:
+    return jax.tree.map(lambda x: lax.all_gather(x, axis_name), tree)
+
+
+def gather_to_leader(x: jax.Array, axis_name: str) -> jax.Array:
+    """Rank-0-PS gather (reference ``igather``, ``mpi_comms.py:60-93``).
+
+    Under SPMD every rank materializes the stacked result; semantically the
+    leader (axis index 0) is the consumer. XLA's all-gather over ICI is the
+    efficient lowering — a true gather would idle the other chips' links.
+    """
+    return lax.all_gather(x, axis_name)
+
+
+def broadcast_from_leader(x: jax.Array, axis_name: str) -> jax.Array:
+    """Every rank receives the leader's ``x`` (reference ``ibroadcast``,
+    ``mpi_comms.py:127-133``). Lowering: mask-then-psum, which XLA turns
+    into a broadcast-shaped collective."""
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == 0, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def broadcast_from_leader_tree(tree: PyTree, axis_name: str) -> PyTree:
+    idx_is_leader = lax.axis_index(axis_name) == 0
+    def bcast(x):
+        return lax.psum(jnp.where(idx_is_leader, x, jnp.zeros_like(x)), axis_name)
+    return jax.tree.map(bcast, tree)
+
+
+def ragged_all_gather(
+    payload: jax.Array, length: jax.Array, axis_name: str
+) -> Tuple[jax.Array, jax.Array]:
+    """All-gather a variable-length payload.
+
+    The XLA analog of the reference's two-phase ``Iallgather`` protocol
+    (sizes first, then ``Iallgatherv``, ``mpi_comms.py:144-174``): here the
+    *max* size is static (``payload.shape``), each rank's *true* length
+    rides along as an int32 sidecar, and consumers mask beyond it — exactly
+    the ``max_bytes`` padding + sentinel-trim idea (``mpi_comms.py:80-104``)
+    without the sentinel's collision bug (SURVEY §2.3).
+
+    Returns ``(payloads[world, *payload.shape], lengths[world])``.
+    """
+    payloads = lax.all_gather(payload, axis_name)
+    lengths = lax.all_gather(jnp.asarray(length, jnp.int32), axis_name)
+    return payloads, lengths
+
+
+def ring_permute(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
+    """Send ``x`` to the next rank around the ring (receives from previous).
+
+    The building block for ring collectives / ring attention; rides
+    neighbor ICI links. No reference analog (MPI point-to-point was never
+    used there) but falls out of the comms layer for free (SURVEY §2.5).
+    """
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def axis_index(axis_name: str) -> jax.Array:
+    return lax.axis_index(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Host-level entry points: same collectives wrapped in shard_map + jit so a
+# user can call them eagerly on sharded arrays (the reference's usage style,
+# e.g. test_comms.py round-trips).
+# ---------------------------------------------------------------------------
+
+def _shard_mapped(fn: Callable, mesh: Mesh, axis_name: str, out_specs):
+    in_spec = P(axis_name)
+    return jax.jit(
+        jax.shard_map(
+            functools.partial(fn, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=in_spec,
+            out_specs=out_specs,
+        )
+    )
+
+
+def host_allreduce_sum(x: jax.Array, mesh: Mesh, axis_name: str = "data") -> jax.Array:
+    """Sum per-worker slices of ``x`` (stacked on the leading axis)."""
+    fn = _shard_mapped(
+        lambda v, axis_name: lax.psum(v, axis_name), mesh, axis_name, P()
+    )
+    return fn(x)
+
+
+def host_all_gather(x: jax.Array, mesh: Mesh, axis_name: str = "data") -> jax.Array:
+    fn = _shard_mapped(
+        lambda v, axis_name: lax.all_gather(v, axis_name), mesh, axis_name, P(axis_name)
+    )
+    return fn(x)
+
+
+def host_broadcast_from_leader(
+    x: jax.Array, mesh: Mesh, axis_name: str = "data"
+) -> jax.Array:
+    fn = _shard_mapped(
+        lambda v, axis_name: broadcast_from_leader(v, axis_name),
+        mesh,
+        axis_name,
+        P(axis_name),
+    )
+    return fn(x)

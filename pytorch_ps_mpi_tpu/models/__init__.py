@@ -1,0 +1,15 @@
+"""Model zoo for the BASELINE configs.
+
+The reference ships no models (SURVEY "What the reference is NOT") — its
+train scripts lived in a sibling research repo — but the BASELINE configs
+(BASELINE.json) name the families the framework must drive: a 2-layer MLP
+(MNIST), ResNet-18/50 (CIFAR-10 / ImageNet), and BERT-base MLM. All are
+flax modules designed TPU-first: stateless norms in the grad path,
+bfloat16-friendly, static shapes, ring-attention option for long context.
+"""
+
+from pytorch_ps_mpi_tpu.models.mlp import MLP
+from pytorch_ps_mpi_tpu.models.resnet import ResNet, ResNet18, ResNet50
+from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM
+
+__all__ = ["MLP", "ResNet", "ResNet18", "ResNet50", "BertConfig", "BertMLM"]

@@ -1,0 +1,116 @@
+"""BERT-style encoder with an MLM head — BASELINE config #5 (large flat
+gradient vector: the ~110M-param embedding+encoder stack stresses
+aggregation bandwidth the way the config intends).
+
+TPU-first: attention and MLPs are einsum/matmul shaped for the MXU,
+bfloat16 compute with float32 params supported via ``dtype``, and
+long-context runs under sequence parallelism — set
+``attention='ring'`` and call ``apply`` inside ``shard_map`` with the
+sequence sharded over ``seq_axis`` (``parallel/ring.py``); position
+embeddings take a per-shard ``position_offset``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_ps_mpi_tpu.parallel.ring import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    dtype: Any = jnp.float32
+    attention: str = "full"       # 'full' or 'ring'
+    seq_axis: str = "seq"         # mesh axis for ring attention
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        defaults = dict(
+            vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+            intermediate_size=128, max_position=128,
+        )
+        defaults.update(kw)
+        return BertConfig(**defaults)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_heads
+        qkv = nn.DenseGeneral(
+            (3, c.num_heads, head_dim), axis=-1, dtype=c.dtype, name="qkv"
+        )(x)                                   # [b, l, 3, h, d]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if c.attention == "ring":
+            out = ring_attention(q, k, v, c.seq_axis, causal=False)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / head_dim ** 0.5
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return nn.DenseGeneral(
+            c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="out"
+        )(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        y = SelfAttention(c)(nn.LayerNorm(dtype=c.dtype)(x))
+        x = x + y
+        y = nn.LayerNorm(dtype=c.dtype)(x)
+        y = nn.Dense(c.intermediate_size, dtype=c.dtype)(y)
+        y = nn.gelu(y)
+        y = nn.Dense(c.hidden_size, dtype=c.dtype)(y)
+        return x + y
+
+
+class BertMLM(nn.Module):
+    """Token-in, vocab-logits-out masked-LM model (pre-norm encoder)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, position_offset: int = 0):
+        c = self.cfg
+        tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype, name="tok_emb")(
+            tokens
+        )
+        positions = position_offset + jnp.arange(tokens.shape[-1])
+        pos = nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype, name="pos_emb")(
+            positions
+        )
+        x = tok + pos[None]
+        for i in range(c.num_layers):
+            x = EncoderLayer(c, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=c.dtype)(x)
+        logits = nn.Dense(c.vocab_size, dtype=c.dtype, name="mlm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def mlm_loss(logits, targets, mask):
+    """Cross-entropy over masked positions only."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = mask.astype(logits.dtype)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)

@@ -1,0 +1,21 @@
+"""2-layer MLP — BASELINE config #1 (MLP / MNIST, sync SGD smoke test)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape(x.shape[0], -1)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=jnp.float32)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x

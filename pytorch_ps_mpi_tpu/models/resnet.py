@@ -1,0 +1,124 @@
+"""ResNet-18/50 — BASELINE configs #2/#3/#4.
+
+TPU-first choices: NHWC layout (XLA's native conv layout on TPU),
+GroupNorm by default instead of BatchNorm so the gradient path is
+stateless under ``jax.grad`` (no mutable batch_stats to sync across
+replicas — the cross-replica BN sync problem simply doesn't arise; GN is
+also batch-size independent, which matters once the global batch is
+sharded over many chips). ``norm='batch'`` is available for parity
+experiments and returns mutable state the caller threads through.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class AdaptiveGroupNorm(nn.Module):
+    """GroupNorm with ``gcd(32, channels)`` groups so scaled-down test
+    models (few filters) normalize correctly too."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        import math
+
+        groups = math.gcd(32, x.shape[-1])
+        return nn.GroupNorm(num_groups=groups, dtype=self.dtype)(x)
+
+
+class ResNetBlock(nn.Module):
+    """Basic 3x3 block (ResNet-18/34)."""
+
+    filters: int
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding=1, use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False)(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), self.strides, use_bias=False, name="shortcut"
+            )(residual)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1-3x3-1x1 bottleneck (ResNet-50/101/152)."""
+
+    filters: int
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding=1, use_bias=False)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), self.strides, use_bias=False, name="shortcut"
+            )(residual)
+            residual = self.norm(name="shortcut_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: Any
+    num_classes: int = 1000
+    num_filters: int = 64
+    norm: str = "group"          # 'group' (stateless) or 'batch'
+    small_inputs: bool = False   # CIFAR stem: 3x3 conv, no maxpool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.norm == "group":
+            norm = functools.partial(AdaptiveGroupNorm, dtype=self.dtype)
+        else:
+            norm = functools.partial(
+                nn.BatchNorm, use_running_average=not train, dtype=self.dtype
+            )
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = nn.Conv(self.num_filters, (3, 3), padding=1, use_bias=False)(x)
+        else:
+            x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=3, use_bias=False)(x)
+        x = norm(name="stem_norm")(x)
+        x = nn.relu(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2 ** i, norm=norm, strides=strides
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock)

@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot codec ops.
+
+The native-code tier of the framework: where the reference leaned on
+c-blosc's C compressor (``mpi_comms.py:25,29``) and ATen's CUDA kernels,
+the TPU build uses Pallas kernels compiled to Mosaic — on-chip, fused,
+VMEM-resident. Portable jnp fallbacks live next to each kernel and are
+used automatically off-TPU (interpret mode on CPU test meshes).
+"""
+
+from pytorch_ps_mpi_tpu.ops.quant_pallas import quantize_int8, dequantize_int8
+
+__all__ = ["quantize_int8", "dequantize_int8"]

@@ -1,0 +1,26 @@
+"""Parallelism strategies.
+
+The reference is data-parallel only (SURVEY §2.5); this package provides
+its two DP topologies plus the async mode, and goes beyond it with
+sequence/context parallelism (ring attention) — the natural extension the
+comms layer's ``ppermute`` ring primitive enables.
+
+- ``dp``: functional sync data-parallel train-step builder (decentralized
+  allgather-sum and leader-PS topologies — reference ``ps.py:75`` and
+  ``mpi_comms.py:60-133``).
+- ``async_ps``: AsySG-InCon bounded-staleness asynchronous training
+  (reference README.md:56-81, Lian et al. 2015).
+- ``ring``: ring attention over a sequence-sharded mesh axis (context
+  parallelism; no reference analog — TPU-first extension).
+"""
+
+from pytorch_ps_mpi_tpu.parallel.dp import make_sync_train_step
+from pytorch_ps_mpi_tpu.parallel.async_ps import AsyncPS
+from pytorch_ps_mpi_tpu.parallel.ring import ring_attention, ring_self_attention
+
+__all__ = [
+    "make_sync_train_step",
+    "AsyncPS",
+    "ring_attention",
+    "ring_self_attention",
+]

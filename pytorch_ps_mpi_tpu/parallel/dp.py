@@ -1,0 +1,81 @@
+"""Functional sync data-parallel train step.
+
+The same pipeline ``MPI_PS.step`` runs (grad → encode → collective →
+decode+sum → fused update), exposed as a pure function builder for users
+who want explicit state threading instead of the optimizer object — the
+idiomatic-JAX face of the reference's ``step`` engine (``ps.py:103-193``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_ps_mpi_tpu import comms
+from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
+from pytorch_ps_mpi_tpu.mesh import DATA_AXIS
+from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+from pytorch_ps_mpi_tpu.ps import aggregate, encode_tree
+
+PyTree = Any
+
+
+def make_sync_train_step(
+    loss_fn: Callable[[PyTree, PyTree], jax.Array],
+    mesh: Mesh,
+    *,
+    optim: str = "sgd",
+    code: Optional[Codec] = None,
+    axis_name: str = DATA_AXIS,
+    mode: str = "allgather",
+    average: bool = False,
+    donate: bool = True,
+    **hyper,
+):
+    """Build ``(init_fn, step_fn)``.
+
+    ``init_fn(params) -> (opt_state, codec_state)``;
+    ``step_fn(params, opt_state, codec_state, batch, rng) ->
+    (params, opt_state, codec_state, loss)`` — one fused XLA program,
+    batch sharded over ``axis_name``, params replicated.
+    """
+    code = code if code is not None else IdentityCodec()
+    hyper_cls, init_state, update_fn = OPTIMIZERS[optim]
+    h = hyper_cls(**hyper)
+    size = int(mesh.shape[axis_name])
+
+    def init_fn(params):
+        def leaf(p):
+            s = code.init_state(p.shape, p.dtype)
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (size,) + x.shape), s
+            )
+        codec_state = jax.tree.map(leaf, params)
+        return init_state(params), codec_state
+
+    def spmd(params, opt_state, codec_state, batch, rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = lax.pmean(loss, axis_name)
+        payloads, new_codec_state = encode_tree(code, grads, codec_state, rng, axis_name)
+        summed = aggregate(code, grads, payloads, axis_name, average, size)
+        new_params, new_opt_state = update_fn(params, summed, opt_state, h)
+        if mode == "leader":
+            new_params = comms.broadcast_from_leader_tree(new_params, axis_name)
+        return new_params, new_opt_state, new_codec_state, loss
+
+    def step_fn(params, opt_state, codec_state, batch, rng):
+        state_spec = jax.tree.map(lambda _: P(axis_name), codec_state)
+        mapped = jax.shard_map(
+            spmd,
+            mesh=mesh,
+            in_specs=(P(), P(), state_spec, P(axis_name), P()),
+            out_specs=(P(), P(), state_spec, P()),
+            check_vma=False,
+        )
+        return mapped(params, opt_state, codec_state, batch, rng)
+
+    return init_fn, jax.jit(step_fn, donate_argnums=(0, 1, 2) if donate else ())

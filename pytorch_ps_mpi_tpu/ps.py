@@ -1,0 +1,337 @@
+"""``MPI_PS`` / ``SGD`` / ``Adam`` — the drop-in distributed-optimizer API.
+
+The TPU-native rebuild of the reference's ``ps.py``: an optimizer-style
+object whose ``step`` (1) obtains per-worker gradients, (2) encodes them
+through a pluggable codec, (3) exchanges them across workers with on-chip
+collectives, (4) decodes + sums, and (5) applies a fused SGD/Adam update —
+returning ``(loss, data)`` where ``data`` is the per-step timing/bytes
+metrics dict (the reference's contract, ``ps.py:193``; schema keys
+``ps.py:116-148``).
+
+What changed architecturally (SURVEY §3.1 vs. this file):
+
+- The reference overlapped encode with backprop via autograd hooks feeding
+  a 200-thread pool (``ps.py:65-66,85,98-101``). Here the *whole* pipeline
+  — grad, encode, collective, decode, update — is one XLA program per step;
+  the compiler overlaps async collectives with the remaining backward
+  compute, which is the TPU-native form of the same optimization and needs
+  no threads, futures, or GIL reasoning (the races of SURVEY §5.2 are
+  gone by construction).
+- The two-phase size exchange (``prepare``/``Iallgatherv``,
+  ``ps.py:140-147``) is compile-time: payload shapes are static.
+- The per-parameter reverse-order receive loop (``ps.py:155-176``)
+  becomes a tree-mapped collective; XLA schedules transfers.
+- Both reference topologies are kept: ``mode='allgather'`` is the live
+  decentralized path (every rank decodes+steps redundantly, ``ps.py:75``),
+  ``mode='leader'`` is the rank-0 PS gather→step→broadcast path
+  (``mpi_comms.py:60-133``, README pseudo-code).
+
+Async (AsySG-InCon) training lives in ``parallel/async_ps.py``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_ps_mpi_tpu import comms
+from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
+from pytorch_ps_mpi_tpu.mesh import DATA_AXIS, make_mesh
+from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+
+PyTree = Any
+
+
+def _tree_bytes(tree: PyTree) -> int:
+    """Total raw bytes of a pytree's arrays (the reference's ``_bytes_of``,
+    ``ps.py:25-43`` — without its self-documented 2-D bug, SURVEY §2.3)."""
+    return sum(
+        int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
+        for x in jax.tree.leaves(tree)
+    )
+
+
+# ---------------------------------------------------------------------------
+# SPMD pipeline pieces, shared with the functional API in parallel/dp.py.
+# All run *inside* shard_map.
+# ---------------------------------------------------------------------------
+
+def encode_tree(code: Codec, grads: PyTree, codec_state: PyTree, rng, axis_name: str):
+    """Per-worker encode of every gradient leaf (the reference's autograd
+    hook + thread pool, ``ps.py:94-101``, collapsed into the traced step).
+
+    ``codec_state`` leaves carry a leading local-shard axis of size 1 (the
+    shard_map slice of the host-side ``[world, ...]`` stack).
+    """
+    leaves, treedef = jax.tree.flatten(grads)
+    keys = None
+    if code.needs_rng:
+        worker_rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+        keys = list(jax.random.split(worker_rng, len(leaves)))
+    flat_states = treedef.flatten_up_to(codec_state)
+    payloads, new_states = [], []
+    for i, g in enumerate(leaves):
+        st = jax.tree.map(lambda x: x[0], flat_states[i])  # squeeze shard axis
+        payload, new_st = code.encode(g, st, keys[i] if keys is not None else None)
+        payloads.append(payload)
+        new_states.append(jax.tree.map(lambda x: x[None], new_st))
+    return (
+        jax.tree.unflatten(treedef, payloads),
+        jax.tree.unflatten(treedef, new_states),
+    )
+
+
+def aggregate(
+    code: Codec,
+    grads: PyTree,
+    payloads: PyTree,
+    axis_name: str,
+    average: bool,
+    size: int,
+) -> PyTree:
+    """Collective + decode + sum across workers (reference
+    ``ps.py:140-176``). Identity-like codecs lower to one fused ``psum``;
+    everything else all-gathers static-shape payloads and scatter/sums."""
+    if code.supports_psum:
+        summed = comms.allreduce_sum_tree(grads, axis_name)
+    else:
+        leaves, treedef = jax.tree.flatten(grads)
+        payload_list = treedef.flatten_up_to(payloads)
+        summed_leaves = []
+        for g, payload in zip(leaves, payload_list):
+            gathered = jax.tree.map(lambda x: lax.all_gather(x, axis_name), payload)
+            summed_leaves.append(code.decode_sum(gathered, g.shape, g.dtype))
+        summed = jax.tree.unflatten(treedef, summed_leaves)
+    if average:
+        summed = jax.tree.map(lambda x: x / size, summed)
+    return summed
+
+
+class MPI_PS:
+    """Distributed parameter-server optimizer over a device mesh.
+
+    Parameters mirror the reference constructor (``ps.py:54-59``) where
+    they still make sense; MPI/cuda knobs are replaced by mesh/codec ones:
+
+    Args:
+      params: pytree of parameter arrays (replicated across the mesh).
+      optim: ``'sgd'`` or ``'adam'`` (reference ``ps.py:181-188``).
+      code: a :class:`Codec` (reference ``code=`` hook); default identity.
+      mesh: ``jax.sharding.Mesh``; default 1-D data mesh over all devices.
+      axis_name: mesh axis to aggregate over.
+      mode: ``'allgather'`` (decentralized replicated step — the
+        reference's live path) or ``'leader'`` (rank-0 PS
+        gather→step→broadcast).
+      average: if True, average worker gradients instead of the
+        reference's sum semantics (``ps.py:176``).
+      instrument: if True, ``step`` runs the pipeline as separate stages
+        with host-side timing to fill the full metrics schema; if False,
+        one fused XLA program (fast path) and only end-to-end time.
+      seed: base PRNG seed for stochastic codecs.
+      **hyper: optimizer hyperparameters (lr, momentum, betas, ...).
+    """
+
+    def __init__(
+        self,
+        params: PyTree,
+        *,
+        optim: str = "sgd",
+        code: Optional[Codec] = None,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = DATA_AXIS,
+        mode: str = "allgather",
+        average: bool = False,
+        instrument: bool = False,
+        seed: int = 0,
+        **hyper,
+    ):
+        if optim not in OPTIMIZERS:
+            raise ValueError(f"optim must be one of {sorted(OPTIMIZERS)}")
+        if mode not in ("allgather", "leader"):
+            raise ValueError("mode must be 'allgather' or 'leader'")
+        hyper_cls, init_state, update_fn = OPTIMIZERS[optim]
+        self.hyper = hyper_cls(**hyper)
+        self._update_fn = update_fn
+        self.params = params
+        self.opt_state = init_state(params)
+        self.code = code if code is not None else IdentityCodec()
+        self.mesh = mesh if mesh is not None else make_mesh(axis_names=(axis_name,))
+        self.axis_name = axis_name
+        self.mode = mode
+        self.average = average
+        self.instrument = instrument
+        self.rank = jax.process_index()           # reference ps.py:71-72
+        self.size = int(self.mesh.shape[axis_name])  # reference ps.py:73
+        self._rng = jax.random.key(seed)
+        self.codec_state = self._init_codec_state()
+        self._compiled: Dict[Any, Callable] = {}
+        self._step_count = 0
+
+    # -- codec state: per-worker, stored host-side stacked on a leading
+    #    [world] axis so shard_map can scatter/gather it ------------------
+    def _init_codec_state(self) -> PyTree:
+        def leaf(p):
+            s = self.code.init_state(p.shape, p.dtype)
+            return jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (self.size,) + x.shape), s
+            )
+        return jax.tree.map(leaf, self.params)
+
+    # -- SPMD pipeline pieces (run inside shard_map) ----------------------
+    def _encode_tree(self, grads, codec_state, rng):
+        return encode_tree(self.code, grads, codec_state, rng, self.axis_name)
+
+    def _aggregate(self, grads, payloads):
+        return aggregate(
+            self.code, grads, payloads, self.axis_name, self.average, self.size
+        )
+
+    def _update(self, params, opt_state, summed):
+        new_params, new_state = self._update_fn(params, summed, opt_state, self.hyper)
+        if self.mode == "leader":
+            # rank-0 PS: semantically the leader steps and broadcasts
+            # (reference README.md:61-77, mpi_comms.py:120-133).
+            new_params = comms.broadcast_from_leader_tree(new_params, self.axis_name)
+        return new_params, new_state
+
+    # -- compiled step builders -------------------------------------------
+    def _build_grad_step(self, loss_fn):
+        axis = self.axis_name
+
+        def spmd(params, opt_state, codec_state, batch, rng):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss = lax.pmean(loss, axis)
+            payloads, new_codec_state = self._encode_tree(grads, codec_state, rng)
+            summed = self._aggregate(grads, payloads)
+            new_params, new_opt_state = self._update(params, opt_state, summed)
+            return new_params, new_opt_state, new_codec_state, loss
+
+        state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
+        return jax.jit(
+            jax.shard_map(
+                spmd,
+                mesh=self.mesh,
+                in_specs=(P(), P(), state_spec, P(axis), P()),
+                out_specs=(P(), P(), state_spec, P()),
+                check_vma=False,
+            )
+        )
+
+    def _build_grads_only_step(self):
+        """Aggregation-only step: caller supplies per-worker grads stacked
+        on a leading [world] axis (the reference's usage: backward already
+        ran, ``step`` only aggregates + updates)."""
+        axis = self.axis_name
+
+        def spmd(params, opt_state, codec_state, grads_stacked, rng):
+            grads = jax.tree.map(lambda x: x[0], grads_stacked)  # local shard
+            payloads, new_codec_state = self._encode_tree(grads, codec_state, rng)
+            summed = self._aggregate(grads, payloads)
+            new_params, new_opt_state = self._update(params, opt_state, summed)
+            return new_params, new_opt_state, new_codec_state
+
+        state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
+        grads_spec = jax.tree.map(lambda _: P(axis), self.params)
+        return jax.jit(
+            jax.shard_map(
+                spmd,
+                mesh=self.mesh,
+                in_specs=(P(), P(), state_spec, grads_spec, P()),
+                out_specs=(P(), P(), state_spec),
+                check_vma=False,
+            )
+        )
+
+    # -- public API --------------------------------------------------------
+    def step(
+        self,
+        grads: Optional[PyTree] = None,
+        *,
+        loss_fn: Optional[Callable] = None,
+        batch: Optional[PyTree] = None,
+        closure: Optional[Callable] = None,
+    ) -> Tuple[Optional[jax.Array], Dict[str, float]]:
+        """Run one distributed step; returns ``(loss, data)`` exactly like
+        the reference (``ps.py:193`` — its known deviation from the torch
+        Optimizer contract, kept deliberately for API parity).
+
+        Either pass ``loss_fn`` + ``batch`` (fused grad+aggregate+update),
+        or pass ``grads`` stacked per-worker on a leading ``[world]`` axis
+        (aggregation-only, the reference's own division of labor).
+        ``closure`` is accepted for signature parity (``ps.py:110-112``)
+        and invoked for its loss value if given.
+        """
+        t0 = time.perf_counter()
+        data: Dict[str, float] = {
+            # schema parity: reference ps.py:116-148,162-191
+            "code_wait": 0.0,
+            "iallgather_prepare_time": 0.0,  # compile-time now (static shapes)
+            "isend_time": 0.0,
+            "comm_wait": 0.0,
+            "decode_time": 0.0,
+            "optim_step_time": 0.0,
+            "msg_bytes": float(_tree_bytes(self.params)),
+            "packaged_bytes": float(
+                sum(
+                    self.code.payload_bits(p.shape, p.dtype) // 8
+                    for p in jax.tree.leaves(self.params)
+                )
+            ),
+        }
+        loss = None
+        self._rng, rng = jax.random.split(self._rng)
+
+        if loss_fn is not None:
+            if batch is None:
+                raise ValueError("loss_fn requires batch")
+            key = ("grad", loss_fn)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_grad_step(loss_fn)
+            fn = self._compiled[key]
+            self.params, self.opt_state, self.codec_state, loss = fn(
+                self.params, self.opt_state, self.codec_state, batch, rng
+            )
+        elif grads is not None:
+            key = ("grads-only",)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_grads_only_step()
+            fn = self._compiled[key]
+            self.params, self.opt_state, self.codec_state = fn(
+                self.params, self.opt_state, self.codec_state, grads, rng
+            )
+        else:
+            raise ValueError("pass grads or loss_fn+batch")
+
+        if closure is not None:
+            loss = closure()
+
+        jax.block_until_ready(self.params)
+        data["step_time"] = time.perf_counter() - t0
+        # In the fused program comm/decode/update are a single XLA
+        # schedule; attribute the whole wait to comm_wait like the
+        # reference's dominant term (ps.py:162).
+        data["comm_wait"] = data["step_time"]
+        self._step_count += 1
+        return loss, data
+
+
+class SGD(MPI_PS):
+    """PS-fused SGD (reference ``ps.py:195-214``)."""
+
+    def __init__(self, params, **kwargs):
+        kwargs.setdefault("optim", "sgd")
+        super().__init__(params, **kwargs)
+
+
+class Adam(MPI_PS):
+    """PS-fused Adam with amsgrad (reference ``ps.py:217-261``)."""
+
+    def __init__(self, params, **kwargs):
+        kwargs.setdefault("optim", "adam")
+        super().__init__(params, **kwargs)

@@ -1,0 +1,24 @@
+"""Auxiliary subsystems: metrics, host wire format, checkpointing.
+
+The reference's auxiliary surface (SURVEY §5) and the gaps it left:
+hand-rolled timing dicts (kept, as ``metrics``), pickle+blosc host wire
+format (replaced by a typed pytree pack in ``serialization``), and
+checkpoint/resume (absent in the reference; provided here via Orbax).
+"""
+
+from pytorch_ps_mpi_tpu.utils.metrics import StepTimer, MetricsAccumulator
+from pytorch_ps_mpi_tpu.utils.serialization import (
+    pack_pytree,
+    unpack_pytree,
+    save_pytree,
+    load_pytree,
+)
+
+__all__ = [
+    "StepTimer",
+    "MetricsAccumulator",
+    "pack_pytree",
+    "unpack_pytree",
+    "save_pytree",
+    "load_pytree",
+]

@@ -1,0 +1,80 @@
+"""Checkpoint / resume — absent in the reference (SURVEY §5.4: optimizer
+state lived in ``torch.optim.Optimizer.state`` and ``state_dict()`` was
+never called). Here it's first-class: Orbax sharded checkpoints of the
+full training pytree (params + optimizer state + codec state + step),
+with a plain-numpy fallback when Orbax is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+PyTree = Any
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    ocp = None
+    _HAVE_ORBAX = False
+
+from pytorch_ps_mpi_tpu.utils.serialization import load_pytree, save_pytree
+
+
+class CheckpointManager:
+    """Minimal step-indexed checkpoint manager.
+
+    ``save(step, state)`` / ``restore(template, step=None)`` where state is
+    any pytree (typically ``{'params':…, 'opt_state':…, 'step':…}``).
+    """
+
+    def __init__(self, directory: str, use_orbax: bool = True, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.use_orbax = use_orbax and _HAVE_ORBAX
+        self.max_to_keep = max_to_keep
+        if self.use_orbax:
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+            )
+
+    def save(self, step: int, state: PyTree) -> None:
+        if self.use_orbax:
+            self._mgr.save(step, args=ocp.args.StandardSave(state))
+            self._mgr.wait_until_finished()
+        else:
+            save_pytree(os.path.join(self.directory, f"ckpt_{step}.npz"), state)
+            self._gc()
+
+    def latest_step(self) -> Optional[int]:
+        if self.use_orbax:
+            return self._mgr.latest_step()
+        steps = self._numpy_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, template: PyTree, step: Optional[int] = None) -> PyTree:
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if self.use_orbax:
+            return self._mgr.restore(step, args=ocp.args.StandardRestore(template))
+        return load_pytree(
+            os.path.join(self.directory, f"ckpt_{step}.npz"), template
+        )
+
+    def _numpy_steps(self):
+        steps = []
+        for f in os.listdir(self.directory):
+            if f.startswith("ckpt_") and f.endswith(".npz"):
+                steps.append(int(f[len("ckpt_"):-len(".npz")]))
+        return sorted(steps)
+
+    def _gc(self):
+        steps = self._numpy_steps()
+        for s in steps[: -self.max_to_keep]:
+            os.remove(os.path.join(self.directory, f"ckpt_{s}.npz"))

@@ -1,0 +1,58 @@
+"""Per-step timing/metrics — the reference's observability surface.
+
+The reference returned a hand-rolled wall-clock dict from every ``step``
+(``ps.py:116-148,191``; ``igather``'s dict ``mpi_comms.py:90-93``). These
+helpers keep that contract ergonomic, and ``jax.profiler`` covers what
+host wall-clocks can't see inside a fused XLA program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, List
+
+
+class StepTimer:
+    """Accumulates named wall-clock segments into a dict.
+
+    >>> t = StepTimer()
+    >>> with t("comm_wait"): ...
+    >>> t.data
+    {'comm_wait': 0.0123}
+    """
+
+    def __init__(self):
+        self.data: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.data[name] = self.data.get(name, 0.0) + time.perf_counter() - t0
+
+
+class MetricsAccumulator:
+    """Collects per-step dicts; reports means (the host-side analog of the
+    reference's ``data`` list the caller was expected to keep)."""
+
+    def __init__(self):
+        self._rows: List[Dict[str, float]] = []
+
+    def add(self, row: Dict[str, float]) -> None:
+        self._rows.append(dict(row))
+
+    def mean(self) -> Dict[str, float]:
+        sums: Dict[str, float] = defaultdict(float)
+        counts: Dict[str, int] = defaultdict(int)
+        for row in self._rows:
+            for k, val in row.items():
+                sums[k] += val
+                counts[k] += 1
+        return {k: sums[k] / counts[k] for k in sums}
+
+    def __len__(self) -> int:
+        return len(self._rows)

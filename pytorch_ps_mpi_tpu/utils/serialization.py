@@ -1,0 +1,78 @@
+"""Host-side wire format: typed pytree pack/unpack.
+
+Replaces the reference's pickle(+blosc) object shipping
+(``mpi_comms.py:186-193``, and the abandoned zero-copy experiment in its
+``serialization.py``): gradients/params are pytrees of typed arrays, so
+the wire format is (flat byte buffer, static spec) — no pickling of code
+objects, no sentinel framing (the ``0x29`` collision bug, SURVEY §2.3),
+and the spec is exchanged once, not per message. On-device nothing here is
+needed at all; this is for host I/O (checkpoints, cross-process metadata).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def _spec_of(leaves: List[np.ndarray]) -> List[dict]:
+    return [{"shape": list(x.shape), "dtype": str(x.dtype)} for x in leaves]
+
+
+def pack_pytree(tree: PyTree) -> Tuple[bytes, str]:
+    """Flatten a pytree of arrays into one contiguous byte buffer plus a
+    JSON spec (shapes/dtypes + treedef). Inverse: :func:`unpack_pytree`."""
+    leaves, treedef = jax.tree.flatten(tree)
+    np_leaves = [np.asarray(x) for x in leaves]
+    buf = b"".join(x.tobytes() for x in np_leaves)
+    spec = json.dumps({"leaves": _spec_of(np_leaves), "treedef": str(treedef)})
+    return buf, spec
+
+
+def unpack_pytree(buf: bytes, spec: str, treedef=None, template: PyTree = None):
+    """Rebuild arrays from :func:`pack_pytree` output. Pass either the
+    ``treedef`` or a ``template`` pytree with the target structure."""
+    meta = json.loads(spec)
+    leaves = []
+    offset = 0
+    for leaf_meta in meta["leaves"]:
+        dtype = np.dtype(leaf_meta["dtype"])
+        shape = tuple(leaf_meta["shape"])
+        nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+        n = max(nbytes, dtype.itemsize)
+        arr = np.frombuffer(buf[offset : offset + n], dtype=dtype).reshape(shape)
+        leaves.append(arr)
+        offset += n
+    if treedef is None:
+        if template is None:
+            raise ValueError("need treedef or template")
+        treedef = jax.tree.structure(template)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def save_pytree(path: str, tree: PyTree) -> None:
+    """Write a pytree to ``path`` (.npz + spec sidecar in one file)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    np.savez(
+        path,
+        __treedef__=np.frombuffer(str(treedef).encode(), dtype=np.uint8),
+        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+    )
+
+
+def load_pytree(path: str, template: PyTree) -> PyTree:
+    """Read arrays saved by :func:`save_pytree` into ``template``'s
+    structure."""
+    with np.load(path) as data:
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
+    treedef = jax.tree.structure(template)
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"template has {treedef.num_leaves} leaves, file has {len(leaves)}"
+        )
+    return jax.tree.unflatten(treedef, leaves)

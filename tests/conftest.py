@@ -1,0 +1,37 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The TPU analog of the reference's ``mpirun -n 2 py.test`` harness
+(``Makefile:2-3``): multi-chip is simulated by multi-device single-process
+via ``--xla_force_host_platform_device_count`` — the SURVEY §4 test
+strategy. Must run before JAX initializes its backends, hence env setup at
+conftest import time; the axon TPU plugin ignores ``JAX_PLATFORMS`` so the
+config flag is set explicitly too.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from pytorch_ps_mpi_tpu.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, jax.devices()
+    return make_mesh()
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    from pytorch_ps_mpi_tpu.mesh import make_mesh
+
+    return make_mesh(shape=(4, 2), axis_names=("data", "seq"))

@@ -1,0 +1,127 @@
+"""Codec unit tests — pure-logic coverage the reference never had
+(SURVEY §4: "no unit tests of pure logic anywhere in the repo")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.codecs import (
+    ErrorFeedback,
+    IdentityCodec,
+    Int8Codec,
+    QSGDCodec,
+    RandomKCodec,
+    SignCodec,
+    TopKCodec,
+    get_codec,
+)
+
+
+def grad(shape=(33,), seed=0):
+    return jax.random.normal(jax.random.key(seed), shape)
+
+
+def roundtrip(codec, g, rng=None):
+    state = codec.init_state(g.shape, g.dtype)
+    payload, _ = codec.encode(g, state, rng)
+    return codec.decode(payload, g.shape, g.dtype)
+
+
+def test_registry():
+    assert isinstance(get_codec("identity"), IdentityCodec)
+    assert isinstance(get_codec("topk", k=4), TopKCodec)
+    with pytest.raises(KeyError):
+        get_codec("nope")
+
+
+def test_identity_exact():
+    g = grad((4, 5))
+    np.testing.assert_array_equal(np.asarray(roundtrip(IdentityCodec(), g)), np.asarray(g))
+
+
+def test_topk_keeps_largest():
+    g = jnp.asarray([0.1, -5.0, 0.2, 3.0, -0.05])
+    out = np.asarray(roundtrip(TopKCodec(k=2), g))
+    np.testing.assert_allclose(out, [0.0, -5.0, 0.0, 3.0, 0.0])
+
+
+def test_topk_fraction_and_bits():
+    c = TopKCodec(fraction=0.25)
+    g = grad((100,))
+    out = np.asarray(roundtrip(c, g))
+    assert (out != 0).sum() <= 25
+    assert c.payload_bits(g.shape, g.dtype) == 25 * (32 + 32)
+
+
+def test_topk_decode_sum_fused_equals_loop():
+    c = TopKCodec(k=3)
+    gs = [grad((20,), seed=i) for i in range(4)]
+    payloads = [c.encode(g, ())[0] for g in gs]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *payloads)
+    fused = np.asarray(c.decode_sum(stacked, (20,), jnp.float32))
+    loop = sum(np.asarray(c.decode(p, (20,), jnp.float32)) for p in payloads)
+    np.testing.assert_allclose(fused, loop, rtol=1e-6)
+
+
+def test_randomk_unbiased_expectation():
+    c = RandomKCodec(k=8)
+    g = grad((32,))
+    outs = [
+        np.asarray(roundtrip(c, g, jax.random.key(i))) for i in range(500)
+    ]
+    # per-coordinate std of the mean is ~|g|*sqrt(3/500); 0.5 is ~4 sigma
+    mean = np.mean(outs, axis=0)
+    np.testing.assert_allclose(mean, np.asarray(g), atol=0.5)
+
+
+def test_int8_accuracy():
+    g = grad((256,))
+    out = np.asarray(roundtrip(Int8Codec(use_pallas=False), g))
+    scale = float(jnp.max(jnp.abs(g))) / 127
+    np.testing.assert_allclose(out, np.asarray(g), atol=scale)
+
+
+def test_int8_pallas_matches_jnp():
+    g = grad((2048,))
+    a = np.asarray(roundtrip(Int8Codec(use_pallas=True), g))
+    b = np.asarray(roundtrip(Int8Codec(use_pallas=False), g))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_qsgd_unbiased():
+    c = QSGDCodec(levels=4)
+    g = grad((32,))
+    outs = [
+        np.asarray(roundtrip(c, g, jax.random.key(i))) for i in range(300)
+    ]
+    np.testing.assert_allclose(np.mean(outs, axis=0), np.asarray(g), atol=0.15)
+
+
+def test_sign_codec():
+    g = jnp.asarray([1.0, -2.0, 3.0, -4.0, 5.0])
+    c = SignCodec()
+    out = np.asarray(roundtrip(c, g))
+    scale = np.abs(np.asarray(g)).mean()
+    np.testing.assert_allclose(out, scale * np.sign(np.asarray(g)))
+    # 1 bit/element + fp32 scale, packed
+    assert c.payload_bits((1000,), jnp.float32) == 125 * 8 + 32
+
+
+def test_error_feedback_accumulates_residual():
+    inner = TopKCodec(k=1)
+    c = ErrorFeedback(inner)
+    g = jnp.asarray([1.0, 0.6])
+    state = c.init_state(g.shape, g.dtype)
+    payload, state = c.encode(g, state)
+    # transmitted [1, 0]; memory keeps the dropped 0.6
+    np.testing.assert_allclose(np.asarray(state["memory"]), [0.0, 0.6])
+    # next round the residual wins: corrected = [1, 1.2] → index 1 sent
+    payload2, state2 = c.encode(g, state)
+    out2 = np.asarray(c.decode(payload2, g.shape, g.dtype))
+    np.testing.assert_allclose(out2, [0.0, 1.2])
+
+
+def test_payload_bits_identity():
+    c = IdentityCodec()
+    assert c.payload_bits((10, 10), jnp.float32) == 100 * 32

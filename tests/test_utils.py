@@ -1,0 +1,88 @@
+"""Utils: wire format round-trips (replacing the reference's broken
+``serialization.py`` experiment, SURVEY §2.3 — ours actually works and is
+tested), checkpoint/resume (absent in the reference, SURVEY §5.4), and
+metrics helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.utils import (
+    MetricsAccumulator,
+    StepTimer,
+    load_pytree,
+    pack_pytree,
+    save_pytree,
+    unpack_pytree,
+)
+from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
+
+
+def tree():
+    return {
+        "w": jnp.arange(12.0).reshape(3, 4),
+        "nested": {"b": jnp.ones((5,), jnp.int32), "s": jnp.float32(2.5)},
+    }
+
+
+def assert_tree_equal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)), a, b
+    )
+
+
+def test_pack_unpack_roundtrip():
+    t = tree()
+    buf, spec = pack_pytree(t)
+    out = unpack_pytree(buf, spec, template=t)
+    assert_tree_equal(t, out)
+
+
+def test_save_load_roundtrip(tmp_path):
+    t = tree()
+    path = str(tmp_path / "state.npz")
+    save_pytree(path, t)
+    out = load_pytree(path, t)
+    assert_tree_equal(t, out)
+
+
+def test_load_wrong_template_raises(tmp_path):
+    t = tree()
+    path = str(tmp_path / "state.npz")
+    save_pytree(path, t)
+    with pytest.raises(ValueError):
+        load_pytree(path, {"only_one": jnp.zeros(1)})
+
+
+def test_checkpoint_manager_numpy_fallback(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), use_orbax=False, max_to_keep=2)
+    t = tree()
+    for step in [1, 2, 3]:
+        mgr.save(step, jax.tree.map(lambda x: x * step, t))
+    assert mgr.latest_step() == 3
+    out = mgr.restore(t)
+    assert_tree_equal(out, jax.tree.map(lambda x: x * 3, t))
+    # gc kept only the last 2
+    assert mgr._numpy_steps() == [2, 3]
+
+
+def test_checkpoint_manager_orbax(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    t = {"w": jnp.arange(6.0).reshape(2, 3)}
+    mgr.save(0, t)
+    out = mgr.restore(t)
+    assert_tree_equal(out, t)
+
+
+def test_step_timer_and_accumulator():
+    timer = StepTimer()
+    with timer("comm_wait"):
+        pass
+    assert "comm_wait" in timer.data and timer.data["comm_wait"] >= 0
+
+    acc = MetricsAccumulator()
+    acc.add({"a": 1.0, "b": 2.0})
+    acc.add({"a": 3.0})
+    m = acc.mean()
+    assert m["a"] == 2.0 and m["b"] == 2.0 and len(acc) == 2
