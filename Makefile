@@ -1,0 +1,15 @@
+# Parity with the reference's 3-line Makefile (`make test` ran
+# `mpirun -n 2 py.test -s`); here multi-chip is an 8-device virtual CPU
+# mesh set up by tests/conftest.py — no cluster, no MPI.
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+native:
+	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libwirecodec.so native/wirecodec.cpp
+	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp
+
+.PHONY: test bench native

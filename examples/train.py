@@ -1,0 +1,129 @@
+"""Train any BASELINE config end-to-end from the command line.
+
+The driving script the reference kept in a sibling research repo
+(SURVEY: "the driving train script ... imports this package"), made part
+of the framework. Synthetic data (zero-egress environment); every knob of
+the optimizer surface is exposed.
+
+Examples:
+  python examples/train.py --config mlp_mnist --steps 50
+  python examples/train.py --config resnet18_cifar10 --codec topk --codec-arg fraction=0.01
+  python examples/train.py --config bert_mlm --optim adam --lr 1e-3 --mode leader
+  python examples/train.py --config resnet50_imagenet --steps 10 --batch 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_ps_mpi_tpu import MPI_PS
+from pytorch_ps_mpi_tpu.codecs import get_codec
+from pytorch_ps_mpi_tpu.data import cross_entropy_loss, synthetic_images, synthetic_mlm
+from pytorch_ps_mpi_tpu.models import MLP, BertConfig, BertMLM, ResNet18, ResNet50
+from pytorch_ps_mpi_tpu.models.bert import mlm_loss
+from pytorch_ps_mpi_tpu.trainer import Trainer
+
+CONFIGS = ["mlp_mnist", "resnet18_cifar10", "resnet50_imagenet", "bert_mlm"]
+
+
+def build(config: str, batch: int, seed: int = 0):
+    """Returns (params, loss_fn, batch_iterator)."""
+    key = jax.random.key(seed)
+    if config == "mlp_mnist":
+        model = MLP(features=(128, 10))
+        data = synthetic_images("mnist", batch)
+        x0, _ = next(data)
+        params = model.init(key, x0)
+        def loss_fn(p, b):
+            x, y = b
+            return cross_entropy_loss(model.apply(p, x), y)
+        return params, loss_fn, data
+    if config == "resnet18_cifar10":
+        model = ResNet18(num_classes=10, small_inputs=True)
+    elif config == "resnet50_imagenet":
+        model = ResNet50(num_classes=1000)
+    else:
+        cfg = BertConfig.base()
+        model = BertMLM(cfg)
+        data = synthetic_mlm(batch, seq_len=128, vocab_size=cfg.vocab_size)
+        b0 = next(data)
+        params = model.init(key, b0["tokens"])
+        def loss_fn(p, b):
+            return mlm_loss(model.apply(p, b["tokens"]), b["targets"], b["mask"])
+        return params, loss_fn, data
+    name = "cifar10" if config == "resnet18_cifar10" else "imagenet"
+    data = synthetic_images(name, batch)
+    x0, _ = next(data)
+    params = model.init(key, x0)
+    def loss_fn(p, b):
+        x, y = b
+        return cross_entropy_loss(model.apply(p, x), y)
+    return params, loss_fn, data
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", choices=CONFIGS, default="mlp_mnist")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--optim", choices=["sgd", "adam"], default="sgd")
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--mode", choices=["allgather", "leader"], default="allgather")
+    ap.add_argument("--codec", default=None,
+                    help="identity|topk|randomk|int8|qsgd|sign|powersgd|ef")
+    ap.add_argument("--codec-arg", action="append", default=[],
+                    help="k=v passed to the codec (repeatable)")
+    ap.add_argument("--bf16-comm", action="store_true",
+                    help="bfloat16 gradient collectives")
+    ap.add_argument("--scan-chunk", type=int, default=1,
+                    help=">1 fuses N steps per XLA program")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--instrument", action="store_true",
+                    help="per-stage timing metrics")
+    ap.add_argument("--log-every", type=int, default=5)
+    args = ap.parse_args(argv)
+
+    code = None
+    if args.codec:
+        kw = {}
+        for kv in args.codec_arg:
+            k, v = kv.split("=", 1)
+            try:
+                v = json.loads(v)
+            except json.JSONDecodeError:
+                pass
+            kw[k] = v
+        code = get_codec(args.codec, **kw)
+
+    params, loss_fn, data = build(args.config, args.batch)
+    hyper = {"lr": args.lr}
+    if args.optim == "sgd":
+        hyper["momentum"] = args.momentum
+    opt = MPI_PS(
+        params, optim=args.optim, code=code, mode=args.mode,
+        average=True, instrument=args.instrument,
+        comm_dtype=jnp.bfloat16 if args.bf16_comm else None, **hyper,
+    )
+    print(f"config={args.config} devices={jax.device_count()} "
+          f"world={opt.size} codec={args.codec or 'identity'}")
+    trainer = Trainer(
+        opt, loss_fn, checkpoint_dir=args.checkpoint_dir,
+        scan_chunk=args.scan_chunk,
+    )
+    resumed = trainer.maybe_restore()
+    if resumed:
+        print(f"resumed from step {trainer.step_count}")
+    summary = trainer.fit(data, args.steps, log_every=args.log_every)
+    print(json.dumps({k: round(float(v), 6) for k, v in summary.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,240 @@
+// psqueue: shared-memory parameter-server transport for host processes.
+//
+// The native runtime piece of the async (AsySG-InCon) path: where the
+// reference moved pickled gradient buffers between ranks with MPI
+// (Igatherv/Ibcast, reference mpi_comms.py:88,132) and got asynchrony from
+// nonblocking requests, this provides the same roles for co-hosted
+// processes (one per pod-slice controller in the DCN picture):
+//
+//   * a versioned parameter board the server publishes and workers read at
+//     any time — the "inconsistent read" of AsySG-InCon: no barrier, a
+//     worker may read version v while another reads v-2; a seqlock keeps
+//     each read internally consistent without blocking the writer.
+//   * one single-slot gradient mailbox per worker (EMPTY/WRITING/FULL
+//     atomic state), tagged with the parameter version the gradient was
+//     computed at, so the server can measure/bound staleness.
+//
+// Layout in one shm segment:
+//   Header | param area (2 KiB aligned) | n_workers * (SlotHeader | grad area)
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x50535155455545ULL;  // "PSQUEUE"
+constexpr size_t kAlign = 2048;
+
+inline size_t align_up(size_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+struct Header {
+  uint64_t magic;
+  uint32_t n_workers;
+  uint32_t reserved;
+  uint64_t param_cap;
+  uint64_t grad_cap;
+  std::atomic<uint64_t> param_seq;   // seqlock: odd = write in progress
+  std::atomic<uint64_t> param_version;
+  std::atomic<uint64_t> param_len;
+};
+
+enum SlotState : uint32_t { EMPTY = 0, WRITING = 1, FULL = 2 };
+
+struct SlotHeader {
+  std::atomic<uint32_t> state;
+  uint32_t reserved;
+  std::atomic<uint64_t> version;  // param version the grad was computed at
+  std::atomic<uint64_t> len;
+};
+
+struct Handle {
+  int fd;
+  size_t total;
+  uint8_t* base;
+  bool owner;
+  char name[256];
+};
+
+inline Header* hdr(Handle* h) { return reinterpret_cast<Header*>(h->base); }
+inline uint8_t* param_area(Handle* h) {
+  return h->base + align_up(sizeof(Header));
+}
+inline SlotHeader* slot(Handle* h, uint32_t w) {
+  Header* H = hdr(h);
+  uint8_t* p = param_area(h) + align_up(H->param_cap);
+  size_t slot_stride = align_up(sizeof(SlotHeader)) + align_up(H->grad_cap);
+  return reinterpret_cast<SlotHeader*>(p + w * slot_stride);
+}
+inline uint8_t* slot_data(Handle* h, uint32_t w) {
+  return reinterpret_cast<uint8_t*>(slot(h, w)) + align_up(sizeof(SlotHeader));
+}
+
+size_t total_size(uint32_t n_workers, uint64_t param_cap, uint64_t grad_cap) {
+  return align_up(sizeof(Header)) + align_up(param_cap) +
+         n_workers * (align_up(sizeof(SlotHeader)) + align_up(grad_cap));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Server: create + initialize the segment. Returns NULL on failure.
+void* psq_create(const char* name, uint32_t n_workers, uint64_t param_cap,
+                 uint64_t grad_cap) {
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = total_size(n_workers, param_cap, grad_cap);
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  std::memset(base, 0, total);
+  Handle* h = new Handle{fd, total, (uint8_t*)base, true, {0}};
+  std::strncpy(h->name, name, sizeof(h->name) - 1);
+  Header* H = hdr(h);
+  H->n_workers = n_workers;
+  H->param_cap = param_cap;
+  H->grad_cap = grad_cap;
+  H->param_seq.store(0);
+  H->param_version.store(0);
+  H->param_len.store(0);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  H->magic = kMagic;
+  return h;
+}
+
+// Worker: attach to an existing segment. Returns NULL on failure.
+void* psq_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Handle* h = new Handle{fd, (size_t)st.st_size, (uint8_t*)base, false, {0}};
+  std::strncpy(h->name, name, sizeof(h->name) - 1);
+  if (hdr(h)->magic != kMagic) {
+    munmap(base, h->total);
+    close(fd);
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void psq_close(void* hv) {
+  Handle* h = (Handle*)hv;
+  if (!h) return;
+  munmap(h->base, h->total);
+  close(h->fd);
+  if (h->owner) shm_unlink(h->name);
+  delete h;
+}
+
+uint32_t psq_n_workers(void* hv) { return hdr((Handle*)hv)->n_workers; }
+
+// Server: publish a new parameter snapshot; bumps version. Seqlock write.
+int psq_publish_params(void* hv, const uint8_t* buf, uint64_t len,
+                       uint64_t version) {
+  Handle* h = (Handle*)hv;
+  Header* H = hdr(h);
+  if (len > H->param_cap) return -1;
+  uint64_t seq = H->param_seq.load(std::memory_order_relaxed);
+  H->param_seq.store(seq + 1, std::memory_order_release);  // odd: writing
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  std::memcpy(param_area(h), buf, len);
+  H->param_len.store(len, std::memory_order_relaxed);
+  H->param_version.store(version, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  H->param_seq.store(seq + 2, std::memory_order_release);  // even: done
+  return 0;
+}
+
+// Worker: consistent read of the latest params. Returns byte length,
+// stores the snapshot's version. Retries while the seqlock is odd/moved.
+int64_t psq_read_params(void* hv, uint8_t* buf, uint64_t cap,
+                        uint64_t* version_out) {
+  Handle* h = (Handle*)hv;
+  Header* H = hdr(h);
+  for (int attempt = 0; attempt < 1000000; ++attempt) {
+    uint64_t s1 = H->param_seq.load(std::memory_order_acquire);
+    if (s1 & 1) continue;  // write in progress
+    uint64_t len = H->param_len.load(std::memory_order_relaxed);
+    uint64_t ver = H->param_version.load(std::memory_order_relaxed);
+    if (len > cap) return -1;
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    std::memcpy(buf, param_area(h), len);
+    std::atomic_thread_fence(std::memory_order_seq_cst);
+    uint64_t s2 = H->param_seq.load(std::memory_order_acquire);
+    if (s1 == s2) {
+      if (version_out) *version_out = ver;
+      return (int64_t)len;
+    }
+  }
+  return -2;  // writer wedged
+}
+
+// Worker: push a gradient into this worker's mailbox. Returns 0 if the
+// slot still holds an unconsumed gradient (caller retries/backs off).
+int psq_push_grad(void* hv, uint32_t worker, const uint8_t* buf, uint64_t len,
+                  uint64_t version) {
+  Handle* h = (Handle*)hv;
+  Header* H = hdr(h);
+  if (worker >= H->n_workers || len > H->grad_cap) return -1;
+  SlotHeader* S = slot(h, worker);
+  uint32_t expected = EMPTY;
+  if (!S->state.compare_exchange_strong(expected, WRITING,
+                                        std::memory_order_acquire))
+    return 0;
+  std::memcpy(slot_data(h, worker), buf, len);
+  S->len.store(len, std::memory_order_relaxed);
+  S->version.store(version, std::memory_order_relaxed);
+  S->state.store(FULL, std::memory_order_release);
+  return 1;
+}
+
+// Server: take one FULL gradient, scanning round-robin from *cursor.
+// Returns byte length (>0) and fills worker/version; 0 if none pending.
+int64_t psq_pop_grad(void* hv, uint8_t* buf, uint64_t cap,
+                     uint32_t* worker_out, uint64_t* version_out,
+                     uint32_t* cursor) {
+  Handle* h = (Handle*)hv;
+  Header* H = hdr(h);
+  uint32_t n = H->n_workers;
+  for (uint32_t k = 0; k < n; ++k) {
+    uint32_t w = (*cursor + k) % n;
+    SlotHeader* S = slot(h, w);
+    if (S->state.load(std::memory_order_acquire) != FULL) continue;
+    uint64_t len = S->len.load(std::memory_order_relaxed);
+    if (len > cap) return -1;
+    std::memcpy(buf, slot_data(h, w), len);
+    if (worker_out) *worker_out = w;
+    if (version_out) *version_out = S->version.load(std::memory_order_relaxed);
+    S->state.store(EMPTY, std::memory_order_release);
+    *cursor = (w + 1) % n;
+    return (int64_t)len;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,111 @@
+// wirecodec: host-side wire compression for checkpoint/metadata buffers.
+//
+// The TPU-native framework's answer to the reference's c-blosc dependency
+// (reference mpi_comms.py:18-30 reached blosc through python bindings; this
+// repo ships the native code itself). Two classic filters:
+//
+//   * byte shuffle  — transpose the bytes of fixed-width elements so that
+//     high-order bytes (mostly equal for floats of similar magnitude) become
+//     long runs; blosc's core trick.
+//   * RLE0          — run-length encode zero bytes, which dominate shuffled
+//     float data and sparse/top-k gradient payloads.
+//
+// On-device gradients never touch this path (ICI outruns any host codec —
+// SURVEY §2.4); this is for host I/O: checkpoints, cross-process metadata,
+// DCN-side buffers.
+//
+// Format of rle0: repeated [zero_run varint][lit_len varint][lit bytes].
+// Varints are LEB128. Worst case output = input + 16.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+void wc_shuffle(const uint8_t* src, uint8_t* dst, size_t n_elems, size_t elem) {
+  for (size_t i = 0; i < n_elems; ++i)
+    for (size_t j = 0; j < elem; ++j)
+      dst[j * n_elems + i] = src[i * elem + j];
+}
+
+void wc_unshuffle(const uint8_t* src, uint8_t* dst, size_t n_elems, size_t elem) {
+  for (size_t i = 0; i < n_elems; ++i)
+    for (size_t j = 0; j < elem; ++j)
+      dst[i * elem + j] = src[j * n_elems + i];
+}
+
+static inline size_t put_varint(uint8_t* dst, uint64_t v) {
+  size_t k = 0;
+  while (v >= 0x80) {
+    dst[k++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  dst[k++] = (uint8_t)v;
+  return k;
+}
+
+static inline size_t get_varint(const uint8_t* src, size_t avail, uint64_t* v) {
+  uint64_t out = 0;
+  int shift = 0;
+  for (size_t k = 0; k < avail && k < 10; ++k) {
+    out |= (uint64_t)(src[k] & 0x7F) << shift;
+    if (!(src[k] & 0x80)) {
+      *v = out;
+      return k + 1;
+    }
+    shift += 7;
+  }
+  return 0;  // malformed
+}
+
+size_t wc_rle0_max_out(size_t n) { return n + n / 64 + 32; }
+
+// Returns compressed size, or 0 on insufficient dst capacity.
+size_t wc_rle0_encode(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+  size_t i = 0, o = 0;
+  while (i < n) {
+    size_t zrun = 0;
+    while (i + zrun < n && src[i + zrun] == 0) ++zrun;
+    size_t lit_start = i + zrun, lit = 0;
+    // literal run extends until the next "worthwhile" zero run (>= 2) or end
+    while (lit_start + lit < n) {
+      if (src[lit_start + lit] == 0) {
+        size_t z = 0;
+        while (lit_start + lit + z < n && src[lit_start + lit + z] == 0) ++z;
+        if (z >= 2) break;
+      }
+      ++lit;
+    }
+    if (o + 20 + lit > cap) return 0;
+    o += put_varint(dst + o, zrun);
+    o += put_varint(dst + o, lit);
+    std::memcpy(dst + o, src + lit_start, lit);
+    o += lit;
+    i = lit_start + lit;
+  }
+  return o;
+}
+
+// Returns decompressed size, or 0 on malformed input / capacity overflow.
+size_t wc_rle0_decode(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+  size_t i = 0, o = 0;
+  while (i < n) {
+    uint64_t zrun, lit;
+    size_t k = get_varint(src + i, n - i, &zrun);
+    if (!k) return 0;
+    i += k;
+    k = get_varint(src + i, n - i, &lit);
+    if (!k) return 0;
+    i += k;
+    if (o + zrun + lit > cap || i + lit > n) return 0;
+    std::memset(dst + o, 0, zrun);
+    o += zrun;
+    std::memcpy(dst + o, src + i, lit);
+    o += lit;
+    i += lit;
+  }
+  return o;
+}
+
+}  // extern "C"

@@ -1,0 +1,234 @@
+"""Multi-process async parameter server over native shared memory.
+
+The cross-process face of AsySG-InCon (the in-XLA single-program form
+lives in ``async_ps.py``): a server process owns the parameters and
+applies gradient updates in arrival order; worker processes read the
+latest published snapshot whenever they like (inconsistent reads) and push
+gradients tagged with the version they used. Transport is the C++
+``native/psqueue.cpp`` segment (seqlock parameter board + per-worker
+gradient mailboxes) — the role mpi4py's nonblocking collectives played for
+the reference (``mpi_comms.py:88,132``), with staleness bounded by the
+server dropping gradients older than ``max_staleness`` versions.
+
+Across real pod slices the same server loop runs on each slice controller
+with DCN transfers in place of shm; this module is the single-host
+(multi-process) instantiation and the protocol reference.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Build (once) and load native/psqueue.cpp; None without a toolchain."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    from pytorch_ps_mpi_tpu.utils.native import build_and_load
+
+    lib = build_and_load("psqueue.cpp")
+    if lib is None:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.psq_create.restype = ctypes.c_void_p
+    lib.psq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                               ctypes.c_uint64, ctypes.c_uint64]
+    lib.psq_open.restype = ctypes.c_void_p
+    lib.psq_open.argtypes = [ctypes.c_char_p]
+    lib.psq_close.argtypes = [ctypes.c_void_p]
+    lib.psq_n_workers.restype = ctypes.c_uint32
+    lib.psq_n_workers.argtypes = [ctypes.c_void_p]
+    lib.psq_publish_params.restype = ctypes.c_int
+    lib.psq_publish_params.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
+                                       ctypes.c_uint64]
+    lib.psq_read_params.restype = ctypes.c_int64
+    lib.psq_read_params.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.psq_push_grad.restype = ctypes.c_int
+    lib.psq_push_grad.argtypes = [ctypes.c_void_p, ctypes.c_uint32, u8p,
+                                  ctypes.c_uint64, ctypes.c_uint64]
+    lib.psq_pop_grad.restype = ctypes.c_int64
+    lib.psq_pop_grad.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
+                                 ctypes.POINTER(ctypes.c_uint32),
+                                 ctypes.POINTER(ctypes.c_uint64),
+                                 ctypes.POINTER(ctypes.c_uint32)]
+    _lib = lib
+    return _lib
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _flat_size(template: PyTree) -> int:
+    import jax
+
+    return sum(int(np.prod(np.shape(x))) for x in jax.tree.leaves(template))
+
+
+def _flatten(tree: PyTree) -> np.ndarray:
+    import jax
+
+    return np.concatenate(
+        [np.asarray(x, np.float32).reshape(-1) for x in jax.tree.leaves(tree)]
+    ) if jax.tree.leaves(tree) else np.zeros(0, np.float32)
+
+
+def _unflatten(flat: np.ndarray, template: PyTree) -> PyTree:
+    import jax
+
+    leaves, treedef = jax.tree.flatten(template)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(np.prod(np.shape(leaf)))
+        out.append(flat[off : off + n].reshape(np.shape(leaf)).astype(np.float32))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+class ShmPSServer:
+    """Owns params; publishes snapshots, consumes gradients in arrival
+    order (the PS side of the reference's rank-0 loop, README.md:61-77)."""
+
+    def __init__(self, name: str, num_workers: int, template: PyTree,
+                 max_staleness: int = 4):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native psqueue unavailable (no g++?)")
+        self._lib = lib
+        self.template = template
+        self.num_workers = num_workers
+        self.max_staleness = max_staleness
+        nbytes = _flat_size(template) * 4
+        self._h = lib.psq_create(name.encode(), num_workers, nbytes, nbytes)
+        if not self._h:
+            raise RuntimeError(f"psq_create({name}) failed")
+        self.version = 0
+        self._grad_buf = np.empty(_flat_size(template), np.float32)
+        self.stale_drops = 0
+        self.staleness_seen: Dict[int, int] = {}
+
+    def publish(self, params: PyTree) -> None:
+        flat = _flatten(params)
+        self.version += 1
+        rc = self._lib.psq_publish_params(
+            self._h, _u8(flat.view(np.uint8)), flat.nbytes, self.version
+        )
+        if rc != 0:
+            raise RuntimeError("psq_publish_params failed")
+
+    def poll_grad(self) -> Optional[Tuple[int, int, PyTree]]:
+        """One pending gradient as (worker, version, grad_tree), or None.
+        Gradients staler than max_staleness are dropped (bounded
+        staleness), counted in ``stale_drops``."""
+        worker = ctypes.c_uint32()
+        version = ctypes.c_uint64()
+        cursor = getattr(self, "_cursor", None)
+        if cursor is None:
+            cursor = self._cursor = ctypes.c_uint32(0)
+        n = self._lib.psq_pop_grad(
+            self._h, _u8(self._grad_buf.view(np.uint8)), self._grad_buf.nbytes,
+            ctypes.byref(worker), ctypes.byref(version), ctypes.byref(cursor),
+        )
+        if n <= 0:
+            return None
+        staleness = self.version - int(version.value)
+        self.staleness_seen[staleness] = self.staleness_seen.get(staleness, 0) + 1
+        if staleness > self.max_staleness:
+            self.stale_drops += 1
+            return self.poll_grad()
+        flat = self._grad_buf[: n // 4].copy()
+        return int(worker.value), int(version.value), _unflatten(flat, self.template)
+
+    def close(self):
+        if self._h:
+            self._lib.psq_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmPSWorker:
+    """Reads the latest params whenever it likes; pushes version-tagged
+    gradients (the worker side of AsySG-InCon's inconsistent reads)."""
+
+    def __init__(self, name: str, worker_id: int, template: PyTree,
+                 timeout: float = 30.0):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native psqueue unavailable (no g++?)")
+        self._lib = lib
+        deadline = time.time() + timeout
+        self._h = None
+        while time.time() < deadline:
+            h = lib.psq_open(name.encode())
+            if h:
+                self._h = h
+                break
+            time.sleep(0.05)
+        if not self._h:
+            raise TimeoutError(f"psq_open({name}) timed out")
+        self.worker_id = worker_id
+        self.template = template
+        self._param_buf = np.empty(_flat_size(template), np.float32)
+
+    def read_params(self, timeout: float = 30.0) -> Tuple[PyTree, int]:
+        """Latest published snapshot (blocks until the server's first
+        publish; after that, never blocks on the writer — seqlock)."""
+        version = ctypes.c_uint64()
+        deadline = time.time() + timeout
+        while True:
+            n = self._lib.psq_read_params(
+                self._h, _u8(self._param_buf.view(np.uint8)),
+                self._param_buf.nbytes, ctypes.byref(version),
+            )
+            if n < 0:
+                raise RuntimeError(f"psq_read_params -> {n}")
+            if version.value > 0:
+                break
+            if time.time() > deadline:
+                raise TimeoutError("no parameter snapshot published yet")
+            time.sleep(0.002)
+        return _unflatten(self._param_buf[: n // 4].copy(), self.template), int(
+            version.value
+        )
+
+    def push_grad(self, grad: PyTree, version: int,
+                  timeout: float = 30.0) -> None:
+        flat = _flatten(grad)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            rc = self._lib.psq_push_grad(
+                self._h, self.worker_id, _u8(flat.view(np.uint8)),
+                flat.nbytes, version,
+            )
+            if rc == 1:
+                return
+            if rc < 0:
+                raise RuntimeError("psq_push_grad failed")
+            time.sleep(0.002)  # mailbox full: server hasn't consumed yet
+        raise TimeoutError("push_grad timed out")
+
+    def close(self):
+        if self._h:
+            self._lib.psq_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -94,12 +94,23 @@ def aggregate(
     axis_name: str,
     average: bool,
     size: int,
+    comm_dtype=None,
 ) -> PyTree:
     """Collective + decode + sum across workers (reference
     ``ps.py:140-176``). Identity-like codecs lower to one fused ``psum``;
-    everything else all-gathers static-shape payloads and scatter/sums."""
+    everything else all-gathers static-shape payloads and scatter/sums.
+
+    ``comm_dtype`` (e.g. ``jnp.bfloat16``) narrows the psum path's wire
+    dtype — halving ICI bytes, the cheap always-on compression every TPU
+    program should use — and casts back for the f32 update."""
     if code.supports_psum:
-        summed = comms.allreduce_sum_tree(grads, axis_name)
+        if comm_dtype is not None:
+            summed = jax.tree.map(
+                lambda g: lax.psum(g.astype(comm_dtype), axis_name).astype(g.dtype),
+                grads,
+            )
+        else:
+            summed = comms.allreduce_sum_tree(grads, axis_name)
     else:
         leaves, treedef = jax.tree.flatten(grads)
         payload_list = treedef.flatten_up_to(payloads)
@@ -148,6 +159,7 @@ class MPI_PS:
         mode: str = "allgather",
         average: bool = False,
         instrument: bool = False,
+        comm_dtype=None,
         seed: int = 0,
         **hyper,
     ):
@@ -166,6 +178,7 @@ class MPI_PS:
         self.mode = mode
         self.average = average
         self.instrument = instrument
+        self.comm_dtype = comm_dtype
         self.rank = jax.process_index()           # reference ps.py:71-72
         self.size = int(self.mesh.shape[axis_name])  # reference ps.py:73
         self._rng = jax.random.key(seed)
@@ -189,7 +202,8 @@ class MPI_PS:
 
     def _aggregate(self, grads, payloads):
         return aggregate(
-            self.code, grads, payloads, self.axis_name, self.average, self.size
+            self.code, grads, payloads, self.axis_name, self.average, self.size,
+            self.comm_dtype,
         )
 
     def _update(self, params, opt_state, summed):
@@ -201,6 +215,158 @@ class MPI_PS:
         return new_params, new_state
 
     # -- compiled step builders -------------------------------------------
+    def _build_instrumented_stages(self, loss_fn):
+        """Pipeline as four separately-dispatched programs so host timers
+        can fill the reference's per-stage schema (``ps.py:116-148``) with
+        real wall times: encode → collective → decode+sum → update.
+        Slower than the fused path (extra dispatches + no cross-stage
+        fusion); for measurement, not production."""
+        axis = self.axis_name
+        state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
+        grads_spec = jax.tree.map(lambda _: P(axis), self.params)
+
+        def grad_spmd(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            return lax.pmean(loss, axis), jax.tree.map(lambda g: g[None], grads)
+
+        grad_fn = jax.jit(
+            jax.shard_map(
+                grad_spmd, mesh=self.mesh, in_specs=(P(), P(axis)),
+                out_specs=(P(), grads_spec), check_vma=False,
+            )
+        ) if loss_fn is not None else None
+
+        def encode_spmd(grads_stacked, codec_state, rng):
+            grads = jax.tree.map(lambda x: x[0], grads_stacked)
+            payloads, new_state = encode_tree(self.code, grads, codec_state, rng, axis)
+            return jax.tree.map(lambda x: x[None], payloads), new_state
+
+        payload_spec = jax.tree.map(lambda _: P(axis), self._payload_struct())
+        encode_fn = jax.jit(
+            jax.shard_map(
+                encode_spmd, mesh=self.mesh,
+                in_specs=(grads_spec, state_spec, P()),
+                out_specs=(payload_spec, state_spec),
+                check_vma=False,
+            )
+        )
+
+        def gather_spmd(payloads_stacked):
+            local = jax.tree.map(lambda x: x[0], payloads_stacked)
+            return jax.tree.map(lambda x: lax.all_gather(x, axis), local)
+
+        def sum_spmd(grads_stacked):
+            grads = jax.tree.map(lambda x: x[0], grads_stacked)
+            return aggregate(
+                self.code, grads, None, axis, False, self.size, self.comm_dtype
+            )
+
+        def update_spmd(params, opt_state, summed):
+            if self.average:
+                summed = jax.tree.map(lambda x: x / self.size, summed)
+            # self._update includes the mode='leader' broadcast, so the
+            # instrumented optim_step_time covers the same collective the
+            # fused path pays; run under shard_map so the axis is bound.
+            return self._update(params, opt_state, summed)
+
+        update_fn_impl = jax.shard_map(
+            update_spmd, mesh=self.mesh, in_specs=(P(), P(), P()),
+            out_specs=(P(), P()), check_vma=False,
+        )
+
+        return {
+            "grad": grad_fn,
+            "encode": encode_fn,
+            "gather": jax.jit(
+                jax.shard_map(
+                    gather_spmd, mesh=self.mesh,
+                    in_specs=(payload_spec,),
+                    out_specs=P(), check_vma=False,
+                )
+            ),
+            "psum": jax.jit(
+                jax.shard_map(
+                    sum_spmd, mesh=self.mesh, in_specs=(grads_spec,),
+                    out_specs=P(), check_vma=False,
+                )
+            ),
+            "decode": jax.jit(
+                lambda gathered: jax.tree.unflatten(
+                    jax.tree.structure(self.params),
+                    [
+                        self.code.decode_sum(pl, p.shape, p.dtype)
+                        for p, pl in zip(
+                            jax.tree.leaves(self.params),
+                            jax.tree.structure(self.params).flatten_up_to(gathered),
+                        )
+                    ],
+                )
+            ),
+            "update": jax.jit(update_fn_impl),
+        }
+
+    def _payload_struct(self):
+        """Shape-structs of the stacked (leading local-shard axis of 1)
+        per-worker payload pytree, used as shard_map out_specs prefix."""
+        def leaf(p):
+            payload, _ = jax.eval_shape(
+                lambda: self.code.encode(
+                    jnp.zeros(p.shape, p.dtype),
+                    self.code.init_state(p.shape, p.dtype),
+                    jax.random.key(0) if self.code.needs_rng else None,
+                )
+            )
+            return jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct((1,) + s.shape, s.dtype), payload
+            )
+        return jax.tree.map(leaf, self.params)
+
+    def _step_instrumented(self, data, rng, grads=None, loss_fn=None, batch=None):
+        """Staged pipeline with host-side timing (reference schema,
+        ``ps.py:116-148``)."""
+        key = ("instr", loss_fn)
+        if key not in self._compiled:
+            self._compiled[key] = self._build_instrumented_stages(loss_fn)
+        stages = self._compiled[key]
+        timer = time.perf_counter
+        loss = None
+
+        if loss_fn is not None:
+            t0 = timer()
+            loss, grads = stages["grad"](self.params, batch)
+            jax.block_until_ready(grads)
+            data["grad_time"] = timer() - t0
+
+        t0 = timer()
+        payloads, new_codec_state = stages["encode"](grads, self.codec_state, rng)
+        jax.block_until_ready(payloads)
+        data["code_wait"] = timer() - t0          # reference ps.py:138
+
+        if self.code.supports_psum:
+            t0 = timer()
+            summed = stages["psum"](grads)
+            jax.block_until_ready(summed)
+            data["comm_wait"] = timer() - t0      # reference ps.py:162
+        else:
+            t0 = timer()
+            gathered = stages["gather"](payloads)
+            data["isend_time"] = timer() - t0     # dispatch (ps.py:148)
+            jax.block_until_ready(gathered)
+            data["comm_wait"] = timer() - t0
+            t0 = timer()
+            summed = stages["decode"](gathered)
+            jax.block_until_ready(summed)
+            data["decode_time"] = timer() - t0    # reference ps.py:168
+
+        t0 = timer()
+        self.params, self.opt_state = stages["update"](
+            self.params, self.opt_state, summed
+        )
+        jax.block_until_ready(self.params)
+        data["optim_step_time"] = timer() - t0    # reference ps.py:191
+        self.codec_state = new_codec_state
+        return loss
+
     def _build_grad_step(self, loss_fn):
         axis = self.axis_name
 
@@ -287,6 +453,20 @@ class MPI_PS:
         loss = None
         self._rng, rng = jax.random.split(self._rng)
 
+        if self.instrument:
+            if loss_fn is None and grads is None:
+                raise ValueError("pass grads or loss_fn+batch")
+            if loss_fn is not None and batch is None:
+                raise ValueError("loss_fn requires batch")
+            loss = self._step_instrumented(
+                data, rng, grads=grads, loss_fn=loss_fn, batch=batch
+            )
+            if closure is not None:
+                loss = closure()
+            data["step_time"] = time.perf_counter() - t0
+            self._step_count += 1
+            return loss, data
+
         if loss_fn is not None:
             if batch is None:
                 raise ValueError("loss_fn requires batch")
@@ -319,6 +499,72 @@ class MPI_PS:
         data["comm_wait"] = data["step_time"]
         self._step_count += 1
         return loss, data
+
+    def run_steps(
+        self, loss_fn: Callable, batches: PyTree, *, unroll: int = 1
+    ) -> Tuple[jax.Array, Dict[str, float]]:
+        """Run N training steps as ONE fused XLA program (``lax.scan`` over
+        the step pipeline inside shard_map), amortizing per-step host
+        dispatch — the TPU-native answer to the reference's thread-pool
+        overlap: nothing to overlap on the host because the host is out of
+        the loop entirely.
+
+        ``batches``: pytree whose leaves are stacked ``[n_steps,
+        global_batch, ...]``. Returns ``(losses[n_steps], data)``.
+        """
+        axis = self.axis_name
+
+        key = ("scan", loss_fn, unroll)
+        if key not in self._compiled:
+            def spmd(params, opt_state, codec_state, batches, rng):
+                def one_step(carry, batch_and_key):
+                    params, opt_state, codec_state = carry
+                    batch, rng = batch_and_key
+                    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                    loss = lax.pmean(loss, axis)
+                    payloads, codec_state = encode_tree(
+                        self.code, grads, codec_state, rng, axis
+                    )
+                    summed = aggregate(
+                        self.code, grads, payloads, axis, self.average, self.size,
+                        self.comm_dtype,
+                    )
+                    params, opt_state = self._update(params, opt_state, summed)
+                    return (params, opt_state, codec_state), loss
+
+                n_steps = jax.tree.leaves(batches)[0].shape[0]
+                keys = jax.random.split(rng, n_steps)
+                (params, opt_state, codec_state), losses = lax.scan(
+                    one_step, (params, opt_state, codec_state), (batches, keys),
+                    unroll=unroll,
+                )
+                return params, opt_state, codec_state, losses
+
+            state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
+            batch_spec = jax.tree.map(lambda _: P(None, axis), batches)
+            self._compiled[key] = jax.jit(
+                jax.shard_map(
+                    spmd,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(), state_spec, batch_spec, P()),
+                    out_specs=(P(), P(), state_spec, P()),
+                    check_vma=False,
+                )
+            )
+        t0 = time.perf_counter()
+        self._rng, rng = jax.random.split(self._rng)
+        self.params, self.opt_state, self.codec_state, losses = self._compiled[key](
+            self.params, self.opt_state, self.codec_state, batches, rng
+        )
+        jax.block_until_ready(self.params)
+        n_steps = int(jax.tree.leaves(batches)[0].shape[0])
+        self._step_count += n_steps
+        wall = time.perf_counter() - t0
+        return losses, {
+            "step_time": wall / n_steps,
+            "steps_per_sec": n_steps / wall,
+            "n_steps": float(n_steps),
+        }
 
 
 class SGD(MPI_PS):

@@ -31,11 +31,13 @@ class CheckpointManager:
     any pytree (typically ``{'params':…, 'opt_state':…, 'step':…}``).
     """
 
-    def __init__(self, directory: str, use_orbax: bool = True, max_to_keep: int = 3):
+    def __init__(self, directory: str, use_orbax: bool = True, max_to_keep: int = 3,
+                 compress: bool = True):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.use_orbax = use_orbax and _HAVE_ORBAX
         self.max_to_keep = max_to_keep
+        self.compress = compress  # numpy fallback: native wire codec
         if self.use_orbax:
             self._mgr = ocp.CheckpointManager(
                 self.directory,
@@ -47,7 +49,10 @@ class CheckpointManager:
             self._mgr.save(step, args=ocp.args.StandardSave(state))
             self._mgr.wait_until_finished()
         else:
-            save_pytree(os.path.join(self.directory, f"ckpt_{step}.npz"), state)
+            save_pytree(
+                os.path.join(self.directory, f"ckpt_{step}.npz"), state,
+                compress=self.compress,
+            )
             self._gc()
 
     def latest_step(self) -> Optional[int]:

@@ -1,0 +1,64 @@
+"""torch ↔ JAX pytree interop.
+
+The TPU-native analog of the reference's recursive converters ``to_np`` /
+``to_torch`` (``mpi_comms.py:32-58`` — including the Python-3.6-only
+``d.cuda(async=True)`` this replaces, SURVEY §2.3): lets a user of the
+reference bring their ``torch.nn.Module`` parameters into this framework
+(named_parameters → pytree) and read trained values back.
+
+torch is imported lazily — the framework never requires it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def torch_params_to_pytree(named_params: Iterable[Tuple[str, Any]]) -> Dict[str, jax.Array]:
+    """``model.named_parameters()`` → flat {name: jnp array} pytree (the
+    reference's constructor input shape, ``ps.py:54-63``)."""
+    out = {}
+    for name, p in named_params:
+        out[name] = jnp.asarray(p.detach().cpu().numpy())
+    return out
+
+
+def pytree_to_torch_params(tree: Dict[str, jax.Array], model: Any) -> None:
+    """Write a {name: array} pytree back into a torch module's parameters
+    in place (the read-back direction of ``to_torch``,
+    ``mpi_comms.py:46-58``)."""
+    import torch
+
+    named = dict(model.named_parameters())
+    missing = set(tree) - set(named)
+    if missing:
+        raise KeyError(f"params not in model: {sorted(missing)}")
+    with torch.no_grad():
+        for name, arr in tree.items():
+            named[name].copy_(torch.from_numpy(np.asarray(arr)))
+
+
+def to_np(tree: PyTree) -> PyTree:
+    """Recursive to-numpy over dict/list pytrees (``mpi_comms.py:32-43``),
+    torch tensors included."""
+    def leaf(x):
+        if hasattr(x, "detach"):
+            return x.detach().cpu().numpy()
+        return np.asarray(x)
+    return jax.tree.map(leaf, tree)
+
+
+def to_jnp(tree: PyTree, dtype=None) -> PyTree:
+    """Recursive to-jax (``to_torch``'s mirror, ``mpi_comms.py:46-58``)."""
+    def leaf(x):
+        if hasattr(x, "detach"):
+            x = x.detach().cpu().numpy()
+        arr = jnp.asarray(x)
+        return arr.astype(dtype) if dtype is not None else arr
+    return jax.tree.map(leaf, tree)

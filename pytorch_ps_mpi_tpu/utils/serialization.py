@@ -55,24 +55,52 @@ def unpack_pytree(buf: bytes, spec: str, treedef=None, template: PyTree = None):
     return jax.tree.unflatten(treedef, leaves)
 
 
-def save_pytree(path: str, tree: PyTree) -> None:
-    """Write a pytree to ``path`` (.npz + spec sidecar in one file)."""
+def save_pytree(path: str, tree: PyTree, compress: bool = False) -> None:
+    """Write a pytree to ``path``. ``compress=True`` runs each leaf's bytes
+    through the native wire codec (shuffle+RLE0+CRC, ``utils/native.py``) —
+    the in-repo replacement for the reference's pickle+blosc checkpoint-ish
+    path (``mpi_comms.py:186-193``)."""
     leaves, treedef = jax.tree.flatten(tree)
+    if compress:
+        from pytorch_ps_mpi_tpu.utils import native
+
+        arrays = {}
+        for i, x in enumerate(leaves):
+            arr = np.asarray(x)
+            blob = native.compress(arr.tobytes(), elem_size=arr.dtype.itemsize)
+            arrays[f"leaf_{i}"] = np.frombuffer(blob, np.uint8)
+        arrays["__compressed__"] = np.ones(1, np.uint8)
+    else:
+        arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
     np.savez(
         path,
         __treedef__=np.frombuffer(str(treedef).encode(), dtype=np.uint8),
-        **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)},
+        **arrays,
     )
 
 
 def load_pytree(path: str, template: PyTree) -> PyTree:
     """Read arrays saved by :func:`save_pytree` into ``template``'s
-    structure."""
+    structure (transparently decompressing if saved with
+    ``compress=True``)."""
+    tmpl_leaves, treedef = jax.tree.flatten(template)
     with np.load(path) as data:
-        leaves = [data[f"leaf_{i}"] for i in range(len(data.files) - 1)]
-    treedef = jax.tree.structure(template)
-    if treedef.num_leaves != len(leaves):
-        raise ValueError(
-            f"template has {treedef.num_leaves} leaves, file has {len(leaves)}"
-        )
+        compressed = "__compressed__" in data.files
+        n_meta = 2 if compressed else 1
+        n = len(data.files) - n_meta
+        if treedef.num_leaves != n:
+            raise ValueError(
+                f"template has {treedef.num_leaves} leaves, file has {n}"
+            )
+        if compressed:
+            from pytorch_ps_mpi_tpu.utils import native
+
+            leaves = []
+            for i, t in enumerate(tmpl_leaves):
+                raw = native.decompress(data[f"leaf_{i}"].tobytes())
+                leaves.append(
+                    np.frombuffer(raw, np.dtype(t.dtype)).reshape(np.shape(t))
+                )
+        else:
+            leaves = [data[f"leaf_{i}"] for i in range(n)]
     return jax.tree.unflatten(treedef, leaves)

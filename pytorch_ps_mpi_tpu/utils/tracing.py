@@ -1,0 +1,41 @@
+"""Profiler integration: the deep-dive layer above the per-step metrics
+dicts (SURVEY §5.1's disposition: keep the reference's returned-timings
+contract and add ``jax.profiler`` traces for what host clocks can't see
+inside a fused XLA program)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace viewable in TensorBoard/Perfetto:
+
+    >>> with trace('/tmp/jax-trace'):
+    ...     opt.step(loss_fn=loss_fn, batch=batch)
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside a trace (shows up on the timeline)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def device_memory_stats() -> Optional[dict]:
+    """Per-device HBM stats where the backend exposes them."""
+    try:
+        dev = jax.devices()[0]
+        return dev.memory_stats()
+    except Exception:
+        return None

@@ -162,3 +162,86 @@ def test_constructor_validation(mesh8):
         MPI_PS(make_params(), mode="nope", mesh=mesh8)
     with pytest.raises(ValueError):
         SGD(make_params(), mesh=mesh8).step()
+
+
+def test_instrumented_step_fills_schema(mesh8):
+    """instrument=True must produce real per-stage wall times for the
+    reference's timing keys (ps.py:116-148) and the same numerics."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    fused = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9)
+    instr = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9, instrument=True)
+    l1, _ = fused.step(loss_fn=quad_loss, batch=batch)
+    l2, d = instr.step(loss_fn=quad_loss, batch=batch)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        fused.params, instr.params,
+    )
+    assert d["comm_wait"] > 0 and d["optim_step_time"] > 0 and d["grad_time"] > 0
+
+
+def test_instrumented_step_with_codec(mesh8):
+    params = make_params()
+    batch = batch_for(mesh8)
+    opt = SGD(params, mesh=mesh8, lr=0.01, instrument=True,
+              code=get_codec("topk", fraction=0.5))
+    first, d = opt.step(loss_fn=quad_loss, batch=batch)
+    assert d["code_wait"] > 0 and d["decode_time"] > 0 and d["comm_wait"] > 0
+    for _ in range(10):
+        last, _ = opt.step(loss_fn=quad_loss, batch=batch)
+    assert float(last) < float(first)
+
+
+def test_run_steps_fused_scan_matches_loop(mesh8):
+    """N steps under one lax.scan == N individual step() calls."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    n = 5
+    batches = (
+        jnp.broadcast_to(batch[0][None], (n,) + batch[0].shape),
+        jnp.broadcast_to(batch[1][None], (n,) + batch[1].shape),
+    )
+    a = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9)
+    losses, data = a.run_steps(quad_loss, batches)
+    assert losses.shape == (n,) and data["n_steps"] == n
+
+    b = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9)
+    loop_losses = [float(b.step(loss_fn=quad_loss, batch=batch)[0]) for _ in range(n)]
+    np.testing.assert_allclose(np.asarray(losses), loop_losses, rtol=1e-5)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6
+        ),
+        a.params, b.params,
+    )
+
+
+def test_powersgd_distributed_training(mesh8):
+    params = make_params()
+    batch = batch_for(mesh8)
+    opt = SGD(params, mesh=mesh8, lr=0.002,
+              code=get_codec("powersgd", rank=2, min_compression_elems=4))
+    first, _ = opt.step(loss_fn=quad_loss, batch=batch)
+    for _ in range(25):
+        last, _ = opt.step(loss_fn=quad_loss, batch=batch)
+    assert float(last) < float(first)
+
+
+def test_instrumented_leader_mode_matches_fused(mesh8):
+    """The instrumented update stage must include leader mode's broadcast
+    (regression: it used to skip it)."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    fused = SGD(params, mesh=mesh8, lr=0.05, mode="leader")
+    instr = SGD(params, mesh=mesh8, lr=0.05, mode="leader", instrument=True)
+    fused.step(loss_fn=quad_loss, batch=batch)
+    instr.step(loss_fn=quad_loss, batch=batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        fused.params, instr.params,
+    )

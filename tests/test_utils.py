@@ -86,3 +86,22 @@ def test_step_timer_and_accumulator():
     acc.add({"a": 3.0})
     m = acc.mean()
     assert m["a"] == 2.0 and m["b"] == 2.0 and len(acc) == 2
+
+
+def test_save_load_compressed_roundtrip(tmp_path):
+    t = tree()
+    path = str(tmp_path / "state_c.npz")
+    save_pytree(path, t, compress=True)
+    out = load_pytree(path, t)
+    assert_tree_equal(t, out)
+
+
+def test_compressed_checkpoint_smaller_for_sparse(tmp_path):
+    sparse = {"w": jnp.zeros((64, 64)).at[0, 0].set(1.0)}
+    p1 = str(tmp_path / "raw.npz")
+    p2 = str(tmp_path / "comp.npz")
+    save_pytree(p1, sparse, compress=False)
+    save_pytree(p2, sparse, compress=True)
+    import os
+    assert os.path.getsize(p2) < os.path.getsize(p1) / 4
+    assert_tree_equal(load_pytree(p2, sparse), sparse)
