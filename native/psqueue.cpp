@@ -213,6 +213,15 @@ int psq_push_grad(void* hv, uint32_t worker, const uint8_t* buf, uint64_t len,
   return 1;
 }
 
+// Anyone: is worker w's mailbox currently FULL (pushed, unconsumed)?
+// Lets liveness checks distinguish "server hasn't polled" from "worker
+// hasn't pushed".
+int psq_grad_pending(void* hv, uint32_t worker) {
+  Handle* h = (Handle*)hv;
+  if (worker >= hdr(h)->n_workers) return -1;
+  return slot(h, worker)->state.load(std::memory_order_acquire) == FULL ? 1 : 0;
+}
+
 // Server: take one FULL gradient, scanning round-robin from *cursor.
 // Returns byte length (>0) and fills worker/version; 0 if none pending.
 int64_t psq_pop_grad(void* hv, uint8_t* buf, uint64_t cap,

@@ -61,6 +61,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                  ctypes.POINTER(ctypes.c_uint32),
                                  ctypes.POINTER(ctypes.c_uint64),
                                  ctypes.POINTER(ctypes.c_uint32)]
+    lib.psq_grad_pending.restype = ctypes.c_int
+    lib.psq_grad_pending.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     _lib = lib
     return _lib
 
@@ -116,6 +118,10 @@ class ShmPSServer:
         self._grad_buf = np.empty(_flat_size(template), np.float32)
         self.stale_drops = 0
         self.staleness_seen: Dict[int, int] = {}
+        # failure/straggler detection (absent in the reference, SURVEY
+        # §5.3: MPI aborted the whole job; here the server observes)
+        self.last_seen: Dict[int, float] = {}
+        self._t0 = time.time()
 
     def publish(self, params: PyTree) -> None:
         flat = _flatten(params)
@@ -143,11 +149,31 @@ class ShmPSServer:
             return None
         staleness = self.version - int(version.value)
         self.staleness_seen[staleness] = self.staleness_seen.get(staleness, 0) + 1
+        self.last_seen[int(worker.value)] = time.time()
         if staleness > self.max_staleness:
             self.stale_drops += 1
             return self.poll_grad()
         flat = self._grad_buf[: n // 4].copy()
         return int(worker.value), int(version.value), _unflatten(flat, self.template)
+
+    def stragglers(self, timeout: float) -> Dict[int, float]:
+        """Workers with no sign of life for ``timeout`` seconds: no
+        gradient consumed from them recently AND nothing pending in their
+        mailbox (a pushed-but-unpolled gradient counts as alive, so server
+        polling pauses don't misreport healthy workers). Never-seen
+        workers age from server start. The failure-detection surface the
+        reference lacked (its MPI default killed the whole job on any rank
+        failure, SURVEY §5.3); the async protocol tolerates stragglers by
+        design — this makes them observable."""
+        now = time.time()
+        out = {}
+        for w in range(self.num_workers):
+            if self._lib.psq_grad_pending(self._h, w) == 1:
+                continue  # pushed, awaiting consumption: alive
+            age = now - self.last_seen.get(w, self._t0)
+            if age > timeout:
+                out[w] = age
+        return out
 
     def close(self):
         if self._h:

@@ -183,6 +183,7 @@ class MPI_PS:
         self.size = int(self.mesh.shape[axis_name])  # reference ps.py:73
         self._rng = jax.random.key(seed)
         self.codec_state = self._init_codec_state()
+        self.aux_state = None  # mutable model state (e.g. BN batch_stats)
         self._compiled: Dict[Any, Callable] = {}
         self._step_count = 0
 
@@ -367,24 +368,41 @@ class MPI_PS:
         self.codec_state = new_codec_state
         return loss
 
-    def _build_grad_step(self, loss_fn):
+    def _build_grad_step(self, loss_fn, has_aux: bool = False):
+        """Fused grad→encode→collective→decode→update step.
+
+        With ``has_aux``, ``loss_fn(params, aux_state, batch) -> (loss,
+        new_aux_state)`` supports mutable-state models (flax
+        ``batch_stats``): each step's per-worker aux is cross-replica
+        averaged with ``pmean``. Note this averages the *running* stats
+        across replicas — normalization inside the forward pass still uses
+        per-replica batch statistics (plain per-device BN, not full
+        SyncBatchNorm semantics)."""
         axis = self.axis_name
 
-        def spmd(params, opt_state, codec_state, batch, rng):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        def spmd(params, opt_state, codec_state, batch, rng, *maybe_aux):
+            if has_aux:
+                (loss, new_aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, maybe_aux[0], batch)
+                new_aux = jax.tree.map(lambda x: lax.pmean(x, axis), new_aux)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                new_aux = ()
             loss = lax.pmean(loss, axis)
             payloads, new_codec_state = self._encode_tree(grads, codec_state, rng)
             summed = self._aggregate(grads, payloads)
             new_params, new_opt_state = self._update(params, opt_state, summed)
-            return new_params, new_opt_state, new_codec_state, loss
+            return new_params, new_opt_state, new_codec_state, loss, new_aux
 
         state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
+        in_specs = (P(), P(), state_spec, P(axis), P()) + ((P(),) if has_aux else ())
         return jax.jit(
             jax.shard_map(
                 spmd,
                 mesh=self.mesh,
-                in_specs=(P(), P(), state_spec, P(axis), P()),
-                out_specs=(P(), P(), state_spec, P()),
+                in_specs=in_specs,
+                out_specs=(P(), P(), state_spec, P(), P()),
                 check_vma=False,
             )
         )
@@ -421,6 +439,7 @@ class MPI_PS:
         *,
         loss_fn: Optional[Callable] = None,
         batch: Optional[PyTree] = None,
+        aux_state: Optional[PyTree] = None,
         closure: Optional[Callable] = None,
     ) -> Tuple[Optional[jax.Array], Dict[str, float]]:
         """Run one distributed step; returns ``(loss, data)`` exactly like
@@ -458,6 +477,10 @@ class MPI_PS:
                 raise ValueError("pass grads or loss_fn+batch")
             if loss_fn is not None and batch is None:
                 raise ValueError("loss_fn requires batch")
+            if aux_state is not None:
+                raise NotImplementedError(
+                    "instrument=True does not support aux_state models yet"
+                )
             loss = self._step_instrumented(
                 data, rng, grads=grads, loss_fn=loss_fn, batch=batch
             )
@@ -470,14 +493,23 @@ class MPI_PS:
         if loss_fn is not None:
             if batch is None:
                 raise ValueError("loss_fn requires batch")
-            key = ("grad", loss_fn)
+            has_aux = aux_state is not None
+            key = ("grad", loss_fn, has_aux)
             if key not in self._compiled:
-                self._compiled[key] = self._build_grad_step(loss_fn)
+                self._compiled[key] = self._build_grad_step(loss_fn, has_aux)
             fn = self._compiled[key]
-            self.params, self.opt_state, self.codec_state, loss = fn(
-                self.params, self.opt_state, self.codec_state, batch, rng
+            extra = (aux_state,) if has_aux else ()
+            (self.params, self.opt_state, self.codec_state, loss, new_aux) = fn(
+                self.params, self.opt_state, self.codec_state, batch, rng, *extra
             )
+            if has_aux:
+                self.aux_state = new_aux
         elif grads is not None:
+            if aux_state is not None:
+                raise NotImplementedError(
+                    "aux_state requires the loss_fn path (grads-only steps "
+                    "have no forward pass to produce new aux state)"
+                )
             key = ("grads-only",)
             if key not in self._compiled:
                 self._compiled[key] = self._build_grads_only_step()

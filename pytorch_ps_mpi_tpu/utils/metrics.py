@@ -35,6 +35,23 @@ class StepTimer:
             self.data[name] = self.data.get(name, 0.0) + time.perf_counter() - t0
 
 
+def print_summary(obj, _depth: int = 0) -> str:
+    """One-line human summary of a nested dict/list, arrays shown as
+    shapes — the reference's debug printer (``mpi_comms.py:176-184``)."""
+    if isinstance(obj, dict):
+        inner = ", ".join(f"{k}: {print_summary(v, _depth + 1)}" for k, v in obj.items())
+        out = "{" + inner + "}"
+    elif isinstance(obj, (list, tuple)):
+        out = "[" + ", ".join(print_summary(v, _depth + 1) for v in obj) + "]"
+    elif hasattr(obj, "shape") and getattr(obj, "ndim", 0) > 0:
+        out = f"array{tuple(obj.shape)}"
+    else:
+        out = repr(obj)
+    if _depth == 0:
+        print(out)
+    return out
+
+
 class MetricsAccumulator:
     """Collects per-step dicts; reports means (the host-side analog of the
     reference's ``data`` list the caller was expected to keep)."""

@@ -121,3 +121,47 @@ def test_staleness_bound_drops_old_grads():
 def test_worker_open_timeout():
     with pytest.raises(TimeoutError):
         dcn.ShmPSWorker("/psq_does_not_exist", 0, TEMPLATE, timeout=0.3)
+
+
+def test_straggler_detection():
+    name = f"/psq_test_{os.getpid()}_h"
+    server = dcn.ShmPSServer(name, num_workers=3, template=TEMPLATE)
+    try:
+        w = dcn.ShmPSWorker(name, 0, TEMPLATE)
+        server.publish({"w": TEMPLATE["w"].copy()})
+        _, v = w.read_params()
+        w.push_grad({"w": np.ones(6, np.float32)}, v)
+        assert server.poll_grad() is not None
+        time.sleep(0.15)
+        lag = server.stragglers(timeout=0.1)
+        # workers 1 and 2 never reported; worker 0 is fresh enough... but
+        # 0.15s > 0.1s, so all three exceed the window except none pushed
+        # within it: 0 pushed 0.15s ago -> also straggling
+        assert set(lag) == {0, 1, 2}
+        lag2 = server.stragglers(timeout=10.0)
+        assert lag2 == {}
+        w.close()
+    finally:
+        server.close()
+
+
+def test_pending_grad_counts_as_alive():
+    """A pushed-but-unpolled gradient must not be reported as straggling
+    (regression: server polling pauses used to misreport workers)."""
+    name = f"/psq_test_{os.getpid()}_p2"
+    server = dcn.ShmPSServer(name, num_workers=1, template=TEMPLATE)
+    try:
+        w = dcn.ShmPSWorker(name, 0, TEMPLATE)
+        server.publish({"w": TEMPLATE["w"].copy()})
+        _, v = w.read_params()
+        w.push_grad({"w": np.ones(6, np.float32)}, v)
+        time.sleep(0.12)
+        # mailbox FULL -> alive even though nothing was ever polled
+        assert server.stragglers(timeout=0.05) == {}
+        assert server.poll_grad() is not None
+        time.sleep(0.12)
+        # now consumed long ago and nothing pending -> straggler
+        assert 0 in server.stragglers(timeout=0.05)
+        w.close()
+    finally:
+        server.close()

@@ -112,3 +112,35 @@ def test_bert_ring_attention_matches_full():
         )
     )(params, tokens)
     np.testing.assert_allclose(np.asarray(ring), np.asarray(ref), rtol=3e-4, atol=3e-4)
+
+
+def test_resnet_batchnorm_aux_state_distributed(mesh8):
+    """norm='batch' ResNet trains through the aux-state path with
+    cross-replica synced batch_stats (torch needed SyncBatchNorm)."""
+    from pytorch_ps_mpi_tpu.models import ResNet18
+
+    model = ResNet18(num_classes=10, small_inputs=True, num_filters=8,
+                     norm="batch")
+    x0, y0 = next(synthetic_images("cifar10", batch=16))
+    variables = model.init(jax.random.key(0), x0)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(p, aux, batch):
+        x, y = batch
+        logits, updates = model.apply(
+            {"params": p, "batch_stats": aux}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        return cross_entropy_loss(logits, y), updates["batch_stats"]
+
+    opt = SGD(params, mesh=mesh8, lr=0.01, average=True)
+    first, _ = opt.step(loss_fn=loss_fn, batch=(x0, y0), aux_state=batch_stats)
+    assert opt.aux_state is not None
+    # running stats must have moved off their init
+    mean0 = jax.tree.leaves(batch_stats)[0]
+    mean1 = jax.tree.leaves(opt.aux_state)[0]
+    assert float(jnp.abs(mean1 - mean0).sum()) > 0
+    for _ in range(3):
+        last, _ = opt.step(loss_fn=loss_fn, batch=(x0, y0),
+                           aux_state=opt.aux_state)
+    assert np.isfinite(float(last))

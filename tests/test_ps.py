@@ -245,3 +245,10 @@ def test_instrumented_leader_mode_matches_fused(mesh8):
         ),
         fused.params, instr.params,
     )
+
+
+def test_grads_only_with_aux_state_rejected(mesh8):
+    opt = SGD(make_params(), mesh=mesh8, lr=0.1)
+    grads = jax.tree.map(lambda p: jnp.ones((8,) + p.shape), make_params())
+    with pytest.raises(NotImplementedError):
+        opt.step(grads=grads, aux_state={"x": jnp.zeros(1)})

@@ -105,3 +105,11 @@ def test_compressed_checkpoint_smaller_for_sparse(tmp_path):
     import os
     assert os.path.getsize(p2) < os.path.getsize(p1) / 4
     assert_tree_equal(load_pytree(p2, sparse), sparse)
+
+
+def test_print_summary(capsys):
+    from pytorch_ps_mpi_tpu.utils.metrics import print_summary
+
+    print_summary({"a": jnp.zeros((3, 4)), "b": [1, jnp.ones(2)], "c": "x"})
+    out = capsys.readouterr().out
+    assert "array(3, 4)" in out and "'x'" in out
