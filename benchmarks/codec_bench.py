@@ -1,0 +1,78 @@
+"""Per-codec encode/decode latency + wire size on the current backend.
+
+The compression-curve evidence the reference's codings research surface
+existed to produce (SURVEY §2.2): for a ResNet-18-sized flat gradient,
+each codec's on-device encode+decode time and bytes on the wire.
+
+Run: ``python benchmarks/codec_bench.py [n_elems]``.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_ps_mpi_tpu.codecs import get_codec
+
+CODECS = [
+    ("identity", {}),
+    ("int8", {}),
+    ("qsgd", {"levels": 16}),
+    ("sign", {}),
+    ("topk", {"fraction": 0.01}),
+    ("randomk", {"fraction": 0.01}),
+    ("powersgd", {"rank": 4}),
+]
+
+
+def bench_codec(name, kw, n, reps=20):
+    code = get_codec(name, **kw)
+    # powersgd wants a matrix view; give every codec the same 2-D shape
+    shape = (n // 1024, 1024)
+    g = jax.random.normal(jax.random.key(0), shape)
+    state = code.init_state(shape, g.dtype)
+    rng = jax.random.key(1) if code.needs_rng else None
+
+    enc = jax.jit(lambda g, s: code.encode(g, s, rng))
+    payload, _ = enc(g, state)
+    dec = jax.jit(lambda p: code.decode(p, shape, g.dtype))
+    out = dec(payload)
+    jax.block_until_ready(out)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        payload, _ = enc(g, state)
+    jax.block_until_ready(payload)
+    t_enc = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = dec(payload)
+    jax.block_until_ready(out)
+    t_dec = (time.perf_counter() - t0) / reps
+
+    bits = code.payload_bits(shape, jnp.float32)
+    return t_enc, t_dec, bits / 8
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 23  # ~8M ≈ ResNet18
+    raw_bytes = n * 4
+    print(f"backend={jax.default_backend()} n={n} raw={raw_bytes/1e6:.1f} MB")
+    print("| codec | encode ms | decode ms | wire MB | ratio |")
+    print("|---|---|---|---|---|")
+    for name, kw in CODECS:
+        t_enc, t_dec, wire = bench_codec(name, kw, n)
+        print(
+            f"| {name} | {t_enc*1e3:.2f} | {t_dec*1e3:.2f} "
+            f"| {wire/1e6:.2f} | {raw_bytes/wire:.1f}x |"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Scaling-efficiency benchmark: steps/sec vs worker count on a virtual
+device mesh — the BASELINE.json "scaling efficiency" metric, measurable
+without a pod by forcing N CPU host devices (the same mechanism the test
+suite uses; on a real pod the identical code runs over ICI).
+
+Run: ``python benchmarks/scaling_bench.py`` (forces CPU; do not use for
+absolute numbers, only for the collective/step-structure scaling shape).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import time
+
+import jax.numpy as jnp
+
+from pytorch_ps_mpi_tpu import SGD
+from pytorch_ps_mpi_tpu.mesh import make_mesh
+from pytorch_ps_mpi_tpu.models import MLP
+from pytorch_ps_mpi_tpu.data import cross_entropy_loss, synthetic_images
+
+
+def run(world: int, steps: int = 30, per_worker_batch: int = 32):
+    mesh = make_mesh(devices=jax.devices()[:world])
+    model = MLP(features=(256, 10))
+    data = synthetic_images("mnist", batch=per_worker_batch * world)
+    x0, y0 = next(data)
+    params = model.init(jax.random.key(0), x0)
+
+    def loss_fn(p, b):
+        x, y = b
+        return cross_entropy_loss(model.apply(p, x), y)
+
+    opt = SGD(params, mesh=mesh, lr=0.05, average=True)
+    opt.step(loss_fn=loss_fn, batch=(x0, y0))  # compile
+    t0 = time.perf_counter()
+    for _, b in zip(range(steps), data):
+        opt.step(loss_fn=loss_fn, batch=b)
+    wall = time.perf_counter() - t0
+    return steps / wall
+
+
+def main():
+    base = None
+    print("| workers | steps/s | weak-scaling efficiency |")
+    print("|---|---|---|")
+    for world in [1, 2, 4, 8]:
+        sps = run(world)
+        if base is None:
+            base = sps
+        # weak scaling: per-worker batch fixed, ideal = flat steps/s
+        print(f"| {world} | {sps:.1f} | {100 * sps / base:.0f}% |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""Serialization microbenchmark — the rebuild of the reference's
+``Serialization-timing.ipynb`` (pickle vs msgpack dump/load + zlib levels
+over array sizes, 100 repeats): here pickle vs this framework's typed
+pytree pack (``utils/serialization.py``) vs the native wire codec
+(``utils/native.py``), over the same n ∈ logspace sweep.
+
+Prints a markdown table; run: ``python benchmarks/serialization_bench.py``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+from pytorch_ps_mpi_tpu.utils import native
+from pytorch_ps_mpi_tpu.utils.serialization import pack_pytree, unpack_pytree
+
+REPEATS = 100
+
+
+def timeit(fn, repeats=REPEATS):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        fn()
+    return (time.perf_counter() - t0) / repeats
+
+
+def main():
+    print("| n | pickle dump | pack_pytree | wirecodec compress | pickle B | packed B | compressed B |")
+    print("|---|---|---|---|---|---|---|")
+    for n in [10, 100, 1000, 10_000, 100_000]:
+        rng = np.random.RandomState(0)
+        arr = (rng.randn(n) * 1e-3).astype(np.float32)
+        tree = {"grad": arr}
+
+        t_pickle = timeit(lambda: pickle.dumps(arr))
+        t_pack = timeit(lambda: pack_pytree(tree))
+        buf, spec = pack_pytree(tree)
+        t_comp = timeit(lambda: native.compress(buf, elem_size=4))
+
+        pickled = pickle.dumps(arr)
+        blob = native.compress(buf, elem_size=4)
+        # round-trip checks
+        assert np.array_equal(
+            unpack_pytree(buf, spec, template=tree)["grad"], arr
+        )
+        assert native.decompress(blob) == buf
+        print(
+            f"| {n} | {t_pickle*1e6:.1f} µs | {t_pack*1e6:.1f} µs "
+            f"| {t_comp*1e6:.1f} µs | {len(pickled)} | {len(buf)} | {len(blob)} |"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,15 +20,34 @@ def _packed_len(n: int) -> int:
 
 @register_codec("sign")
 class SignCodec(Codec):
+    """``use_pallas=True`` routes sizes divisible by 1024 through the
+    fused VMEM pack/unpack kernels (``ops/sign_pallas.py``). NOTE: the
+    Pallas bit layout groups by sublane (bit s of packed byte [r, lane]
+    holds element r*1024 + s*128 + lane) while the jnp path groups 8
+    consecutive elements per byte — payloads are only self-consistent
+    within one codec configuration, which is all the aggregation pipeline
+    needs (every worker runs the same codec)."""
+
+    def __init__(self, use_pallas: bool = True):
+        self.use_pallas = use_pallas
+
+    def _pallas_ok(self, n: int) -> bool:
+        return self.use_pallas and n > 0 and n % 1024 == 0
+
     def encode(self, grad, state=(), rng=None):
         flat = grad.reshape(-1)
         n = flat.shape[0]
         scale = jnp.mean(jnp.abs(flat))
-        bits = (flat >= 0).astype(jnp.uint8)
-        pad = _packed_len(n) * 8 - n
-        bits = jnp.pad(bits, (0, pad)).reshape(-1, 8)
-        weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
-        packed = (bits * weights).sum(axis=1).astype(jnp.uint8)
+        if self._pallas_ok(n):
+            from pytorch_ps_mpi_tpu.ops.sign_pallas import pack_signs
+
+            packed = pack_signs(flat.astype(jnp.float32))
+        else:
+            bits = (flat >= 0).astype(jnp.uint8)
+            pad = _packed_len(n) * 8 - n
+            bits = jnp.pad(bits, (0, pad)).reshape(-1, 8)
+            weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+            packed = (bits * weights).sum(axis=1).astype(jnp.uint8)
         return {"packed": packed, "scale": scale.astype(jnp.float32)}, state
 
     def _unpack(self, packed, n):
@@ -38,6 +57,12 @@ class SignCodec(Codec):
 
     def decode(self, payload, shape, dtype):
         n = int(np.prod(shape)) if shape else 1
+        if self._pallas_ok(n):
+            from pytorch_ps_mpi_tpu.ops.sign_pallas import unpack_signs
+
+            signs = unpack_signs(payload["packed"])
+            g = (signs * payload["scale"]).astype(dtype)
+            return g.reshape(shape)
         signs = self._unpack(payload["packed"], n)
         g = jnp.where(signs, payload["scale"], -payload["scale"]).astype(dtype)
         return g.reshape(shape)

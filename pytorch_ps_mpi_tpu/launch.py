@@ -1,0 +1,57 @@
+"""Multi-host launcher: ``python -m pytorch_ps_mpi_tpu.launch script.py``.
+
+The SPMD bootstrap the reference got from ``mpirun -n 2`` (reference
+``Makefile:2-3``): every host runs the same script; this module wires
+``jax.distributed.initialize`` from flags/env before handing control to
+the user script, so rank topology is explicit instead of ambient
+(reference ``mpi_comms.py:11-13``).
+
+On TPU pods the runtime usually autodetects everything and a bare
+``python script.py`` per host suffices; flags are for CPU/GPU clusters or
+explicit control:
+
+  python -m pytorch_ps_mpi_tpu.launch \
+      --coordinator host0:1234 --num-processes 2 --process-id 0 train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--coordinator", default=os.environ.get("PS_COORDINATOR"),
+                    help="host:port of process 0")
+    ap.add_argument("--num-processes", type=int,
+                    default=int(os.environ.get("PS_NUM_PROCESSES", "0")) or None)
+    ap.add_argument("--process-id", type=int,
+                    default=int(os.environ.get("PS_PROCESS_ID", "-1")))
+    ap.add_argument("script", help="user training script (runs as __main__)")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    if args.coordinator is None and (
+        args.num_processes is not None or args.process_id >= 0
+    ):
+        ap.error(
+            "--num-processes/--process-id given without --coordinator "
+            "(or PS_COORDINATOR): the job would silently run single-process"
+        )
+
+    from pytorch_ps_mpi_tpu.mesh import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id if args.process_id >= 0 else None,
+    )
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

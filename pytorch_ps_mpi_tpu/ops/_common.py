@@ -1,0 +1,13 @@
+"""Shared constants/predicates for the Pallas kernel family."""
+
+from __future__ import annotations
+
+import jax
+
+LANE = 128      # TPU lane width (last-dim tile)
+SUBLANE = 8     # float32 sublane tile
+
+
+def interpret() -> bool:
+    """Run kernels in Pallas interpret mode off-TPU (CPU test meshes)."""
+    return jax.default_backend() != "tpu"

@@ -2,11 +2,16 @@
 
 Replaces the reference's host-side blosc compress/decompress round-trip
 (``mpi_comms.py:18-30``): the gradient never leaves the chip — abs-max
-reduction, scale, round, clip and narrow all happen in VMEM in one pass.
+reduction, scale, round, clip and narrow all happen in VMEM.
+
+Two gridded passes so arbitrarily large gradients stream through VMEM
+(a single-block version OOMs scoped VMEM beyond ~4M floats):
+pass 1 reduces the global abs-max tile by tile into SMEM; pass 2 applies
+the scalar scale per tile. TPU grids execute sequentially per core, so
+the pass-1 accumulator is race-free.
 
 On non-TPU backends (the 8-device CPU test mesh) the kernels run in
-Pallas interpret mode; tiny shapes fall back to plain jnp to dodge
-tiling-constraint edge cases.
+Pallas interpret mode; tiny/ragged shapes fall back to plain jnp.
 """
 
 from __future__ import annotations
@@ -16,13 +21,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
-_LANE = 128
-_SUBLANE = 8
-_TILE = _LANE * _SUBLANE  # min float32 tile, flattened
+from pytorch_ps_mpi_tpu.ops._common import LANE as _LANE, SUBLANE as _SUBLANE
+from pytorch_ps_mpi_tpu.ops._common import interpret as _interpret
 
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+_TILE = _LANE * _SUBLANE   # min float32 tile, flattened
+_BLOCK_ROWS = 1024         # 1024×128 f32 = 512 KiB per tile
 
 
 def _quantize_jnp(flat: jax.Array):
@@ -31,13 +34,25 @@ def _quantize_jnp(flat: jax.Array):
     return q, scale.astype(jnp.float32)
 
 
-def _quant_kernel(x_ref, q_ref, scale_ref):
-    from jax.experimental import pallas as pl  # noqa: F401
+def _absmax_kernel(x_ref, out_ref):
+    from jax.experimental import pallas as pl
 
-    x = x_ref[:]
-    scale = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-12)
-    q_ref[:] = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-    scale_ref[0, 0] = scale.astype(jnp.float32)
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[0, 0] = 0.0
+
+    blk = jnp.max(jnp.abs(x_ref[:]))
+    out_ref[0, 0] = jnp.maximum(out_ref[0, 0], blk)
+
+
+def _quant_kernel(x_ref, scale_ref, q_ref):
+    # scale is computed once on the host from the absmax pass; the kernel
+    # only applies it, so quantize and dequantize can never drift
+    q_ref[:] = jnp.clip(
+        jnp.round(x_ref[:] / scale_ref[0, 0]), -127, 127
+    ).astype(jnp.int8)
 
 
 @functools.partial(jax.jit, static_argnames=())
@@ -51,21 +66,47 @@ def quantize_int8(flat: jax.Array):
         # Irregular sizes: XLA's fused jnp path is already near-optimal.
         return _quantize_jnp(flat)
 
-    x2d = flat.reshape(n // _LANE, _LANE)
-    q, scale = pl.pallas_call(
-        _quant_kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct(x2d.shape, jnp.int8),
-            jax.ShapeDtypeStruct((1, 1), jnp.float32),
-        ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ),
+    rows = n // _LANE  # multiple of _SUBLANE since n % _TILE == 0
+    x2d = flat.reshape(rows, _LANE)
+    # shrink the block for small inputs so a 1024-element gradient isn't
+    # padded 128x; for large unaligned inputs pad rows to a block multiple
+    # with zeros — the absmax reduction must not see the undefined values
+    # Mosaic pads ragged trailing blocks with (zeros are absmax-neutral);
+    # the padded tail of q is sliced off on the host below.
+    block_rows = min(_BLOCK_ROWS, rows)
+    pad_rows = (-rows) % block_rows
+    if pad_rows:
+        x2d = jnp.pad(x2d, ((0, pad_rows), (0, 0)))
+    padded_rows = rows + pad_rows
+    grid = (padded_rows // block_rows,)
+
+    absmax = pl.pallas_call(
+        _absmax_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         interpret=_interpret(),
     )(x2d)
-    return q.reshape(n), scale[0, 0]
+    scale = jnp.maximum(absmax[0, 0] / 127.0, 1e-12)
+
+    q = pl.pallas_call(
+        _quant_kernel,
+        out_shape=jax.ShapeDtypeStruct((padded_rows, _LANE), jnp.int8),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(x2d, scale.reshape(1, 1))
+    return q[:rows].reshape(n), scale
 
 
 def _dequant_kernel(q_ref, scale_ref, out_ref):
@@ -81,15 +122,21 @@ def dequantize_int8(q: jax.Array, scale: jax.Array):
     if n % _TILE != 0 or n == 0:
         return q.astype(jnp.float32) * scale
 
-    q2d = q.reshape(n // _LANE, _LANE)
+    rows = n // _LANE
+    block_rows = min(_BLOCK_ROWS, rows)
+    q2d = q.reshape(rows, _LANE)
+    grid = ((rows + block_rows - 1) // block_rows,)
     out = pl.pallas_call(
         _dequant_kernel,
-        out_shape=jax.ShapeDtypeStruct(q2d.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.float32),
+        grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
         interpret=_interpret(),
     )(q2d, scale.reshape(1, 1).astype(jnp.float32))
     return out.reshape(n)

@@ -252,3 +252,26 @@ def test_grads_only_with_aux_state_rejected(mesh8):
     grads = jax.tree.map(lambda p: jnp.ones((8,) + p.shape), make_params())
     with pytest.raises(NotImplementedError):
         opt.step(grads=grads, aux_state={"x": jnp.zeros(1)})
+
+
+def test_step_accumulate_matches_big_batch(mesh8):
+    """k microbatches accumulated == one k-times-larger batch (with
+    average=True both are mean gradients)."""
+    params = make_params()
+    k1, k2 = jax.random.split(jax.random.key(9))
+    x = jax.random.normal(k1, (64, 4))
+    y = jax.random.normal(k2, (64, 3))
+
+    a = SGD(params, mesh=mesh8, lr=0.05, average=True)
+    a.step(loss_fn=quad_loss, batch=(x, y))
+
+    b = SGD(params, mesh=mesh8, lr=0.05, average=True)
+    micro = (x.reshape(2, 32, 4), y.reshape(2, 32, 3))
+    loss, data = b.step_accumulate(quad_loss, micro)
+    assert data["accum_steps"] == 2
+    jax.tree.map(
+        lambda p, q: np.testing.assert_allclose(
+            np.asarray(p), np.asarray(q), rtol=1e-5, atol=1e-6
+        ),
+        a.params, b.params,
+    )
