@@ -17,6 +17,12 @@ measured for a ResNet-18-sized gradient set (~11M params, ~60 tensors,
   the code path ``MPI_PS.step`` runs per chip, where multi-chip meshes
   add one ICI psum).
 
+Device work is deliberately just TWO jitted programs (grad/param
+materialization from on-device PRNG, then the step), with parameter
+shapes discovered host-side via ``jax.eval_shape`` — no eager per-op
+dispatch, no bulk host→device transfers, so the benchmark stays fast
+even when the TPU sits behind a high-latency tunnel.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
 vs_baseline = baseline_ms / ours_ms (speedup factor, >1 is better).
 """
@@ -31,6 +37,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorch_ps_mpi_tpu.utils.backend_guard import (
+    enable_compilation_cache,
+    ensure_live_backend,
+)
+
+enable_compilation_cache()
+
 from pytorch_ps_mpi_tpu.codecs import IdentityCodec
 from pytorch_ps_mpi_tpu.models import ResNet18
 from pytorch_ps_mpi_tpu.optim import SGDHyper, init_sgd_state, sgd_update
@@ -39,11 +52,13 @@ WORKERS = 8
 REPS = 20
 
 
-def make_grads(params, workers, seed=0):
-    rng = np.random.RandomState(seed)
-    leaves, treedef = jax.tree.flatten(params)
-    stacked = [rng.randn(workers, *np.shape(x)).astype(np.float32) for x in leaves]
-    return treedef, stacked
+def param_structs():
+    """Parameter ShapeDtypeStructs via tracing only — no device ops."""
+    model = ResNet18(num_classes=10, small_inputs=True)
+    return jax.eval_shape(
+        lambda k: model.init(k, jnp.ones((1, 32, 32, 3), jnp.float32)),
+        jax.random.key(0),
+    )
 
 
 def reference_style_step(np_params, np_bufs, worker_msgs, lr=0.01, momentum=0.9):
@@ -60,8 +75,10 @@ def reference_style_step(np_params, np_bufs, worker_msgs, lr=0.01, momentum=0.9)
         np_params[i] -= lr * buf                         # ps.py:214
 
 
-def run_reference_baseline(treedef, stacked):
-    np_params = [np.zeros(s.shape[1:], np.float32) for s in stacked]
+def run_reference_baseline(shapes):
+    rng = np.random.RandomState(0)
+    stacked = [rng.randn(WORKERS, *s).astype(np.float32) for s in shapes]
+    np_params = [np.zeros(s, np.float32) for s in shapes]
     np_bufs = [np.zeros_like(p) for p in np_params]
     times = []
     for _ in range(max(3, REPS // 4)):
@@ -76,12 +93,25 @@ def run_reference_baseline(treedef, stacked):
     return min(times)
 
 
-def run_ours(treedef, stacked):
-    params = jax.tree.unflatten(treedef, [jnp.zeros(s.shape[1:]) for s in stacked])
-    grads_stacked = jax.tree.unflatten(treedef, [jnp.asarray(s) for s in stacked])
-    state = init_sgd_state(params)
-    h = SGDHyper(lr=0.01, momentum=0.9)
+def run_ours(structs):
     code = IdentityCodec()
+    h = SGDHyper(lr=0.01, momentum=0.9)
+    leaves, treedef = jax.tree.flatten(structs)
+
+    @jax.jit
+    def materialize(key):
+        keys = jax.random.split(key, len(leaves))
+        grads_stacked = jax.tree.unflatten(
+            treedef,
+            [
+                jax.random.normal(k, (WORKERS,) + s.shape, jnp.float32)
+                for k, s in zip(keys, leaves)
+            ],
+        )
+        params = jax.tree.unflatten(
+            treedef, [jnp.zeros(s.shape, jnp.float32) for s in leaves]
+        )
+        return params, init_sgd_state(params), grads_stacked
 
     @jax.jit
     def step(params, state, grads_stacked):
@@ -90,6 +120,8 @@ def run_ours(treedef, stacked):
         )
         return sgd_update(params, summed, state, h)
 
+    params, state, grads_stacked = materialize(jax.random.key(0))
+    jax.block_until_ready(params)
     params, state = step(params, state, grads_stacked)  # compile
     jax.block_until_ready(params)
     times = []
@@ -102,13 +134,13 @@ def run_ours(treedef, stacked):
 
 
 def main():
-    model = ResNet18(num_classes=10, small_inputs=True)
-    params = model.init(jax.random.key(0), jnp.ones((1, 32, 32, 3)))
-    treedef, stacked = make_grads(params, WORKERS)
-    n_params = sum(int(np.prod(s.shape[1:])) for s in stacked)
+    ensure_live_backend()
+    structs = param_structs()
+    shapes = [s.shape for s in jax.tree.leaves(structs)]
+    n_params = sum(int(np.prod(s)) for s in shapes)
 
-    ref_s = run_reference_baseline(treedef, stacked)
-    ours_s = run_ours(treedef, stacked)
+    ref_s = run_reference_baseline(shapes)
+    ours_s = run_ours(structs)
 
     print(
         json.dumps(

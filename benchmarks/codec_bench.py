@@ -9,15 +9,17 @@ Run: ``python benchmarks/codec_bench.py [n_elems]``.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 
 from pytorch_ps_mpi_tpu.codecs import get_codec
+from pytorch_ps_mpi_tpu.utils.backend_guard import ensure_live_backend
 
 CODECS = [
     ("identity", {}),
@@ -61,7 +63,9 @@ def bench_codec(name, kw, n, reps=20):
 
 
 def main():
+    ensure_live_backend()
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 23  # ~8M ≈ ResNet18
+    n = max(1024, (n // 1024) * 1024)  # benchmarked shape is (n//1024, 1024)
     raw_bytes = n * 4
     print(f"backend={jax.default_backend()} n={n} raw={raw_bytes/1e6:.1f} MB")
     print("| codec | encode ms | decode ms | wire MB | ratio |")

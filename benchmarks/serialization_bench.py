@@ -9,11 +9,12 @@ Prints a markdown table; run: ``python benchmarks/serialization_bench.py``.
 
 from __future__ import annotations
 
+import os
 import pickle
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -22,11 +22,17 @@ from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
 
 @register_codec("topk")
 class TopKCodec(Codec):
-    def __init__(self, k: int = 0, fraction: float = 0.0):
+    def __init__(self, k: int = 0, fraction: float = 0.0, approx: bool = False):
+        """``approx=True`` selects ``lax.approx_max_k`` — the TPU's
+        hardware-accelerated approximate top-k (recall ~0.95) — instead of
+        the exact sort-based ``lax.top_k``, which is far cheaper on
+        multi-million-element gradients. Sparsification is already lossy,
+        so approximate selection costs little accuracy."""
         if (k <= 0) == (fraction <= 0.0):
             raise ValueError("give exactly one of k>0 or 0<fraction<=1")
         self.k = int(k)
         self.fraction = float(fraction)
+        self.approx = bool(approx)
 
     def _k_for(self, shape) -> int:
         n = int(np.prod(shape)) if shape else 1
@@ -36,7 +42,10 @@ class TopKCodec(Codec):
     def encode(self, grad, state=(), rng=None):
         flat = grad.reshape(-1)
         k = self._k_for(grad.shape)
-        values, indices = jax.lax.top_k(jnp.abs(flat), k)
+        if self.approx:
+            _, indices = jax.lax.approx_max_k(jnp.abs(flat), k)
+        else:
+            _, indices = jax.lax.top_k(jnp.abs(flat), k)
         payload = {
             "values": jnp.take(flat, indices),
             "indices": indices.astype(jnp.int32),

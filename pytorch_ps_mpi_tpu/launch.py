@@ -50,6 +50,9 @@ def main(argv=None):
         process_id=args.process_id if args.process_id >= 0 else None,
     )
     sys.argv = [args.script] + args.script_args
+    # match `python script.py` semantics: the script's directory is
+    # importable (runpy.run_path does not add it itself)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(args.script)))
     runpy.run_path(args.script, run_name="__main__")
 
 

@@ -34,7 +34,7 @@ def _quantize_jnp(flat: jax.Array):
     return q, scale.astype(jnp.float32)
 
 
-def _absmax_kernel(x_ref, out_ref):
+def _absmax_kernel(rows, block_rows, x_ref, out_ref):
     from jax.experimental import pallas as pl
 
     i = pl.program_id(0)
@@ -43,7 +43,12 @@ def _absmax_kernel(x_ref, out_ref):
     def _():
         out_ref[0, 0] = 0.0
 
-    blk = jnp.max(jnp.abs(x_ref[:]))
+    x = x_ref[:]
+    # The trailing grid step's block may extend past the array; Mosaic
+    # fills the overhang with undefined values, which a max reduction must
+    # never see — mask them to 0 (absmax-neutral) by global row index.
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) + i * block_rows
+    blk = jnp.max(jnp.where(row_ids < rows, jnp.abs(x), 0.0))
     out_ref[0, 0] = jnp.maximum(out_ref[0, 0], blk)
 
 
@@ -68,20 +73,16 @@ def quantize_int8(flat: jax.Array):
 
     rows = n // _LANE  # multiple of _SUBLANE since n % _TILE == 0
     x2d = flat.reshape(rows, _LANE)
-    # shrink the block for small inputs so a 1024-element gradient isn't
-    # padded 128x; for large unaligned inputs pad rows to a block multiple
-    # with zeros — the absmax reduction must not see the undefined values
-    # Mosaic pads ragged trailing blocks with (zeros are absmax-neutral);
-    # the padded tail of q is sliced off on the host below.
+    # Shrink the block for small inputs so a 1024-element gradient isn't
+    # padded 128x. A non-block-multiple row count needs no data copy: the
+    # absmax kernel masks the ragged trailing block's undefined overhang
+    # itself, and the quant kernel tolerates it (garbage in → garbage out,
+    # never written past `rows` in the output).
     block_rows = min(_BLOCK_ROWS, rows)
-    pad_rows = (-rows) % block_rows
-    if pad_rows:
-        x2d = jnp.pad(x2d, ((0, pad_rows), (0, 0)))
-    padded_rows = rows + pad_rows
-    grid = (padded_rows // block_rows,)
+    grid = ((rows + block_rows - 1) // block_rows,)
 
     absmax = pl.pallas_call(
-        _absmax_kernel,
+        functools.partial(_absmax_kernel, rows, block_rows),
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
         grid=grid,
         in_specs=[
@@ -95,7 +96,7 @@ def quantize_int8(flat: jax.Array):
 
     q = pl.pallas_call(
         _quant_kernel,
-        out_shape=jax.ShapeDtypeStruct((padded_rows, _LANE), jnp.int8),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), jnp.int8),
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_rows, _LANE), lambda i: (i, 0),
@@ -106,7 +107,7 @@ def quantize_int8(flat: jax.Array):
                                memory_space=pltpu.VMEM),
         interpret=_interpret(),
     )(x2d, scale.reshape(1, 1))
-    return q[:rows].reshape(n), scale
+    return q.reshape(n), scale
 
 
 def _dequant_kernel(q_ref, scale_ref, out_ref):

@@ -447,6 +447,12 @@ class MPI_PS:
         """One optimizer step over ``accum_steps`` microbatches per worker.
         ``microbatches`` leaves are ``[accum_steps, global_batch, ...]``;
         returns ``(mean_loss, data)``."""
+        if self.instrument:
+            raise NotImplementedError(
+                "instrument=True does not support step_accumulate (the "
+                "accumulation scan is one fused program; per-stage times "
+                "are not separable)"
+            )
         accum_steps = int(jax.tree.leaves(microbatches)[0].shape[0])
         key = ("accum", loss_fn, accum_steps)
         if key not in self._compiled:

@@ -1,0 +1,62 @@
+"""Guard against a wedged accelerator transport.
+
+The axon TPU plugin on this machine can hang indefinitely on the first
+device op when its tunnel is down, and it ignores the ``JAX_PLATFORMS``
+env var — so benchmark entry points probe device health in a subprocess
+under a hard timeout and pin the process to the CPU backend (via
+``jax.config``, which the plugin does respect) when the probe fails.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def enable_compilation_cache(path: str = "/tmp/jax_comp_cache") -> None:
+    """Persistent compiled-program cache shared by the repo's entry points
+    — significant when the TPU backend compiles remotely. Safe no-op on
+    JAX versions lacking the config knobs."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+
+def ensure_live_backend(timeout: float | None = None) -> bool:
+    """Run one trivial device op in a subprocess under ``timeout`` seconds
+    (default: ``$BENCH_PROBE_TIMEOUT`` or 240). On failure, switch this
+    process to the CPU backend so callers always complete.
+
+    Must be called before the current process initializes its JAX
+    backend. Returns True if the default backend is live.
+    """
+    import jax
+
+    if timeout is None:
+        timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    try:
+        subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.block_until_ready(jax.numpy.ones((8, 8)))",
+            ],
+            timeout=timeout,
+            check=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return True
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
+        print(
+            f"backend probe: accelerator unresponsive after {timeout:.0f}s; "
+            "falling back to CPU",
+            file=sys.stderr,
+        )
+        jax.config.update("jax_platforms", "cpu")
+        return False
