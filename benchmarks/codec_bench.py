@@ -21,14 +21,16 @@ import jax.numpy as jnp
 from pytorch_ps_mpi_tpu.codecs import get_codec
 from pytorch_ps_mpi_tpu.utils.backend_guard import ensure_live_backend
 
-CODECS = [
-    ("identity", {}),
-    ("int8", {}),
-    ("qsgd", {"levels": 16}),
-    ("sign", {}),
-    ("topk", {"fraction": 0.01}),
-    ("randomk", {"fraction": 0.01}),
-    ("powersgd", {"rank": 4}),
+CODECS = [  # (label, registry name, kwargs)
+    ("identity", "identity", {}),
+    ("int8", "int8", {}),
+    ("qsgd", "qsgd", {"levels": 16}),
+    ("sign", "sign", {}),
+    ("terngrad", "terngrad", {}),
+    ("topk", "topk", {"fraction": 0.01}),
+    ("topk-approx", "topk", {"fraction": 0.01, "approx": True}),
+    ("randomk", "randomk", {"fraction": 0.01}),
+    ("powersgd", "powersgd", {"rank": 4}),
 ]
 
 
@@ -70,10 +72,10 @@ def main():
     print(f"backend={jax.default_backend()} n={n} raw={raw_bytes/1e6:.1f} MB")
     print("| codec | encode ms | decode ms | wire MB | ratio |")
     print("|---|---|---|---|---|")
-    for name, kw in CODECS:
+    for label, name, kw in CODECS:
         t_enc, t_dec, wire = bench_codec(name, kw, n)
         print(
-            f"| {name} | {t_enc*1e3:.2f} | {t_dec*1e3:.2f} "
+            f"| {label} | {t_enc*1e3:.2f} | {t_dec*1e3:.2f} "
             f"| {wire/1e6:.2f} | {raw_bytes/wire:.1f}x |"
         )
 

@@ -16,6 +16,7 @@ from pytorch_ps_mpi_tpu.codecs.topk import TopKCodec
 from pytorch_ps_mpi_tpu.codecs.randomk import RandomKCodec
 from pytorch_ps_mpi_tpu.codecs.quant import Int8Codec, QSGDCodec
 from pytorch_ps_mpi_tpu.codecs.sign import SignCodec
+from pytorch_ps_mpi_tpu.codecs.terngrad import TernGradCodec
 from pytorch_ps_mpi_tpu.codecs.powersgd import PowerSGDCodec
 from pytorch_ps_mpi_tpu.codecs.error_feedback import ErrorFeedback
 
@@ -29,6 +30,7 @@ __all__ = [
     "Int8Codec",
     "QSGDCodec",
     "SignCodec",
+    "TernGradCodec",
     "PowerSGDCodec",
     "ErrorFeedback",
 ]

@@ -1,8 +1,16 @@
-"""Random-k sparsification codec (unbiased: kept entries are scaled by n/k).
+"""Random-k sparsification codec (unbiased: kept entries are rescaled so
+``E[decode] = grad``).
 
 Companion to top-k in the reference's codings research surface (SURVEY
 §2.2). Needs per-worker randomness: the train step threads a PRNG key
 folded with the worker's axis index so ranks sample different coordinates.
+
+Sampling is stratified: the flat gradient is split into k equal buckets
+and one uniform index is drawn per bucket — O(k) work and collision-free,
+where drawing k of n indices without replacement costs a full O(n log n)
+permutation. Kept entries are scaled by their bucket's length, which makes
+the estimator exactly unbiased per coordinate (inclusion probability is
+1/len(bucket)) and lowers variance vs. plain without-replacement sampling.
 """
 
 from __future__ import annotations
@@ -35,10 +43,15 @@ class RandomKCodec(Codec):
         flat = grad.reshape(-1)
         n = flat.shape[0]
         k = self._k_for(grad.shape)
-        indices = jax.random.choice(rng, n, shape=(k,), replace=False).astype(jnp.int32)
+        # n and k are static: exact bucket bounds on host (int arithmetic)
+        bounds = ((np.arange(k + 1, dtype=np.int64) * n) // k).astype(np.int32)
+        starts = jnp.asarray(bounds[:-1])
+        lens = jnp.asarray(bounds[1:] - bounds[:-1])
+        u = jax.random.uniform(rng, (k,))
+        indices = starts + jnp.floor(u * lens).astype(jnp.int32)
         values = jnp.take(flat, indices)
         if self.unbiased:
-            values = values * (n / k)
+            values = values * lens.astype(flat.dtype)
         return {"values": values, "indices": indices}, state
 
     def decode(self, payload, shape, dtype):

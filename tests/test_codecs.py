@@ -13,6 +13,7 @@ from pytorch_ps_mpi_tpu.codecs import (
     QSGDCodec,
     RandomKCodec,
     SignCodec,
+    TernGradCodec,
     TopKCodec,
     get_codec,
 )
@@ -126,6 +127,36 @@ def test_sign_codec():
     np.testing.assert_allclose(out, scale * np.sign(np.asarray(g)))
     # 1 bit/element + fp32 scale, packed
     assert c.payload_bits((1000,), jnp.float32) == 125 * 8 + 32
+
+
+def test_terngrad_values_and_bits():
+    c = TernGradCodec()
+    g = grad((37,))
+    out = np.asarray(roundtrip(c, g, jax.random.key(3)))
+    scale = float(jnp.max(jnp.abs(g)))
+    # every decoded coordinate is in {-s, 0, +s} with the sign of g
+    np.testing.assert_allclose(
+        out, np.where(out != 0, scale * np.sign(np.asarray(g)), 0), rtol=1e-6
+    )
+    # 2 bits/element packed 4-per-byte + fp32 scale
+    assert c.payload_bits((1000,), jnp.float32) == 250 * 8 + 32
+
+
+def test_terngrad_unbiased_expectation():
+    c = TernGradCodec()
+    g = grad((32,))
+    outs = [np.asarray(roundtrip(c, g, jax.random.key(i))) for i in range(500)]
+    np.testing.assert_allclose(np.mean(outs, axis=0), np.asarray(g), atol=0.5)
+
+
+def test_terngrad_decode_sum_matches_loop():
+    c = TernGradCodec()
+    gs = [grad((20,), seed=i) for i in range(4)]
+    payloads = [c.encode(g, (), jax.random.key(10 + i))[0] for i, g in enumerate(gs)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *payloads)
+    fused = np.asarray(c.decode_sum(stacked, (20,), jnp.float32))
+    loop = sum(np.asarray(c.decode(p, (20,), jnp.float32)) for p in payloads)
+    np.testing.assert_allclose(fused, loop, rtol=1e-6)
 
 
 def test_error_feedback_accumulates_residual():

@@ -118,6 +118,7 @@ def test_average_flag(mesh8):
     ("sign", {}),
     ("randomk", {"fraction": 0.5}),
     ("qsgd", {"levels": 16}),
+    ("terngrad", {}),
 ])
 def test_codec_training_converges(mesh8, codec_name, kw):
     """Loss decreases under every codec (convergence smoke; the reference's
