@@ -600,6 +600,29 @@ class MPI_PS:
         self._step_count += 1
         return loss, data
 
+    def state_dict(self) -> Dict[str, Any]:
+        """torch.optim.Optimizer-style checkpointable state. The reference
+        inherited stock ``state_dict()`` (momentum/Adam moments live in
+        ``Optimizer.state``, SURVEY §5.4) but never called it; a drop-in
+        replacement still has to offer it. Pair with
+        ``utils.checkpoint.CheckpointManager`` for sharded on-disk saves."""
+        return {
+            "params": self.params,
+            "opt_state": tuple(self.opt_state),
+            "codec_state": self.codec_state,
+            "aux_state": self.aux_state,
+            "step_count": self._step_count,
+            "rng_data": jax.random.key_data(self._rng),
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.params = sd["params"]
+        self.opt_state = type(self.opt_state)(*sd["opt_state"])
+        self.codec_state = sd["codec_state"]
+        self.aux_state = sd.get("aux_state")
+        self._step_count = int(sd["step_count"])
+        self._rng = jax.random.wrap_key_data(jnp.asarray(sd["rng_data"]))
+
     def run_steps(
         self, loss_fn: Callable, batches: PyTree, *, unroll: int = 1
     ) -> Tuple[jax.Array, Dict[str, float]]:

@@ -51,6 +51,7 @@ class Trainer:
         self.scan_chunk = max(1, int(scan_chunk))
         self.checkpoint_every = checkpoint_every
         self._last_saved_step = 0
+        self._eval_compiled: Dict[Any, Callable] = {}
         self.ckpt = (
             CheckpointManager(checkpoint_dir) if checkpoint_dir else None
         )
@@ -85,6 +86,26 @@ class Trainer:
         self.opt.codec_state = state["codec_state"]
         self.step_count = int(state["step"])
         return True
+
+    # -- evaluation ---------------------------------------------------------
+    def evaluate(
+        self,
+        batches: Iterator[PyTree],
+        num_batches: int,
+        eval_fn: Optional[Callable] = None,
+    ) -> float:
+        """Mean of ``eval_fn(params, batch)`` (default: the training
+        ``loss_fn``) over ``num_batches`` batches, without touching
+        optimizer state."""
+        fn = eval_fn if eval_fn is not None else self.loss_fn
+        key = ("eval", fn)
+        if key not in self._eval_compiled:
+            self._eval_compiled[key] = jax.jit(fn)
+        compiled = self._eval_compiled[key]
+        total = 0.0
+        for _ in range(num_batches):
+            total += float(compiled(self.opt.params, next(batches)))
+        return total / max(1, num_batches)
 
     # -- training -----------------------------------------------------------
     def fit(
