@@ -13,6 +13,7 @@ outruns any host CPU compressor — SURVEY §2.4).
 from pytorch_ps_mpi_tpu.codecs.base import Codec, get_codec, register_codec
 from pytorch_ps_mpi_tpu.codecs.identity import IdentityCodec
 from pytorch_ps_mpi_tpu.codecs.topk import TopKCodec
+from pytorch_ps_mpi_tpu.codecs.threshold import ThresholdCodec
 from pytorch_ps_mpi_tpu.codecs.randomk import RandomKCodec
 from pytorch_ps_mpi_tpu.codecs.quant import Int8Codec, QSGDCodec
 from pytorch_ps_mpi_tpu.codecs.sign import SignCodec
@@ -26,6 +27,7 @@ __all__ = [
     "register_codec",
     "IdentityCodec",
     "TopKCodec",
+    "ThresholdCodec",
     "RandomKCodec",
     "Int8Codec",
     "QSGDCodec",

@@ -38,9 +38,20 @@ class Int8Codec(Codec):
         return (payload["q"].astype(dtype) * payload["scale"].astype(dtype)).reshape(shape)
 
     def decode_sum(self, payloads, shape, dtype):
-        # [world, n] int8 × [world] scales → one weighted sum.
-        deq = payloads["q"].astype(dtype) * payloads["scale"].astype(dtype)[:, None]
-        return deq.sum(axis=0).reshape(shape)
+        # sum_w scale_w * q_w as one [n, world] @ [world] matvec: the int8
+        # payload is dequantized and reduced inside a single MXU-friendly
+        # dot, never materializing the [world, n] float32 dequantized
+        # intermediate (which at ResNet scale × 8 workers costs ~1.4 GB of
+        # HBM traffic just to feed a sum).
+        q = payloads["q"]                     # [world, n] int8
+        scales = payloads["scale"].astype(jnp.float32)  # [world]
+        summed = jnp.einsum(
+            "wn,w->n",
+            q,
+            scales,
+            preferred_element_type=jnp.float32,
+        )
+        return summed.astype(dtype).reshape(shape)
 
     def payload_bits(self, shape, dtype):
         n = int(np.prod(shape)) if shape else 1
@@ -55,7 +66,10 @@ class QSGDCodec(Codec):
     needs_rng = True
 
     def __init__(self, levels: int = 16):
-        assert levels >= 1
+        # levels must fit the int8 payload: encode stores q in [-levels,
+        # levels], so levels > 127 would silently overflow int8.
+        if not 1 <= levels <= 127:
+            raise ValueError(f"levels must be in [1, 127], got {levels}")
         self.levels = int(levels)
 
     def encode(self, grad, state=(), rng=None):
@@ -78,10 +92,15 @@ class QSGDCodec(Codec):
         return g.reshape(shape)
 
     def decode_sum(self, payloads, shape, dtype):
-        deq = payloads["q"].astype(dtype) * (
-            payloads["norm"].astype(dtype)[:, None] / self.levels
+        # Same [n, world] @ [world] contraction as Int8Codec.decode_sum:
+        # no [world, n] f32 intermediate.
+        summed = jnp.einsum(
+            "wn,w->n",
+            payloads["q"],
+            payloads["norm"].astype(jnp.float32) / self.levels,
+            preferred_element_type=jnp.float32,
         )
-        return deq.sum(axis=0).reshape(shape)
+        return summed.astype(dtype).reshape(shape)
 
     def payload_bits(self, shape, dtype):
         n = int(np.prod(shape)) if shape else 1

@@ -1,0 +1,124 @@
+"""Threshold sparsification — the genuinely RAGGED codec.
+
+Keeps every entry with ``|g| > tau * mean|g|`` (Strom-2015-style relative
+threshold). Unlike top-k, the number of surviving entries is
+**data-dependent**: it varies per worker, per parameter, and per step. This
+is the payload class the reference's whole two-phase variable-length
+protocol existed for (``mpi_comms.py:144-174``: exchange byte counts
+first, then ``Iallgatherv`` the ragged payloads), and its TPU-native wire
+convention is the one the reference's ``max_bytes`` high-water padding
+approximated (``mpi_comms.py:82-85``):
+
+- the payload buffer has a **static cap** (``max_fraction`` of the tensor),
+  so it can ride ``lax.all_gather`` under jit;
+- the slots past each worker's true count hold *garbage* (whatever
+  ``flat[0]`` gather produced) — they are NOT zeroed on the send side;
+- an int32 ``length`` sidecar rides along, and the **receive side masks**
+  ``arange(cap) < length`` before the scatter-add. Consumers that ignore
+  the sidecar get corrupt sums — the sidecar is load-bearing, exactly like
+  the reference's count exchange (and unlike its 32-byte ``0x29`` sentinel,
+  which could collide with payload bytes, SURVEY §2.3).
+
+Overflow (more survivors than the cap) drops the tail entries in index
+order — the high-water buffer is the contract, as in the reference. Wrap
+in :class:`~pytorch_ps_mpi_tpu.codecs.error_feedback.ErrorFeedback`
+(``get_codec('ef', inner_name='threshold', ...)``) to accumulate both
+sub-threshold and overflow residuals into later steps.
+
+With ``target_fraction`` set, ``tau`` becomes adaptive codec state: a
+multiplicative controller nudges it so the mean kept fraction tracks the
+target (kept > target → raise the bar, and vice versa).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+
+
+@register_codec("threshold")
+class ThresholdCodec(Codec):
+    def __init__(
+        self,
+        tau: float = 2.0,
+        max_fraction: float = 0.25,
+        target_fraction: float = 0.0,
+        eta: float = 0.25,
+    ):
+        """Args:
+          tau: initial threshold in units of the gradient's mean |g|.
+          max_fraction: static payload cap as a fraction of the tensor —
+            the compile-time high-water mark (reference ``max_bytes``).
+          target_fraction: if >0, adapt tau so the kept fraction tracks
+            this value (tau becomes codec state).
+          eta: controller gain for the tau adaptation.
+        """
+        if not 0.0 < max_fraction <= 1.0:
+            raise ValueError(f"max_fraction must be in (0, 1], got {max_fraction}")
+        if target_fraction and target_fraction > max_fraction:
+            raise ValueError("target_fraction must be <= max_fraction")
+        self.tau = float(tau)
+        self.max_fraction = float(max_fraction)
+        self.target_fraction = float(target_fraction)
+        self.eta = float(eta)
+
+    def _cap(self, shape) -> int:
+        n = int(np.prod(shape)) if shape else 1
+        return max(1, int(round(n * self.max_fraction)))
+
+    def init_state(self, shape, dtype):
+        return {"tau": jnp.float32(self.tau)}
+
+    def encode(self, grad, state=None, rng=None):
+        state = state if state else {"tau": jnp.float32(self.tau)}
+        flat = grad.reshape(-1)
+        n = flat.shape[0]
+        cap = self._cap(grad.shape)
+        tau = state["tau"]
+        thr = tau * jnp.mean(jnp.abs(flat))
+        mask = jnp.abs(flat) > thr
+        kept = jnp.sum(mask)  # true survivor count — data-dependent
+        # static-size compaction: indices of the first `cap` survivors in
+        # index order; slots past min(kept, cap) are fill (index 0) and the
+        # values gathered there are garbage by design — see module doc.
+        (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
+        payload = {
+            "values": jnp.take(flat, idx),
+            "indices": idx.astype(jnp.int32),
+            "length": jnp.minimum(kept, cap).astype(jnp.int32),
+        }
+        if self.target_fraction > 0.0:
+            target = self.target_fraction * n
+            ratio = kept.astype(jnp.float32) / target
+            new_tau = jnp.clip(tau * ratio**self.eta, 1e-4, 1e4)
+        else:
+            new_tau = tau
+        return payload, {"tau": new_tau}
+
+    def _masked_values(self, payload, dtype):
+        cap = payload["values"].shape[-1]
+        valid = jnp.arange(cap) < payload["length"][..., None]
+        return jnp.where(valid, payload["values"], 0).astype(dtype)
+
+    def decode(self, payload, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        vals = self._masked_values(payload, dtype)
+        flat = jnp.zeros((n,), dtype)
+        return flat.at[payload["indices"]].add(vals).reshape(shape)
+
+    def decode_sum(self, payloads, shape, dtype):
+        # Masked fused scatter-add over all workers: each worker's garbage
+        # tail is zeroed by ITS OWN length before the sum — the receive
+        # half of the ragged protocol.
+        n = int(np.prod(shape)) if shape else 1
+        vals = self._masked_values(payloads, dtype).reshape(-1)
+        idx = payloads["indices"].reshape(-1)
+        return jnp.zeros((n,), dtype).at[idx].add(vals).reshape(shape)
+
+    def payload_bits(self, shape, dtype):
+        # static wire size (the cap); true occupancy varies per step
+        cap = self._cap(shape)
+        return cap * (jnp.dtype(dtype).itemsize * 8 + 32) + 32

@@ -5,10 +5,11 @@ the reference reached it through the external ``codings`` hook, SURVEY
 §2.2). Keeps the k largest-magnitude entries of the flattened gradient.
 
 Static shapes: k is fixed at trace time, so the payload (values[k],
-indices[k]) needs no size exchange — the compile-time analog of the
-reference's two-phase ``prepare``/``Iallgatherv`` ragged protocol
-(``mpi_comms.py:144-174``). ``true_length`` is carried anyway to exercise
-the ragged sidecar convention (``comms.ragged_all_gather``).
+indices[k]) is dense and needs NO size exchange — the compile-time analog
+of the reference's two-phase ``prepare``/``Iallgatherv`` ragged protocol
+(``mpi_comms.py:144-174``). For the genuinely variable-length payload
+class (data-dependent survivor counts + a load-bearing length sidecar),
+see :class:`~pytorch_ps_mpi_tpu.codecs.threshold.ThresholdCodec`.
 """
 
 from __future__ import annotations

@@ -22,9 +22,14 @@ What changed architecturally (SURVEY §3.1 vs. this file):
 - The per-parameter reverse-order receive loop (``ps.py:155-176``)
   becomes a tree-mapped collective; XLA schedules transfers.
 - Both reference topologies are kept: ``mode='allgather'`` is the live
-  decentralized path (every rank decodes+steps redundantly, ``ps.py:75``),
-  ``mode='leader'`` is the rank-0 PS gather→step→broadcast path
-  (``mpi_comms.py:60-133``, README pseudo-code).
+  decentralized path (every rank decodes+steps redundantly, ``ps.py:75``);
+  ``mode='leader'`` is the rank-0 PS path (gather→step-on-leader→broadcast,
+  ``mpi_comms.py:60-133``, README pseudo-code), lowered TPU-natively as a
+  ZeRO-1 sharded-optimizer step: reduce_scatter the summed gradient, each
+  worker updates only its 1/world flat shard (owning that shard's optimizer
+  state), then all_gather the updated shards. Same numerics, but update
+  FLOPs and optimizer-state memory divide by world size instead of every
+  rank redundantly stepping the full model.
 
 Async (AsySG-InCon) training lives in ``parallel/async_ps.py``.
 """
@@ -55,6 +60,75 @@ def _tree_bytes(tree: PyTree) -> int:
         int(np.prod(x.shape)) * jnp.dtype(x.dtype).itemsize
         for x in jax.tree.leaves(tree)
     )
+
+
+def _tree_size(tree: PyTree) -> int:
+    """Total element count of a pytree's arrays."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def _flatten_f32(tree: PyTree, n_pad: int) -> jax.Array:
+    """Concatenate all leaves into one zero-padded f32 vector of length
+    ``n_pad`` (the flat layout the leader-PS shards over workers)."""
+    flat = jnp.concatenate(
+        [jnp.ravel(x).astype(jnp.float32) for x in jax.tree.leaves(tree)]
+    )
+    return jnp.pad(flat, (0, n_pad - flat.shape[0]))
+
+
+def _unflatten_like(flat: jax.Array, like: PyTree) -> PyTree:
+    """Inverse of :func:`_flatten_f32`: split ``flat`` back into ``like``'s
+    leaf shapes/dtypes (padding tail dropped)."""
+    leaves, treedef = jax.tree.flatten(like)
+    out, i = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape))
+        out.append(lax.slice(flat, (i,), (i + n,)).reshape(l.shape).astype(l.dtype))
+        i += n
+    return jax.tree.unflatten(treedef, out)
+
+
+class _IdKey:
+    """Hash/eq by object identity while holding a strong reference, so an
+    id() can never be recycled into a false cache hit after GC."""
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __hash__(self):
+        return id(self.obj)
+
+    def __eq__(self, other):
+        return isinstance(other, _IdKey) and other.obj is self.obj
+
+
+def _fn_cache_key(fn: Optional[Callable]) -> Any:
+    """Compile-cache key for a user loss function that survives fresh
+    function *objects* with identical behavior — ``(code, closure cells,
+    defaults, bound self)`` instead of bare identity — so
+    ``step(loss_fn=lambda p, b: ...)`` in a loop, or a bound method
+    (``model.loss`` creates a new object per attribute access), compiles
+    once. Anything that can change behavior distinguishes the key:
+    closure cell values, default args, and the method receiver; unhashable
+    values are wrapped in :class:`_IdKey` (identity + strong ref).
+    Known limit: a function reading a rebound module-level *global* is
+    indistinguishable — same caveat as ``jax.jit`` itself."""
+    if fn is None or not hasattr(fn, "__code__"):
+        return fn
+
+    def h(v):
+        try:
+            hash(v)
+            return v
+        except TypeError:
+            return _IdKey(v)
+
+    cells = tuple(h(c.cell_contents) for c in (fn.__closure__ or ()))
+    defaults = tuple(h(d) for d in (fn.__defaults__ or ()))
+    bound_self = _IdKey(fn.__self__) if hasattr(fn, "__self__") else None
+    return (fn.__code__, cells, defaults, bound_self)
 
 
 # ---------------------------------------------------------------------------
@@ -137,8 +211,11 @@ class MPI_PS:
       mesh: ``jax.sharding.Mesh``; default 1-D data mesh over all devices.
       axis_name: mesh axis to aggregate over.
       mode: ``'allgather'`` (decentralized replicated step — the
-        reference's live path) or ``'leader'`` (rank-0 PS
-        gather→step→broadcast).
+        reference's live path) or ``'leader'`` (PS topology: the update
+        runs once, sharded over workers ZeRO-1 style, not redundantly —
+        optimizer state is partitioned 1/world per device; internally the
+        update runs on a flat f32 vector, so non-f32 params are cast
+        through f32).
       average: if True, average worker gradients instead of the
         reference's sum semantics (``ps.py:176``).
       instrument: if True, ``step`` runs the pipeline as separate stages
@@ -171,7 +248,6 @@ class MPI_PS:
         self.hyper = hyper_cls(**hyper)
         self._update_fn = update_fn
         self.params = params
-        self.opt_state = init_state(params)
         self.code = code if code is not None else IdentityCodec()
         self.mesh = mesh if mesh is not None else make_mesh(axis_names=(axis_name,))
         self.axis_name = axis_name
@@ -181,6 +257,38 @@ class MPI_PS:
         self.comm_dtype = comm_dtype
         self.rank = jax.process_index()           # reference ps.py:71-72
         self.size = int(self.mesh.shape[axis_name])  # reference ps.py:73
+        if mode == "leader":
+            # ZeRO-1-style sharded optimizer: each worker owns a 1/world
+            # shard of the flat parameter vector and the optimizer state
+            # for it — the TPU-native lowering of the reference's rank-0
+            # PS (gather to rank 0, rank 0 alone steps, broadcast back,
+            # mpi_comms.py:60-133, README.md:61-77), generalized so every
+            # chip is the "leader" of its own shard: reduce_scatter →
+            # shard-local update → all_gather. Update FLOPs and optimizer
+            # state memory divide by world size; comm volume matches a
+            # psum. Internally flat f32 (leaves cast back on unflatten).
+            n = _tree_size(params)
+            self._shard_len = -(-n // self.size)  # ceil
+            self._n_pad = self._shard_len * self.size
+            flat_shard = jnp.zeros((self._shard_len,), jnp.float32)
+            st = init_state(flat_shard)
+            stacked = jax.tree.map(
+                lambda x: jnp.broadcast_to(x[None], (self.size,) + x.shape)
+                if x.ndim > 0 else x,
+                st,
+            )
+            from jax.sharding import NamedSharding
+            self.opt_state = jax.tree.map(
+                lambda x: jax.device_put(
+                    x,
+                    NamedSharding(
+                        self.mesh, P(axis_name) if x.ndim > 0 else P()
+                    ),
+                ),
+                stacked,
+            )
+        else:
+            self.opt_state = init_state(params)
         self._rng = jax.random.key(seed)
         self.codec_state = self._init_codec_state()
         self.aux_state = None  # mutable model state (e.g. BN batch_stats)
@@ -208,12 +316,66 @@ class MPI_PS:
         )
 
     def _update(self, params, opt_state, summed):
-        new_params, new_state = self._update_fn(params, summed, opt_state, self.hyper)
         if self.mode == "leader":
-            # rank-0 PS: semantically the leader steps and broadcasts
-            # (reference README.md:61-77, mpi_comms.py:120-133).
-            new_params = comms.broadcast_from_leader_tree(new_params, self.axis_name)
-        return new_params, new_state
+            # Every rank already holds the full summed gradient (non-psum
+            # codec decode path, or the instrumented stages); slice out the
+            # local shard and run the sharded step.
+            flat = _flatten_f32(summed, self._n_pad)
+            idx = lax.axis_index(self.axis_name)
+            shard = lax.dynamic_slice(
+                flat, (idx * self._shard_len,), (self._shard_len,)
+            )
+            return self._leader_shard_update(params, opt_state, shard)
+        return self._update_fn(params, summed, opt_state, self.hyper)
+
+    def _leader_shard_update(self, params, opt_state, grad_shard):
+        """The PS step proper: this worker is the parameter server for its
+        flat shard — update it with its slice of the optimizer state, then
+        all-gather the updated shards back to replicated parameters (the
+        reference's step-on-leader + broadcast, mpi_comms.py:107-133, with
+        the leader role partitioned across the mesh)."""
+        axis = self.axis_name
+        idx = lax.axis_index(axis)
+        flat_params = _flatten_f32(params, self._n_pad)
+        p_shard = lax.dynamic_slice(
+            flat_params, (idx * self._shard_len,), (self._shard_len,)
+        )
+        st = jax.tree.map(lambda x: x[0] if x.ndim > 0 else x, opt_state)
+        new_p_shard, new_st = self._update_fn(p_shard, grad_shard, st, self.hyper)
+        new_flat = lax.all_gather(new_p_shard, axis, tiled=True)
+        new_params = _unflatten_like(new_flat, params)
+        new_opt_state = jax.tree.map(
+            lambda x: x[None] if x.ndim > 0 else x, new_st
+        )
+        return new_params, new_opt_state
+
+    def _aggregate_update(self, params, opt_state, grads, payloads):
+        """Aggregate + update, choosing the cheapest lowering per mode:
+        in leader mode with a psum-capable codec the full allreduce is
+        replaced by ``psum_scatter`` (half the collective of psum — each
+        worker receives only its shard's sum), then shard-update +
+        all_gather."""
+        if self.mode == "leader" and self.code.supports_psum:
+            flat = _flatten_f32(grads, self._n_pad)
+            if self.comm_dtype is not None:
+                flat = flat.astype(self.comm_dtype)
+            shard = lax.psum_scatter(
+                flat, self.axis_name, scatter_dimension=0, tiled=True
+            ).astype(jnp.float32)
+            if self.average:
+                shard = shard / self.size
+            return self._leader_shard_update(params, opt_state, shard)
+        summed = self._aggregate(grads, payloads)
+        return self._update(params, opt_state, summed)
+
+    def _opt_state_spec(self):
+        """shard_map PartitionSpec pytree for the optimizer state: sharded
+        over the mesh axis in leader mode (ZeRO-1), replicated otherwise."""
+        if self.mode != "leader":
+            return P()
+        return jax.tree.map(
+            lambda x: P(self.axis_name) if x.ndim > 0 else P(), self.opt_state
+        )
 
     # -- compiled step builders -------------------------------------------
     def _build_instrumented_stages(self, loss_fn):
@@ -270,9 +432,10 @@ class MPI_PS:
             # fused path pays; run under shard_map so the axis is bound.
             return self._update(params, opt_state, summed)
 
+        opt_spec = self._opt_state_spec()
         update_fn_impl = jax.shard_map(
-            update_spmd, mesh=self.mesh, in_specs=(P(), P(), P()),
-            out_specs=(P(), P()), check_vma=False,
+            update_spmd, mesh=self.mesh, in_specs=(P(), opt_spec, P()),
+            out_specs=(P(), opt_spec), check_vma=False,
         )
 
         return {
@@ -325,7 +488,7 @@ class MPI_PS:
     def _step_instrumented(self, data, rng, grads=None, loss_fn=None, batch=None):
         """Staged pipeline with host-side timing (reference schema,
         ``ps.py:116-148``)."""
-        key = ("instr", loss_fn)
+        key = ("instr", _fn_cache_key(loss_fn))
         if key not in self._compiled:
             self._compiled[key] = self._build_instrumented_stages(loss_fn)
         stages = self._compiled[key]
@@ -391,18 +554,22 @@ class MPI_PS:
                 new_aux = ()
             loss = lax.pmean(loss, axis)
             payloads, new_codec_state = self._encode_tree(grads, codec_state, rng)
-            summed = self._aggregate(grads, payloads)
-            new_params, new_opt_state = self._update(params, opt_state, summed)
+            new_params, new_opt_state = self._aggregate_update(
+                params, opt_state, grads, payloads
+            )
             return new_params, new_opt_state, new_codec_state, loss, new_aux
 
         state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
-        in_specs = (P(), P(), state_spec, P(axis), P()) + ((P(),) if has_aux else ())
+        opt_spec = self._opt_state_spec()
+        in_specs = (P(), opt_spec, state_spec, P(axis), P()) + (
+            (P(),) if has_aux else ()
+        )
         return jax.jit(
             jax.shard_map(
                 spmd,
                 mesh=self.mesh,
                 in_specs=in_specs,
-                out_specs=(P(), P(), state_spec, P(), P()),
+                out_specs=(P(), opt_spec, state_spec, P(), P()),
                 check_vma=False,
             )
         )
@@ -426,17 +593,19 @@ class MPI_PS:
             grads = jax.tree.map(lambda g: g / accum_steps, grads)
             loss = lax.pmean(losses.mean(), axis)
             payloads, new_codec_state = self._encode_tree(grads, codec_state, rng)
-            summed = self._aggregate(grads, payloads)
-            new_params, new_opt_state = self._update(params, opt_state, summed)
+            new_params, new_opt_state = self._aggregate_update(
+                params, opt_state, grads, payloads
+            )
             return new_params, new_opt_state, new_codec_state, loss
 
         state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
+        opt_spec = self._opt_state_spec()
         return jax.jit(
             jax.shard_map(
                 spmd,
                 mesh=self.mesh,
-                in_specs=(P(), P(), state_spec, P(None, axis), P()),
-                out_specs=(P(), P(), state_spec, P()),
+                in_specs=(P(), opt_spec, state_spec, P(None, axis), P()),
+                out_specs=(P(), opt_spec, state_spec, P()),
                 check_vma=False,
             )
         )
@@ -454,7 +623,7 @@ class MPI_PS:
                 "are not separable)"
             )
         accum_steps = int(jax.tree.leaves(microbatches)[0].shape[0])
-        key = ("accum", loss_fn, accum_steps)
+        key = ("accum", _fn_cache_key(loss_fn), accum_steps)
         if key not in self._compiled:
             self._compiled[key] = self._build_accum_grad_step(loss_fn, accum_steps)
         t0 = time.perf_counter()
@@ -467,7 +636,6 @@ class MPI_PS:
         jax.block_until_ready(self.params)
         self._step_count += 1
         data["step_time"] = time.perf_counter() - t0
-        data["comm_wait"] = data["step_time"]  # fused program, as in step()
         return loss, data
 
     def _build_grads_only_step(self):
@@ -479,18 +647,20 @@ class MPI_PS:
         def spmd(params, opt_state, codec_state, grads_stacked, rng):
             grads = jax.tree.map(lambda x: x[0], grads_stacked)  # local shard
             payloads, new_codec_state = self._encode_tree(grads, codec_state, rng)
-            summed = self._aggregate(grads, payloads)
-            new_params, new_opt_state = self._update(params, opt_state, summed)
+            new_params, new_opt_state = self._aggregate_update(
+                params, opt_state, grads, payloads
+            )
             return new_params, new_opt_state, new_codec_state
 
         state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
         grads_spec = jax.tree.map(lambda _: P(axis), self.params)
+        opt_spec = self._opt_state_spec()
         return jax.jit(
             jax.shard_map(
                 spmd,
                 mesh=self.mesh,
-                in_specs=(P(), P(), state_spec, grads_spec, P()),
-                out_specs=(P(), P(), state_spec),
+                in_specs=(P(), opt_spec, state_spec, grads_spec, P()),
+                out_specs=(P(), opt_spec, state_spec),
                 check_vma=False,
             )
         )
@@ -562,7 +732,7 @@ class MPI_PS:
             if batch is None:
                 raise ValueError("loss_fn requires batch")
             has_aux = aux_state is not None
-            key = ("grad", loss_fn, has_aux)
+            key = ("grad", _fn_cache_key(loss_fn), has_aux)
             if key not in self._compiled:
                 self._compiled[key] = self._build_grad_step(loss_fn, has_aux)
             fn = self._compiled[key]
@@ -592,20 +762,22 @@ class MPI_PS:
             loss = closure()
 
         jax.block_until_ready(self.params)
+        # The fused program has no separable comm/decode/update stages —
+        # only step_time is a real measurement here; the per-stage keys
+        # stay 0.0 and instrument=True fills them with honest wall times.
         data["step_time"] = time.perf_counter() - t0
-        # In the fused program comm/decode/update are a single XLA
-        # schedule; attribute the whole wait to comm_wait like the
-        # reference's dominant term (ps.py:162).
-        data["comm_wait"] = data["step_time"]
         self._step_count += 1
         return loss, data
 
     def state_dict(self) -> Dict[str, Any]:
-        """torch.optim.Optimizer-style checkpointable state. The reference
-        inherited stock ``state_dict()`` (momentum/Adam moments live in
-        ``Optimizer.state``, SURVEY §5.4) but never called it; a drop-in
-        replacement still has to offer it. Pair with
-        ``utils.checkpoint.CheckpointManager`` for sharded on-disk saves."""
+        """Checkpointable state in this repo's schema (params/opt_state/
+        codec_state/aux_state/step_count/rng) — the role of torch's
+        ``Optimizer.state_dict()`` (which the reference inherited but never
+        called, SURVEY §5.4), NOT its format: there is no
+        ``state``/``param_groups`` layout and the dict holds live array
+        references, not copies, so it is not interchangeable with torch
+        checkpoints. Pair with ``utils.checkpoint.CheckpointManager`` for
+        sharded on-disk saves."""
         return {
             "params": self.params,
             "opt_state": tuple(self.opt_state),
@@ -637,7 +809,7 @@ class MPI_PS:
         """
         axis = self.axis_name
 
-        key = ("scan", loss_fn, unroll)
+        key = ("scan", _fn_cache_key(loss_fn), unroll)
         if key not in self._compiled:
             def spmd(params, opt_state, codec_state, batches, rng):
                 def one_step(carry, batch_and_key):
@@ -648,11 +820,9 @@ class MPI_PS:
                     payloads, codec_state = encode_tree(
                         self.code, grads, codec_state, rng, axis
                     )
-                    summed = aggregate(
-                        self.code, grads, payloads, axis, self.average, self.size,
-                        self.comm_dtype,
+                    params, opt_state = self._aggregate_update(
+                        params, opt_state, grads, payloads
                     )
-                    params, opt_state = self._update(params, opt_state, summed)
                     return (params, opt_state, codec_state), loss
 
                 n_steps = jax.tree.leaves(batches)[0].shape[0]
@@ -665,12 +835,13 @@ class MPI_PS:
 
             state_spec = jax.tree.map(lambda _: P(axis), self.codec_state)
             batch_spec = jax.tree.map(lambda _: P(None, axis), batches)
+            opt_spec = self._opt_state_spec()
             self._compiled[key] = jax.jit(
                 jax.shard_map(
                     spmd,
                     mesh=self.mesh,
-                    in_specs=(P(), P(), state_spec, batch_spec, P()),
-                    out_specs=(P(), P(), state_spec, P()),
+                    in_specs=(P(), opt_spec, state_spec, batch_spec, P()),
+                    out_specs=(P(), opt_spec, state_spec, P()),
                     check_vma=False,
                 )
             )

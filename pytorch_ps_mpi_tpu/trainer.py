@@ -58,12 +58,15 @@ class Trainer:
 
     # -- checkpoint / resume ------------------------------------------------
     def _state(self) -> Dict[str, PyTree]:
-        return {
-            "params": self.opt.params,
-            "opt_state": self.opt.opt_state,
-            "codec_state": self.opt.codec_state,
-            "step": jnp.asarray(self.step_count),
-        }
+        # Delegate to the optimizer's own state_dict so checkpoints carry
+        # everything it considers state — including the PRNG stream
+        # (stochastic codecs replay keys on resume) and aux_state (BN
+        # batch_stats), not just params/opt_state.
+        sd = dict(self.opt.state_dict())
+        sd["trainer_step"] = jnp.asarray(self.step_count)
+        if sd.get("aux_state") is None:
+            sd.pop("aux_state")  # pytree restore needs a stable structure
+        return sd
 
     def save(self) -> None:
         if self.ckpt is None:
@@ -72,19 +75,31 @@ class Trainer:
         self._last_saved_step = self.step_count
 
     def maybe_restore(self) -> bool:
-        """Resume from the latest checkpoint if one exists."""
+        """Resume from the latest checkpoint if one exists. A checkpoint
+        whose pytree structure does not match the current schema (e.g.
+        written by an older version) is reported and skipped — training
+        starts fresh rather than crashing."""
         if self.ckpt is None or self.ckpt.latest_step() is None:
             return False
-        state = self.ckpt.restore(self._state())
+        try:
+            state = self.ckpt.restore(self._state())
+        except Exception as e:
+            import sys
+
+            print(
+                f"checkpoint restore failed (incompatible schema?): {e}; "
+                "starting fresh",
+                file=sys.stderr,
+            )
+            return False
         # restored arrays may come back committed to a single device;
         # rehost to numpy so the jitted step re-shards them over the mesh
         import numpy as np
 
         state = jax.tree.map(np.asarray, state)
-        self.opt.params = state["params"]
-        self.opt.opt_state = type(self.opt.opt_state)(*state["opt_state"])
-        self.opt.codec_state = state["codec_state"]
-        self.step_count = int(state["step"])
+        self.step_count = int(state.pop("trainer_step"))
+        state.setdefault("aux_state", None)
+        self.opt.load_state_dict(state)
         return True
 
     # -- evaluation ---------------------------------------------------------

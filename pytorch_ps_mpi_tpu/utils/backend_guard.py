@@ -23,40 +23,69 @@ def enable_compilation_cache(path: str = "/tmp/jax_comp_cache") -> None:
     try:
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass
+    except (AttributeError, ValueError) as e:  # missing knobs on old JAX
+        print(f"compilation cache not enabled: {e}", file=sys.stderr)
 
 
-def ensure_live_backend(timeout: float | None = None) -> bool:
-    """Run one trivial device op in a subprocess under ``timeout`` seconds
-    (default: ``$BENCH_PROBE_TIMEOUT`` or 240). On failure, switch this
-    process to the CPU backend so callers always complete.
+def ensure_live_backend(
+    timeout: float | None = None, retries: int | None = None
+) -> bool:
+    """Probe the accelerator with one trivial device op in a subprocess,
+    retrying up to ``retries`` extra times of ``timeout`` seconds each
+    (defaults: ``$BENCH_PROBE_TIMEOUT`` or 420 s, ``$BENCH_PROBE_RETRIES``
+    or 1 — i.e. up to 14 minutes of patience, because the axon TPU tunnel
+    can take minutes to come up). Only after every attempt fails is the
+    process pinned to the CPU backend so callers always complete.
 
     Must be called before the current process initializes its JAX
-    backend. Returns True if the default backend is live.
+    backend. Returns True if the default (accelerator) backend is live —
+    callers MUST surface this (plus ``jax.default_backend()``) in any
+    reported numbers so a CPU-fallback run can never masquerade as a TPU
+    result (VERDICT r1 item 1).
     """
     import jax
 
     if timeout is None:
-        timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    try:
-        subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax; jax.block_until_ready(jax.numpy.ones((8, 8)))",
-            ],
-            timeout=timeout,
-            check=True,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        )
-        return True
-    except (subprocess.TimeoutExpired, subprocess.CalledProcessError):
-        print(
-            f"backend probe: accelerator unresponsive after {timeout:.0f}s; "
-            "falling back to CPU",
-            file=sys.stderr,
-        )
-        jax.config.update("jax_platforms", "cpu")
-        return False
+        timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "420"))
+    if retries is None:
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "1"))
+    attempts = 1 + max(0, retries)
+    for attempt in range(attempts):
+        try:
+            out = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; jax.block_until_ready(jax.numpy.ones((8, 8)));"
+                    "print(jax.default_backend())",
+                ],
+                timeout=timeout,
+                check=True,
+                capture_output=True,
+                text=True,
+            )
+            print(
+                f"backend probe: live ({out.stdout.strip()}, "
+                f"attempt {attempt + 1}/{attempts})",
+                file=sys.stderr,
+            )
+            return True
+        except subprocess.TimeoutExpired:
+            print(
+                f"backend probe: no response after {timeout:.0f}s "
+                f"(attempt {attempt + 1}/{attempts})",
+                file=sys.stderr,
+            )
+        except subprocess.CalledProcessError as e:
+            print(
+                f"backend probe: probe process failed "
+                f"(attempt {attempt + 1}/{attempts}): {e.stderr[-500:]}",
+                file=sys.stderr,
+            )
+    print(
+        f"backend probe: accelerator unresponsive after {attempts} x "
+        f"{timeout:.0f}s; falling back to CPU",
+        file=sys.stderr,
+    )
+    jax.config.update("jax_platforms", "cpu")
+    return False

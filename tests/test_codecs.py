@@ -243,3 +243,95 @@ def test_sign_pallas_matches_jnp_training_effect():
     a = np.asarray(roundtrip(SignCodec(use_pallas=True), g))
     b = np.asarray(roundtrip(SignCodec(use_pallas=False), g))
     np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+# -- threshold: the genuinely ragged codec ---------------------------------
+
+def test_threshold_length_is_data_dependent():
+    """Survivor count varies with the data — the ragged property."""
+    from pytorch_ps_mpi_tpu.codecs import ThresholdCodec
+
+    c = ThresholdCodec(tau=2.0, max_fraction=1.0)
+    spiky = jnp.zeros(64).at[jnp.array([3, 17])].set(100.0)
+    flat_g = jnp.ones(64)
+    p1, _ = c.encode(spiky, c.init_state((64,), jnp.float32))
+    p2, _ = c.encode(flat_g, c.init_state((64,), jnp.float32))
+    assert int(p1["length"]) == 2
+    assert int(p2["length"]) == 0  # nothing exceeds 2x the mean
+    assert int(p1["length"]) != int(p2["length"])
+
+
+def test_threshold_decode_masks_garbage_tail():
+    """Slots past `length` are garbage by design; decode must ignore them
+    using the sidecar (the receive half of the ragged protocol)."""
+    from pytorch_ps_mpi_tpu.codecs import ThresholdCodec
+
+    c = ThresholdCodec(tau=2.0, max_fraction=0.5)
+    g = jnp.zeros(32).at[jnp.array([5, 9])].set(jnp.array([10.0, -8.0]))
+    payload, _ = c.encode(g, c.init_state((32,), jnp.float32))
+    assert int(payload["length"]) == 2
+    # corrupt the garbage tail on the wire; decode must not change
+    bad = dict(payload)
+    bad["values"] = payload["values"].at[3:].set(999.0)
+    bad["indices"] = payload["indices"].at[3:].set(7)
+    out = c.decode(bad, (32,), jnp.float32)
+    expected = np.zeros(32); expected[5] = 10.0; expected[9] = -8.0
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_threshold_decode_sum_masks_per_worker():
+    from pytorch_ps_mpi_tpu.codecs import ThresholdCodec
+
+    c = ThresholdCodec(tau=2.0, max_fraction=0.5)
+    g1 = jnp.zeros(32).at[2].set(50.0)            # 1 survivor
+    g2 = jnp.zeros(32).at[jnp.array([2, 30])].set(jnp.array([7.0, -7.0]))
+    p1, _ = c.encode(g1, c.init_state((32,), jnp.float32))
+    p2, _ = c.encode(g2, c.init_state((32,), jnp.float32))
+    assert int(p1["length"]) != int(p2["length"])  # ragged across workers
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), p1, p2)
+    out = np.asarray(c.decode_sum(stacked, (32,), jnp.float32))
+    expected = np.zeros(32); expected[2] = 57.0; expected[30] = -7.0
+    np.testing.assert_allclose(out, expected)
+
+
+def test_threshold_cap_overflow_drops_tail():
+    from pytorch_ps_mpi_tpu.codecs import ThresholdCodec
+
+    c = ThresholdCodec(tau=0.0, max_fraction=0.25)  # everything survives
+    g = jnp.arange(1.0, 17.0)
+    payload, _ = c.encode(g, c.init_state((16,), jnp.float32))
+    assert payload["values"].shape == (4,)          # static cap
+    assert int(payload["length"]) == 4              # clamped
+    out = np.asarray(c.decode(payload, (16,), jnp.float32))
+    np.testing.assert_allclose(out[:4], np.arange(1.0, 5.0))
+    np.testing.assert_allclose(out[4:], 0.0)
+
+
+def test_threshold_adaptive_tau_tracks_target():
+    """With target_fraction set, tau rises when too much survives and the
+    kept fraction converges toward the target."""
+    from pytorch_ps_mpi_tpu.codecs import ThresholdCodec
+
+    c = ThresholdCodec(tau=0.01, max_fraction=1.0, target_fraction=0.1)
+    state = c.init_state((512,), jnp.float32)
+    kept = []
+    for i in range(30):
+        g = jax.random.normal(jax.random.key(i), (512,))
+        payload, state = c.encode(g, state)
+        kept.append(int(payload["length"]))
+    assert kept[0] > 400            # tau=0.01 keeps nearly everything
+    assert 20 <= np.mean(kept[-5:]) <= 120   # ~10% of 512 at steady state
+
+
+def test_threshold_validation():
+    from pytorch_ps_mpi_tpu.codecs import ThresholdCodec
+
+    with pytest.raises(ValueError):
+        ThresholdCodec(max_fraction=0.0)
+    with pytest.raises(ValueError):
+        ThresholdCodec(max_fraction=0.1, target_fraction=0.2)
+
+
+def test_qsgd_levels_bounded():
+    with pytest.raises(ValueError):
+        QSGDCodec(levels=200)  # would overflow the int8 payload

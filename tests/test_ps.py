@@ -119,6 +119,8 @@ def test_average_flag(mesh8):
     ("randomk", {"fraction": 0.5}),
     ("qsgd", {"levels": 16}),
     ("terngrad", {}),
+    ("threshold", {"tau": 0.5, "max_fraction": 0.5}),
+    ("threshold", {"tau": 1.0, "max_fraction": 0.5, "target_fraction": 0.25}),
 ])
 def test_codec_training_converges(mesh8, codec_name, kw):
     """Loss decreases under every codec (convergence smoke; the reference's
@@ -273,6 +275,110 @@ def test_step_accumulate_matches_big_batch(mesh8):
     jax.tree.map(
         lambda p, q: np.testing.assert_allclose(
             np.asarray(p), np.asarray(q), rtol=1e-5, atol=1e-6
+        ),
+        a.params, b.params,
+    )
+
+
+def test_leader_optimizer_state_is_sharded(mesh8):
+    """ZeRO-1 property: leader mode partitions optimizer state 1/world per
+    device instead of replicating it (VERDICT r1 item 3 — the old lowering
+    redundantly updated on every rank and broadcast identical values)."""
+    params = make_params()
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    opt = Adam(params, mesh=mesh8, lr=1e-3)
+    assert opt.mode == "allgather"
+    opt_leader = Adam(params, mesh=mesh8, lr=1e-3, mode="leader")
+    shard_len = -(-n // 8)
+    # Adam moments are flat [world, shard_len], globally covering the model
+    # once (vs. once *per device* when replicated)
+    assert opt_leader.opt_state.exp_avg.shape == (8, shard_len)
+    # and the leading axis is really partitioned over the mesh
+    spec = opt_leader.opt_state.exp_avg.sharding.spec
+    assert spec[0] == "data", spec
+    shard_devs = {
+        s.device for s in opt_leader.opt_state.exp_avg.addressable_shards
+    }
+    assert len(shard_devs) == 8
+    per_shard_elems = {
+        int(np.prod(s.data.shape))
+        for s in opt_leader.opt_state.exp_avg.addressable_shards
+    }
+    assert per_shard_elems == {shard_len}
+
+    # state stays sharded after a step
+    batch = batch_for(mesh8)
+    opt_leader.step(loss_fn=quad_loss, batch=batch)
+    assert opt_leader.opt_state.exp_avg.shape == (8, shard_len)
+    assert opt_leader.opt_state.exp_avg.sharding.spec[0] == "data"
+
+
+def test_leader_mode_adam_multi_step_equals_allgather(mesh8):
+    """Sharded Adam (moments partitioned, bias correction, multi-step state
+    carry) == replicated Adam."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    a = Adam(params, mesh=mesh8, lr=3e-2, mode="allgather")
+    b = Adam(params, mesh=mesh8, lr=3e-2, mode="leader")
+    for _ in range(5):
+        la, _ = a.step(loss_fn=quad_loss, batch=batch)
+        lb, _ = b.step(loss_fn=quad_loss, batch=batch)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-4, atol=1e-6
+        ),
+        a.params, b.params,
+    )
+
+
+def test_leader_mode_momentum_state_carry(mesh8):
+    """SGD momentum buffers live sharded across steps in leader mode."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    a = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9, mode="allgather")
+    b = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9, mode="leader")
+    for _ in range(4):
+        a.step(loss_fn=quad_loss, batch=batch)
+        b.step(loss_fn=quad_loss, batch=batch)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-4, atol=1e-6
+        ),
+        a.params, b.params,
+    )
+
+
+def test_leader_mode_with_sparse_codec(mesh8):
+    """Leader mode through the non-psum decode path (all_gather payloads →
+    decode_sum → slice local shard → sharded update)."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    opt = SGD(params, mesh=mesh8, lr=0.002, mode="leader",
+              code=get_codec("topk", fraction=0.5))
+    first, _ = opt.step(loss_fn=quad_loss, batch=batch)
+    for _ in range(20):
+        last, _ = opt.step(loss_fn=quad_loss, batch=batch)
+    assert float(last) < float(first)
+
+
+def test_leader_mode_run_steps(mesh8):
+    """Fused lax.scan multi-step works with sharded optimizer state."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    n = 4
+    batches = (
+        jnp.broadcast_to(batch[0][None], (n,) + batch[0].shape),
+        jnp.broadcast_to(batch[1][None], (n,) + batch[1].shape),
+    )
+    a = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9, mode="leader")
+    losses, _ = a.run_steps(quad_loss, batches)
+    b = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9, mode="allgather")
+    for _ in range(n):
+        b.step(loss_fn=quad_loss, batch=batch)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-4, atol=1e-6
         ),
         a.params, b.params,
     )
