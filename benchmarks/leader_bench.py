@@ -1,0 +1,101 @@
+"""leader (ZeRO-1 sharded PS) vs allgather (replicated step) — Adam.
+
+The measured case for the leader topology (VERDICT r1 item 3): both modes
+move the same gradient bytes over the interconnect (psum and
+reduce_scatter+all_gather are the same 2(w-1)/w·n volume), but leader
+divides the *update* FLOPs and the optimizer-state memory by world size:
+
+  allgather: every device steps the full model -> w·n update work total,
+             3n floats of Adam state per device
+  leader:    each device steps its 1/w flat shard -> n update work total,
+             3n/w floats of Adam state per device
+
+Run: ``python benchmarks/leader_bench.py [n_elems]`` (defaults ~11M on an
+8-device virtual CPU mesh; on real hardware use the ambient devices).
+Prints a table + one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# leader mode needs a multi-device mesh; the one tunneled TPU chip can't
+# host one, so this benchmark runs on the 8-device virtual CPU mesh (the
+# flag only affects the host platform; harmless elsewhere)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu import Adam
+
+REPS = 10
+
+
+def bench_mode(mode: str, params, grads) -> tuple[float, int]:
+    opt = Adam(params, lr=1e-3, mode=mode)
+    opt.step(grads=grads)  # compile
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        opt.step(grads=grads)
+        times.append(time.perf_counter() - t0)
+    # per-device optimizer-state bytes: leader's moments are sharded over
+    # the mesh, allgather's replicated on every device
+    state_elems = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(tuple(opt.opt_state)[1:])
+    )
+    world = opt.size
+    per_device_state = state_elems * 4 // (world if mode == "leader" else 1)
+    return min(times), per_device_state
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 11_000_000
+    # ~60 tensors like ResNet-18's parameter list
+    k = jax.random.key(0)
+    sizes = [n // 60] * 59 + [n - 59 * (n // 60)]
+    params = {f"p{i}": jnp.zeros((s,), jnp.float32) for i, s in enumerate(sizes)}
+    world = len(jax.devices())
+    grads = {
+        name: jax.random.normal(jax.random.fold_in(k, i), (world,) + p.shape)
+        for i, (name, p) in enumerate(params.items())
+    }
+
+    t_all, mem_all = bench_mode("allgather", params, grads)
+    t_lead, mem_lead = bench_mode("leader", params, grads)
+
+    print(f"backend={jax.default_backend()} world={world} n={n}")
+    print("| mode | step ms | adam state bytes/device |")
+    print("|---|---|---|")
+    print(f"| allgather | {t_all*1e3:.2f} | {mem_all/1e6:.1f} MB |")
+    print(f"| leader    | {t_lead*1e3:.2f} | {mem_lead/1e6:.1f} MB |")
+    print(
+        json.dumps(
+            {
+                "metric": "adam_11M_leader_vs_allgather_step_speedup",
+                "value": round(t_all / t_lead, 3),
+                "unit": "x",
+                "vs_baseline": round(t_all / t_lead, 3),
+                "backend": jax.default_backend(),
+                "leader_step_ms": round(t_lead * 1e3, 3),
+                "allgather_step_ms": round(t_all * 1e3, 3),
+                "state_bytes_per_device_ratio": mem_all / mem_lead,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -90,6 +90,12 @@ def broadcast_from_leader(x: jax.Array, axis_name: str) -> jax.Array:
 
 
 def broadcast_from_leader_tree(tree: PyTree, axis_name: str) -> PyTree:
+    """Tree-mapped :func:`broadcast_from_leader` — the parameter read-back
+    of a broadcast-topology PS (reference ``ibroadcast`` of the whole
+    param dict, ``mpi_comms.py:127-133``). The optimizer's leader mode now
+    uses the sharded ZeRO-1 lowering instead (``ps.leader_shard_update``);
+    this remains the comms-layer primitive for replicating any leader-held
+    pytree (e.g. initial params in a custom loop)."""
     idx_is_leader = lax.axis_index(axis_name) == 0
     def bcast(x):
         return lax.psum(jnp.where(idx_is_leader, x, jnp.zeros_like(x)), axis_name)

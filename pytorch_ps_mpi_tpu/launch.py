@@ -30,6 +30,10 @@ def main(argv=None):
                     default=int(os.environ.get("PS_NUM_PROCESSES", "0")) or None)
     ap.add_argument("--process-id", type=int,
                     default=int(os.environ.get("PS_PROCESS_ID", "-1")))
+    ap.add_argument("--platform", default=os.environ.get("PS_PLATFORM"),
+                    help="pin the JAX platform (e.g. 'cpu') before "
+                         "distributed init — needed on hosts whose "
+                         "accelerator plugin ignores JAX_PLATFORMS")
     ap.add_argument("script", help="user training script (runs as __main__)")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -41,6 +45,11 @@ def main(argv=None):
             "--num-processes/--process-id given without --coordinator "
             "(or PS_COORDINATOR): the job would silently run single-process"
         )
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     from pytorch_ps_mpi_tpu.mesh import initialize_distributed
 

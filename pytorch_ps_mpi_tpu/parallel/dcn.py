@@ -97,12 +97,115 @@ def _unflatten(flat: np.ndarray, template: PyTree) -> PyTree:
     return jax.tree.unflatten(treedef, out)
 
 
+class CodecWire:
+    """Fixed-spec byte wire for codec payloads over the shm mailboxes.
+
+    The reference's codec placement — encode before send, decode on
+    receive (``ps.py:94,166``) — applied to the async PS path: the worker
+    encodes on device and ships the payload *bytes*; the server decodes
+    back to a gradient. Because payload shapes are static, the wire spec
+    (leaf shapes/dtypes/order) is fixed at construction — the reference's
+    per-message two-phase size exchange (``mpi_comms.py:144-174``)
+    collapses to a one-time agreement, and the mailbox slot is sized to
+    the spec exactly (no ``max_bytes`` high-water growth).
+    """
+
+    def __init__(self, code, template: PyTree, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.code = code
+        leaves, self.treedef = jax.tree.flatten(template)
+        self.shapes = [tuple(np.shape(l)) for l in leaves]
+        self.dtypes = [np.asarray(l).dtype for l in leaves]
+
+        def one_struct(shape, dtype):
+            return jax.eval_shape(
+                lambda: code.encode(
+                    jnp.zeros(shape, dtype),
+                    code.init_state(shape, dtype),
+                    jax.random.key(0) if code.needs_rng else None,
+                )
+            )[0]
+
+        self._payload_structs = [
+            one_struct(s, d) for s, d in zip(self.shapes, self.dtypes)
+        ]
+        self._flat_specs = [  # (shape, dtype) in wire order
+            (tuple(x.shape), np.dtype(x.dtype))
+            for ps in self._payload_structs
+            for x in jax.tree.leaves(ps)
+        ]
+        self.wire_bytes = sum(
+            int(np.prod(s)) * d.itemsize if s else d.itemsize
+            for s, d in self._flat_specs
+        )
+        self.raw_bytes = _flat_size(template) * 4
+        self._states = [
+            code.init_state(s, d) for s, d in zip(self.shapes, self.dtypes)
+        ]
+        self._rng = jax.random.key(seed)
+
+        def enc_all(grad_leaves, states, keys):
+            payloads, new_states = [], []
+            for i, (g, st) in enumerate(zip(grad_leaves, states)):
+                k = keys[i] if keys is not None else None
+                p, s2 = code.encode(g, st, k)
+                payloads.append(p)
+                new_states.append(s2)
+            return payloads, new_states
+
+        def dec_all(payloads):
+            return [
+                code.decode(p, s, d)
+                for p, s, d in zip(payloads, self.shapes, self.dtypes)
+            ]
+
+        self._enc = jax.jit(enc_all)
+        self._dec = jax.jit(dec_all)
+
+    def encode_to_bytes(self, grad_tree: PyTree) -> bytes:
+        import jax
+
+        grad_leaves = self.treedef.flatten_up_to(grad_tree)
+        keys = None
+        if self.code.needs_rng:
+            self._rng, sub = jax.random.split(self._rng)
+            keys = list(jax.random.split(sub, len(grad_leaves)))
+        payloads, self._states = self._enc(grad_leaves, self._states, keys)
+        return b"".join(
+            np.asarray(x).tobytes() for p in payloads for x in jax.tree.leaves(p)
+        )
+
+    def decode_from_bytes(self, buf: bytes) -> PyTree:
+        import jax
+
+        arrays, off = [], 0
+        for shape, dtype in self._flat_specs:
+            n = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
+            arrays.append(np.frombuffer(buf[off:off + n], dtype).reshape(shape))
+            off += n
+        payloads, i = [], 0
+        for ps in self._payload_structs:
+            struct = jax.tree.structure(ps)
+            payloads.append(
+                jax.tree.unflatten(struct, arrays[i:i + struct.num_leaves])
+            )
+            i += struct.num_leaves
+        decoded = self._dec(payloads)
+        return jax.tree.unflatten(
+            self.treedef, [np.asarray(x) for x in decoded]
+        )
+
+
 class ShmPSServer:
     """Owns params; publishes snapshots, consumes gradients in arrival
-    order (the PS side of the reference's rank-0 loop, README.md:61-77)."""
+    order (the PS side of the reference's rank-0 loop, README.md:61-77).
+    With ``code=`` the mailboxes carry encoded payload bytes (see
+    :class:`CodecWire`) and the server decodes on receive."""
 
     def __init__(self, name: str, num_workers: int, template: PyTree,
-                 max_staleness: int = 4):
+                 max_staleness: int = 4, code=None):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -110,18 +213,41 @@ class ShmPSServer:
         self.template = template
         self.num_workers = num_workers
         self.max_staleness = max_staleness
+        self.wire = CodecWire(code, template) if code is not None else None
         nbytes = _flat_size(template) * 4
-        self._h = lib.psq_create(name.encode(), num_workers, nbytes, nbytes)
+        grad_slot = self.wire.wire_bytes if self.wire else nbytes
+        self._h = lib.psq_create(name.encode(), num_workers, nbytes, grad_slot)
         if not self._h:
             raise RuntimeError(f"psq_create({name}) failed")
         self.version = 0
-        self._grad_buf = np.empty(_flat_size(template), np.float32)
+        if self.wire:
+            self._grad_buf = np.empty(self.wire.wire_bytes, np.uint8)
+        else:
+            self._grad_buf = np.empty(_flat_size(template), np.float32)
         self.stale_drops = 0
         self.staleness_seen: Dict[int, int] = {}
+        self.grads_received = 0
+        self.bytes_received = 0
         # failure/straggler detection (absent in the reference, SURVEY
         # §5.3: MPI aborted the whole job; here the server observes)
         self.last_seen: Dict[int, float] = {}
         self._t0 = time.time()
+
+    def metrics(self) -> Dict[str, float]:
+        """Server-side wire observability: grads consumed, payload bytes,
+        and the codec's compression ratio vs the raw f32 wire (the
+        reference's ``msg_bytes``/``packaged_bytes`` pair, ``ps.py:135-136``,
+        measured on the live async path)."""
+        raw = self.wire.raw_bytes if self.wire else _flat_size(self.template) * 4
+        wire = self.wire.wire_bytes if self.wire else raw
+        return {
+            "grads_received": float(self.grads_received),
+            "bytes_received": float(self.bytes_received),
+            "raw_bytes_per_grad": float(raw),
+            "wire_bytes_per_grad": float(wire),
+            "compression_ratio": raw / wire,
+            "stale_drops": float(self.stale_drops),
+        }
 
     def publish(self, params: PyTree) -> None:
         flat = _flatten(params)
@@ -150,11 +276,29 @@ class ShmPSServer:
         staleness = self.version - int(version.value)
         self.staleness_seen[staleness] = self.staleness_seen.get(staleness, 0) + 1
         self.last_seen[int(worker.value)] = time.time()
+        self.grads_received += 1
+        self.bytes_received += int(n)
         if staleness > self.max_staleness:
             self.stale_drops += 1
             return self.poll_grad()
-        flat = self._grad_buf[: n // 4].copy()
-        return int(worker.value), int(version.value), _unflatten(flat, self.template)
+        expected = self.wire.wire_bytes if self.wire else _flat_size(self.template) * 4
+        if int(n) != expected:
+            # the wire spec is a one-time agreement — enforce it, or a
+            # worker running a different codec config would crash the
+            # decode (short payload) or silently corrupt gradients
+            # (same-size different layout)
+            raise RuntimeError(
+                f"payload size {n} != wire spec {expected} bytes: worker "
+                "and server codec configs disagree"
+            )
+        if self.wire:
+            grad = self.wire.decode_from_bytes(
+                self._grad_buf[:n].tobytes()
+            )
+        else:
+            flat = self._grad_buf[: n // 4].copy()
+            grad = _unflatten(flat, self.template)
+        return int(worker.value), int(version.value), grad
 
     def stragglers(self, timeout: float) -> Dict[int, float]:
         """Workers with no sign of life for ``timeout`` seconds: no
@@ -192,7 +336,7 @@ class ShmPSWorker:
     gradients (the worker side of AsySG-InCon's inconsistent reads)."""
 
     def __init__(self, name: str, worker_id: int, template: PyTree,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, code=None, seed: int = 0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -209,6 +353,12 @@ class ShmPSWorker:
             raise TimeoutError(f"psq_open({name}) timed out")
         self.worker_id = worker_id
         self.template = template
+        # worker's wire must agree with the server's (same codec config);
+        # stochastic codecs get a per-worker PRNG stream
+        self.wire = (
+            CodecWire(code, template, seed=seed + worker_id)
+            if code is not None else None
+        )
         self._param_buf = np.empty(_flat_size(template), np.float32)
 
     def read_params(self, timeout: float = 30.0) -> Tuple[PyTree, int]:
@@ -234,7 +384,12 @@ class ShmPSWorker:
 
     def push_grad(self, grad: PyTree, version: int,
                   timeout: float = 30.0) -> None:
-        flat = _flatten(grad)
+        if self.wire:
+            # encode-before-send (reference ps.py:94): only payload bytes
+            # ever enter the mailbox
+            flat = np.frombuffer(self.wire.encode_to_bytes(grad), np.uint8).copy()
+        else:
+            flat = _flatten(grad)
         deadline = time.time() + timeout
         while time.time() < deadline:
             rc = self._lib.psq_push_grad(

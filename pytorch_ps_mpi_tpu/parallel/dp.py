@@ -15,11 +15,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from pytorch_ps_mpi_tpu import comms
 from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
 from pytorch_ps_mpi_tpu.mesh import DATA_AXIS
 from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
-from pytorch_ps_mpi_tpu.ps import aggregate, encode_tree
+from pytorch_ps_mpi_tpu.ps import (
+    aggregate,
+    encode_tree,
+    leader_init_state,
+    leader_scatter_shards,
+    leader_shard_update,
+    leader_slice_shards,
+    leader_state_spec,
+)
 
 PyTree = Any
 
@@ -55,25 +62,42 @@ def make_sync_train_step(
                 lambda x: jnp.broadcast_to(x[None], (size,) + x.shape), s
             )
         codec_state = jax.tree.map(leaf, params)
+        if mode == "leader":
+            # ZeRO-1: master param shards + sharded inner state (see
+            # ps.LeaderState); the step all-gathers fresh replicated params
+            return leader_init_state(params, init_state, size), codec_state
         return init_state(params), codec_state
 
     def spmd(params, opt_state, codec_state, batch, rng):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         loss = lax.pmean(loss, axis_name)
         payloads, new_codec_state = encode_tree(code, grads, codec_state, rng, axis_name)
-        summed = aggregate(code, grads, payloads, axis_name, average, size)
-        new_params, new_opt_state = update_fn(params, summed, opt_state, h)
         if mode == "leader":
-            new_params = comms.broadcast_from_leader_tree(new_params, axis_name)
+            if code.supports_psum:
+                grad_shards = leader_scatter_shards(
+                    grads, axis_name, size, average=average
+                )
+            else:
+                summed = aggregate(code, grads, payloads, axis_name, average, size)
+                grad_shards = leader_slice_shards(summed, axis_name, size)
+            new_params, new_opt_state = leader_shard_update(
+                params, opt_state, grad_shards, update_fn, h, axis_name
+            )
+        else:
+            summed = aggregate(code, grads, payloads, axis_name, average, size)
+            new_params, new_opt_state = update_fn(params, summed, opt_state, h)
         return new_params, new_opt_state, new_codec_state, loss
 
     def step_fn(params, opt_state, codec_state, batch, rng):
         state_spec = jax.tree.map(lambda _: P(axis_name), codec_state)
+        opt_spec = (
+            leader_state_spec(opt_state, axis_name) if mode == "leader" else P()
+        )
         mapped = jax.shard_map(
             spmd,
             mesh=mesh,
-            in_specs=(P(), P(), state_spec, P(axis_name), P()),
-            out_specs=(P(), P(), state_spec, P()),
+            in_specs=(P(), opt_spec, state_spec, P(axis_name), P()),
+            out_specs=(P(), opt_spec, state_spec, P()),
             check_vma=False,
         )
         return mapped(params, opt_state, codec_state, batch, rng)

@@ -25,11 +25,12 @@ What changed architecturally (SURVEY §3.1 vs. this file):
   decentralized path (every rank decodes+steps redundantly, ``ps.py:75``);
   ``mode='leader'`` is the rank-0 PS path (gather→step-on-leader→broadcast,
   ``mpi_comms.py:60-133``, README pseudo-code), lowered TPU-natively as a
-  ZeRO-1 sharded-optimizer step: reduce_scatter the summed gradient, each
-  worker updates only its 1/world flat shard (owning that shard's optimizer
-  state), then all_gather the updated shards. Same numerics, but update
-  FLOPs and optimizer-state memory divide by world size instead of every
-  rank redundantly stepping the full model.
+  ZeRO-1 sharded-optimizer step: per-leaf reduce_scatter of the summed
+  gradient, each worker updates only its 1/world shard (owning that
+  shard's optimizer state AND the master parameter copy, see
+  :class:`LeaderState`), then all_gather the updated shards. Same
+  numerics, but update FLOPs and optimizer-state memory divide by world
+  size instead of every rank redundantly stepping the full model.
 
 Async (AsySG-InCon) training lives in ``parallel/async_ps.py``.
 """
@@ -37,7 +38,7 @@ Async (AsySG-InCon) training lives in ``parallel/async_ps.py``.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -67,25 +68,102 @@ def _tree_size(tree: PyTree) -> int:
     return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
 
 
-def _flatten_f32(tree: PyTree, n_pad: int) -> jax.Array:
-    """Concatenate all leaves into one zero-padded f32 vector of length
-    ``n_pad`` (the flat layout the leader-PS shards over workers)."""
-    flat = jnp.concatenate(
-        [jnp.ravel(x).astype(jnp.float32) for x in jax.tree.leaves(tree)]
+class LeaderState(NamedTuple):
+    """Optimizer state for ``mode='leader'`` (ZeRO-1): each worker owns a
+    1/world shard of every parameter (``param_shards`` leaves are
+    ``[world, shard_len]``, partitioned over the mesh) plus the matching
+    shard of the inner optimizer state. The master copy of the parameters
+    lives HERE, sharded — the replicated ``MPI_PS.params`` is the
+    all-gathered working copy for the forward pass, re-derived every step
+    (so reassigning ``opt.params`` directly is overwritten; go through
+    ``load_state_dict``)."""
+
+    param_shards: Any
+    inner: Any
+
+
+def _to_shards(x: jax.Array, world: int) -> jax.Array:
+    """ravel + zero-pad to a multiple of ``world`` + reshape so row r is
+    worker r's shard (the layout ``lax.psum_scatter``/``all_gather``
+    tiled=True use)."""
+    flat = jnp.ravel(x)
+    ss = -(-flat.shape[0] // world)
+    return jnp.pad(flat, (0, ss * world - flat.shape[0])).reshape(world, ss)
+
+
+def leader_init_state(params: PyTree, init_state: Callable, world: int) -> LeaderState:
+    """Host-side construction of the sharded leader (ZeRO-1) state: the
+    master param shards plus the inner optimizer state, leaves stacked
+    ``[world, shard_len]`` for a ``P(axis)`` sharding."""
+    shards = jax.tree.map(lambda p: _to_shards(p, world), params)
+    shard_tmpl = jax.tree.map(lambda s: jnp.zeros(s.shape[1:], s.dtype), shards)
+    inner = init_state(shard_tmpl)
+    inner = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (world,) + x.shape)
+        if x.ndim > 0 else x,
+        inner,
     )
-    return jnp.pad(flat, (0, n_pad - flat.shape[0]))
+    return LeaderState(shards, inner)
 
 
-def _unflatten_like(flat: jax.Array, like: PyTree) -> PyTree:
-    """Inverse of :func:`_flatten_f32`: split ``flat`` back into ``like``'s
-    leaf shapes/dtypes (padding tail dropped)."""
-    leaves, treedef = jax.tree.flatten(like)
-    out, i = [], 0
-    for l in leaves:
-        n = int(np.prod(l.shape))
-        out.append(lax.slice(flat, (i,), (i + n,)).reshape(l.shape).astype(l.dtype))
-        i += n
-    return jax.tree.unflatten(treedef, out)
+def leader_state_spec(opt_state: LeaderState, axis_name: str):
+    """PartitionSpec pytree for :class:`LeaderState` (arrays sharded over
+    ``axis_name``, scalars replicated)."""
+    return jax.tree.map(
+        lambda x: P(axis_name) if x.ndim > 0 else P(), opt_state
+    )
+
+
+def leader_scatter_shards(
+    grads: PyTree, axis_name: str, world: int, comm_dtype=None,
+    average: bool = False,
+) -> PyTree:
+    """Per-leaf reduce_scatter of local gradients: each worker receives
+    only its shard's cross-worker sum (half of a psum's work)."""
+
+    def scatter(g):
+        rows = _to_shards(g, world).reshape(-1)  # row-major == tiled layout
+        if comm_dtype is not None:
+            rows = rows.astype(comm_dtype)
+        sh = lax.psum_scatter(
+            rows, axis_name, scatter_dimension=0, tiled=True
+        ).astype(g.dtype)
+        return sh / world if average else sh
+
+    return jax.tree.map(scatter, grads)
+
+
+def leader_slice_shards(summed: PyTree, axis_name: str, world: int) -> PyTree:
+    """When every worker already holds the full summed gradient (non-psum
+    codec decode path), index out each leaf's local shard row."""
+    idx = lax.axis_index(axis_name)
+    return jax.tree.map(
+        lambda g: _to_shards(g, world)[idx], summed
+    )
+
+
+def leader_shard_update(
+    params: PyTree, opt_state: LeaderState, grad_shards: PyTree,
+    update_fn: Callable, hyper, axis_name: str,
+) -> Tuple[PyTree, LeaderState]:
+    """Shard-local optimizer step + all_gather back to replicated params
+    (runs inside shard_map; ``opt_state`` leaves carry the local ``[1,
+    shard_len]`` slice)."""
+    p_shards = jax.tree.map(lambda x: x[0], opt_state.param_shards)
+    inner = jax.tree.map(lambda x: x[0] if x.ndim > 0 else x, opt_state.inner)
+    new_shards, new_inner = update_fn(p_shards, grad_shards, inner, hyper)
+
+    def gather(sh, p):
+        full = lax.all_gather(sh, axis_name, tiled=True)
+        n = int(np.prod(p.shape)) if p.shape else 1
+        return lax.slice(full, (0,), (n,)).reshape(p.shape)
+
+    new_params = jax.tree.map(gather, new_shards, params)
+    new_opt_state = LeaderState(
+        jax.tree.map(lambda x: x[None], new_shards),
+        jax.tree.map(lambda x: x[None] if x.ndim > 0 else x, new_inner),
+    )
+    return new_params, new_opt_state
 
 
 class _IdKey:
@@ -213,9 +291,8 @@ class MPI_PS:
       mode: ``'allgather'`` (decentralized replicated step — the
         reference's live path) or ``'leader'`` (PS topology: the update
         runs once, sharded over workers ZeRO-1 style, not redundantly —
-        optimizer state is partitioned 1/world per device; internally the
-        update runs on a flat f32 vector, so non-f32 params are cast
-        through f32).
+        optimizer state and the master parameter copy are partitioned
+        1/world per device, per leaf, preserving leaf dtypes).
       average: if True, average worker gradients instead of the
         reference's sum semantics (``ps.py:176``).
       instrument: if True, ``step`` runs the pipeline as separate stages
@@ -259,25 +336,18 @@ class MPI_PS:
         self.size = int(self.mesh.shape[axis_name])  # reference ps.py:73
         if mode == "leader":
             # ZeRO-1-style sharded optimizer: each worker owns a 1/world
-            # shard of the flat parameter vector and the optimizer state
-            # for it — the TPU-native lowering of the reference's rank-0
-            # PS (gather to rank 0, rank 0 alone steps, broadcast back,
+            # shard of every parameter and the optimizer state for it —
+            # the TPU-native lowering of the reference's rank-0 PS
+            # (gather to rank 0, rank 0 alone steps, broadcast back,
             # mpi_comms.py:60-133, README.md:61-77), generalized so every
-            # chip is the "leader" of its own shard: reduce_scatter →
-            # shard-local update → all_gather. Update FLOPs and optimizer
-            # state memory divide by world size; comm volume matches a
-            # psum. Internally flat f32 (leaves cast back on unflatten).
-            n = _tree_size(params)
-            self._shard_len = -(-n // self.size)  # ceil
-            self._n_pad = self._shard_len * self.size
-            flat_shard = jnp.zeros((self._shard_len,), jnp.float32)
-            st = init_state(flat_shard)
-            stacked = jax.tree.map(
-                lambda x: jnp.broadcast_to(x[None], (self.size,) + x.shape)
-                if x.ndim > 0 else x,
-                st,
-            )
+            # chip is the "leader" of its own shard: per-leaf
+            # reduce_scatter → shard-local update → all_gather. Update
+            # FLOPs and optimizer-state memory divide by world size; comm
+            # volume matches a psum (which IS reduce_scatter+all_gather
+            # on a ring). Per-leaf sharding (not one flat concat)
+            # preserves leaf dtypes and lets XLA fuse per-tensor.
             from jax.sharding import NamedSharding
+
             self.opt_state = jax.tree.map(
                 lambda x: jax.device_put(
                     x,
@@ -285,7 +355,7 @@ class MPI_PS:
                         self.mesh, P(axis_name) if x.ndim > 0 else P()
                     ),
                 ),
-                stacked,
+                leader_init_state(params, init_state, self.size),
             )
         else:
             self.opt_state = init_state(params)
@@ -318,53 +388,28 @@ class MPI_PS:
     def _update(self, params, opt_state, summed):
         if self.mode == "leader":
             # Every rank already holds the full summed gradient (non-psum
-            # codec decode path, or the instrumented stages); slice out the
-            # local shard and run the sharded step.
-            flat = _flatten_f32(summed, self._n_pad)
-            idx = lax.axis_index(self.axis_name)
-            shard = lax.dynamic_slice(
-                flat, (idx * self._shard_len,), (self._shard_len,)
+            # codec decode path, or the instrumented stages); slice out
+            # each leaf's local shard and run the sharded step.
+            grad_shards = leader_slice_shards(summed, self.axis_name, self.size)
+            return leader_shard_update(
+                params, opt_state, grad_shards, self._update_fn, self.hyper,
+                self.axis_name,
             )
-            return self._leader_shard_update(params, opt_state, shard)
         return self._update_fn(params, summed, opt_state, self.hyper)
-
-    def _leader_shard_update(self, params, opt_state, grad_shard):
-        """The PS step proper: this worker is the parameter server for its
-        flat shard — update it with its slice of the optimizer state, then
-        all-gather the updated shards back to replicated parameters (the
-        reference's step-on-leader + broadcast, mpi_comms.py:107-133, with
-        the leader role partitioned across the mesh)."""
-        axis = self.axis_name
-        idx = lax.axis_index(axis)
-        flat_params = _flatten_f32(params, self._n_pad)
-        p_shard = lax.dynamic_slice(
-            flat_params, (idx * self._shard_len,), (self._shard_len,)
-        )
-        st = jax.tree.map(lambda x: x[0] if x.ndim > 0 else x, opt_state)
-        new_p_shard, new_st = self._update_fn(p_shard, grad_shard, st, self.hyper)
-        new_flat = lax.all_gather(new_p_shard, axis, tiled=True)
-        new_params = _unflatten_like(new_flat, params)
-        new_opt_state = jax.tree.map(
-            lambda x: x[None] if x.ndim > 0 else x, new_st
-        )
-        return new_params, new_opt_state
 
     def _aggregate_update(self, params, opt_state, grads, payloads):
         """Aggregate + update, choosing the cheapest lowering per mode:
         in leader mode with a psum-capable codec the full allreduce is
-        replaced by ``psum_scatter`` (half the collective of psum — each
-        worker receives only its shard's sum), then shard-update +
-        all_gather."""
+        replaced by per-leaf ``psum_scatter`` (each worker receives only
+        its shard's sum), then shard-update + all_gather."""
         if self.mode == "leader" and self.code.supports_psum:
-            flat = _flatten_f32(grads, self._n_pad)
-            if self.comm_dtype is not None:
-                flat = flat.astype(self.comm_dtype)
-            shard = lax.psum_scatter(
-                flat, self.axis_name, scatter_dimension=0, tiled=True
-            ).astype(jnp.float32)
-            if self.average:
-                shard = shard / self.size
-            return self._leader_shard_update(params, opt_state, shard)
+            grad_shards = leader_scatter_shards(
+                grads, self.axis_name, self.size, self.comm_dtype, self.average
+            )
+            return leader_shard_update(
+                params, opt_state, grad_shards, self._update_fn, self.hyper,
+                self.axis_name,
+            )
         summed = self._aggregate(grads, payloads)
         return self._update(params, opt_state, summed)
 
@@ -373,9 +418,7 @@ class MPI_PS:
         over the mesh axis in leader mode (ZeRO-1), replicated otherwise."""
         if self.mode != "leader":
             return P()
-        return jax.tree.map(
-            lambda x: P(self.axis_name) if x.ndim > 0 else P(), self.opt_state
-        )
+        return leader_state_spec(self.opt_state, self.axis_name)
 
     # -- compiled step builders -------------------------------------------
     def _build_instrumented_stages(self, loss_fn):

@@ -1,0 +1,67 @@
+"""SPMD worker for ``test_distributed.py`` — NOT a pytest file.
+
+Run on 2 coordinated processes via the launcher (the reference's
+``mpirun -n 2 py.test`` harness, ``Makefile:2-3``, rebuilt on
+``jax.distributed``):
+
+  python -m pytorch_ps_mpi_tpu.launch --platform cpu \
+      --coordinator localhost:PORT --num-processes 2 --process-id R \
+      tests/distributed_worker.py
+
+Each process owns ONE local CPU device; the global mesh spans both.
+Asserts (rank-parameterized golden data, the reference's oracle pattern):
+one cross-process allreduce, one ``MPI_PS.step`` in each topology mode
+equal to the single-process oracle.
+"""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+    assert len(jax.devices()) == 2, jax.devices()
+
+    from pytorch_ps_mpi_tpu import SGD, comms
+    from pytorch_ps_mpi_tpu.mesh import make_mesh
+
+    mesh = make_mesh()
+
+    # 1) cross-process allreduce: shard r carries r+1; sum must be 3 on
+    #    both processes (reference test_comms.py oracle style)
+    x = (np.arange(2.0).reshape(2, 1) + 1.0).astype(np.float32)
+    out = comms.host_allreduce_sum(jnp.asarray(x), mesh)
+    np.testing.assert_allclose(np.asarray(out).reshape(()), 3.0)
+    print(f"allreduce ok rank={rank}", flush=True)
+
+    # 2) one MPI_PS.step per topology == single-process oracle:
+    #    worker 0 sends grad=1, worker 1 sends grad=2, sum=3, lr=0.5
+    params = {"w": jnp.ones((3, 2)), "b": jnp.zeros((2,))}
+    grads = jax.tree.map(
+        lambda p: np.stack(
+            [np.full(p.shape, 1.0), np.full(p.shape, 2.0)]
+        ).astype(np.float32),
+        params,
+    )
+    for mode in ("allgather", "leader"):
+        opt = SGD(params, mesh=mesh, lr=0.5, mode=mode)
+        opt.step(grads=grads)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b) - 1.5, rtol=1e-6
+            ),
+            opt.params,
+            params,
+        )
+        print(f"step ok rank={rank} mode={mode}", flush=True)
+
+    print(f"PS_TEST_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

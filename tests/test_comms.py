@@ -131,3 +131,20 @@ def test_ragged_all_gather_with_threshold_codec(mesh8):
     for r in range(8):
         expected[:r] += 100.0
     np.testing.assert_allclose(np.asarray(summed), expected)
+
+
+def test_broadcast_from_leader_tree(mesh8):
+    """Whole-pytree leader broadcast (reference ibroadcast of the param
+    dict, mpi_comms.py:127-133)."""
+    def spmd(x):
+        r = lax.axis_index("data").astype(jnp.float32)
+        tree = {"a": x[0] * 0 + r, "b": x[0] * 0 + 10.0 * (r + 1)}
+        return comms.broadcast_from_leader_tree(tree, "data")
+
+    fn = jax.jit(
+        jax.shard_map(spmd, mesh=mesh8, in_specs=P("data"),
+                      out_specs=P("data"), check_vma=False)
+    )
+    out = fn(jnp.ones((8, 1)))
+    np.testing.assert_allclose(np.asarray(out["a"]).ravel(), 0.0)   # leader rank 0
+    np.testing.assert_allclose(np.asarray(out["b"]).ravel(), 10.0)

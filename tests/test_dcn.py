@@ -165,3 +165,80 @@ def test_pending_grad_counts_as_alive():
         w.close()
     finally:
         server.close()
+
+
+# -- codecs on the async wire (VERDICT r1 item 5) --------------------------
+
+def _codec_worker_loop(name, worker_id, n_pushes, code):
+    w = dcn.ShmPSWorker(name, worker_id, TEMPLATE, code=code)
+    try:
+        for _ in range(n_pushes):
+            params, version = w.read_params()
+            grad = {"w": params["w"] - TARGET}
+            w.push_grad(grad, version)
+    finally:
+        w.close()
+
+
+@pytest.mark.parametrize("codec_name,kw,min_ratio,atol,pushes", [
+    ("sign", {"use_pallas": False}, 4.0, 0.3, 40),   # 5B vs 24B on the wire
+    ("int8", {"use_pallas": False}, 2.0, 5e-2, 40),  # 10B vs 24B
+    # ragged wire: per-message true length varies as coordinates reach the
+    # target and leave the |g|>0 mask (uncapped so convergence is exact;
+    # cap-overflow dynamics are covered deterministically in test_codecs)
+    ("threshold", {"tau": 0.0, "max_fraction": 1.0}, 0.4, 1e-2, 40),
+])
+def test_codec_compressed_mailbox_trains(codec_name, kw, min_ratio, atol, pushes):
+    """Training through a codec-compressed mailbox: encode on the worker,
+    payload bytes (only) through the psqueue, decode+apply on the server
+    (reference codec placement, ps.py:94,166). The server's metrics
+    report the live compression ratio."""
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+
+    name = f"/psq_test_{os.getpid()}_{codec_name[:3]}"
+    code = get_codec(codec_name, **kw)
+    server = dcn.ShmPSServer(name, num_workers=2, template=TEMPLATE, code=code)
+    try:
+        threads = [
+            threading.Thread(
+                target=_codec_worker_loop,
+                args=(name, i, pushes, get_codec(codec_name, **kw)),
+            )
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        # sign's per-coordinate step is lr*mean|residual| independent of
+        # the coordinate's own size — needs a larger lr to close the big
+        # coordinates within the push budget (oscillation self-damps as
+        # mean|residual| shrinks)
+        lr = 0.3 if codec_name == "sign" else 0.2
+        total = 2 * pushes
+        params, got = _serve(server, total_grads=total, lr=lr, timeout=120.0)
+        for t in threads:
+            t.join(timeout=15)
+        assert got == total
+        np.testing.assert_allclose(params["w"], TARGET, atol=atol)
+        m = server.metrics()
+        assert m["compression_ratio"] >= min_ratio, m
+        assert m["grads_received"] == total
+        # every mailbox payload was the encoded wire size, not raw f32
+        assert m["bytes_received"] == total * m["wire_bytes_per_grad"]
+    finally:
+        server.close()
+
+
+def test_codec_wire_spec_roundtrip():
+    """CodecWire byte round-trip is exact for the identity codec and
+    shape-preserving for lossy ones."""
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+
+    template = {"a": np.zeros((5, 3), np.float32), "b": np.zeros((7,), np.float32)}
+    wire = dcn.CodecWire(get_codec("identity"), template)
+    grad = {"a": np.arange(15, dtype=np.float32).reshape(5, 3),
+            "b": -np.arange(7, dtype=np.float32)}
+    buf = wire.encode_to_bytes(grad)
+    assert len(buf) == wire.wire_bytes == 22 * 4
+    out = wire.decode_from_bytes(buf)
+    np.testing.assert_allclose(out["a"], grad["a"])
+    np.testing.assert_allclose(out["b"], grad["b"])

@@ -281,36 +281,37 @@ def test_step_accumulate_matches_big_batch(mesh8):
 
 
 def test_leader_optimizer_state_is_sharded(mesh8):
-    """ZeRO-1 property: leader mode partitions optimizer state 1/world per
-    device instead of replicating it (VERDICT r1 item 3 — the old lowering
-    redundantly updated on every rank and broadcast identical values)."""
+    """ZeRO-1 property: leader mode partitions optimizer state (and the
+    master parameter copy) 1/world per device instead of replicating it
+    (VERDICT r1 item 3 — the old lowering redundantly updated on every
+    rank and broadcast identical values)."""
     params = make_params()
-    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     opt = Adam(params, mesh=mesh8, lr=1e-3)
     assert opt.mode == "allgather"
     opt_leader = Adam(params, mesh=mesh8, lr=1e-3, mode="leader")
-    shard_len = -(-n // 8)
-    # Adam moments are flat [world, shard_len], globally covering the model
-    # once (vs. once *per device* when replicated)
-    assert opt_leader.opt_state.exp_avg.shape == (8, shard_len)
-    # and the leading axis is really partitioned over the mesh
-    spec = opt_leader.opt_state.exp_avg.sharding.spec
-    assert spec[0] == "data", spec
-    shard_devs = {
-        s.device for s in opt_leader.opt_state.exp_avg.addressable_shards
-    }
-    assert len(shard_devs) == 8
-    per_shard_elems = {
-        int(np.prod(s.data.shape))
-        for s in opt_leader.opt_state.exp_avg.addressable_shards
-    }
-    assert per_shard_elems == {shard_len}
 
+    def check_sharded(state):
+        for p, m in zip(
+            jax.tree.leaves(params), jax.tree.leaves(state.inner.exp_avg)
+        ):
+            n = int(np.prod(p.shape))
+            shard_len = -(-n // 8)
+            # moments cover the model once globally (vs once PER DEVICE
+            # when replicated), partitioned over the mesh axis
+            assert m.shape == (8, shard_len), (p.shape, m.shape)
+            assert m.sharding.spec[0] == "data", m.sharding.spec
+            assert len({s.device for s in m.addressable_shards}) == 8
+            assert {
+                int(np.prod(s.data.shape)) for s in m.addressable_shards
+            } == {shard_len}
+        # the master param copy is sharded the same way
+        for sh in jax.tree.leaves(state.param_shards):
+            assert sh.sharding.spec[0] == "data", sh.sharding.spec
+
+    check_sharded(opt_leader.opt_state)
     # state stays sharded after a step
-    batch = batch_for(mesh8)
-    opt_leader.step(loss_fn=quad_loss, batch=batch)
-    assert opt_leader.opt_state.exp_avg.shape == (8, shard_len)
-    assert opt_leader.opt_state.exp_avg.sharding.spec[0] == "data"
+    opt_leader.step(loss_fn=quad_loss, batch=batch_for(mesh8))
+    check_sharded(opt_leader.opt_state)
 
 
 def test_leader_mode_adam_multi_step_equals_allgather(mesh8):
