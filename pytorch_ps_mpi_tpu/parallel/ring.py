@@ -79,9 +79,16 @@ def ring_attention(
         src_nxt = (src_idx - 1) % n
         return (k_nxt, v_nxt, src_nxt, num, den, new_mx), None
 
-    num0 = jnp.zeros((b, h, l_q, d), q.dtype)
-    den0 = jnp.zeros((b, h, l_q), q.dtype)
-    mx0 = jnp.full((b, h, l_q), _NEG_BIG, q.dtype)
+    # Under shard_map with check_vma=True the scan carry's
+    # varying-manual-axes type must be loop-invariant; freshly-built
+    # zeros are device-invariant while the loop body makes them vary over
+    # every axis q varies over (seq, plus data/model when composed with
+    # DP/TP). Deriving the initial accumulators FROM q inherits exactly
+    # q's vma — version-portable, and XLA folds the arithmetic away.
+    z = jnp.transpose(q, (0, 2, 1, 3)) * 0             # [b, h, l_q, d]
+    num0 = z
+    den0 = z[..., 0]
+    mx0 = z[..., 0] + _NEG_BIG
     carry0 = (k, v, my_idx, num0, den0, mx0)
     (_, _, _, num, den, _), _ = lax.scan(step, carry0, None, length=n)
 

@@ -1,0 +1,160 @@
+"""Tensor parallelism: Megatron-style column/row-parallel layers.
+
+No reference analog — the reference's stated constraint is "models fit on
+one device" (``README.md:6``, SURVEY §2.5 marks TP "out of reference
+scope") — but the mesh design leaves the ``model`` axis open and this
+module fills it: the canonical two-matmul TP block that keeps activations
+sharded between a column-parallel and a row-parallel linear so each
+transformer MLP/attention costs exactly ONE ``psum`` on the ICI, not two
+all-gathers (Shoeybi et al. 2019, arXiv:1909.08053 — public technique).
+
+Layout convention: TP parameter leaves carry a leading ``[tp]`` shard axis
+(the same convention the optimizer uses for codec state), sharded
+``P(tp_axis)`` host-side; inside ``shard_map`` each worker sees its
+``[1, ...]`` slice and squeezes it. All functions here run INSIDE
+``shard_map`` with ``tp_axis`` bound.
+
+Composition: the heads dimension is batch-like to attention, so TP over
+heads composes transparently with ring attention over the sequence axis
+(``parallel/ring.py``) — q/k/v simply carry ``heads/tp`` local heads.
+``__graft_entry__.dryrun_multichip`` runs the full DP x SP x TP train
+step built from these pieces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def column_parallel(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None):
+    """``y_local = x @ w_local (+ b_local)`` — weight sharded on the
+    OUTPUT dim; input replicated (within the tp axis), output sharded.
+    No communication."""
+    y = x @ w
+    return y + b if b is not None else y
+
+
+def row_parallel(
+    y_local: jax.Array, w: jax.Array, tp_axis: str,
+    b: Optional[jax.Array] = None,
+):
+    """``out = psum_tp(y_local @ w_local) (+ b)`` — weight sharded on the
+    INPUT dim; input sharded, output replicated. The block's single
+    collective."""
+    out = lax.psum(y_local @ w, tp_axis)
+    return out + b if b is not None else out
+
+
+def _sq(x):
+    """Squeeze the leading local [1, ...] shard axis shard_map leaves."""
+    return x[0]
+
+
+def tp_mlp(x: jax.Array, params: Dict[str, jax.Array], tp_axis: str):
+    """Transformer MLP: column-parallel up-projection + gelu +
+    row-parallel down-projection; one psum total.
+
+    ``params`` leaves (host-side, leading [tp] axis): ``w1 [tp, d, f/tp]``,
+    ``b1 [tp, f/tp]``, ``w2 [tp, f/tp, d]``, ``b2 [d]`` (replicated — added
+    once after the psum).
+    """
+    h = jax.nn.gelu(column_parallel(x, _sq(params["w1"]), _sq(params["b1"])))
+    return row_parallel(h, _sq(params["w2"]), tp_axis, params["b2"])
+
+
+def tp_self_attention(
+    x: jax.Array,
+    params: Dict[str, jax.Array],
+    tp_axis: str,
+    *,
+    seq_axis: Optional[str] = None,
+    causal: bool = False,
+):
+    """Self-attention with heads split over ``tp_axis``: the QKV
+    projection is column-parallel (each worker computes its local heads),
+    attention runs on local heads (ring attention over ``seq_axis`` when
+    given — SP x TP composition), and the output projection is
+    row-parallel. One psum total.
+
+    ``params`` (host-side): ``wqkv [tp, d, 3, h/tp, hd]``,
+    ``wo [tp, (h/tp)*hd, d]``, ``bo [d]``.
+    """
+    wqkv = _sq(params["wqkv"])                     # [d, 3, h_loc, hd]
+    qkv = jnp.einsum("bld,dche->blche", x, wqkv)   # [b, l, 3, h_loc, hd]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if seq_axis is not None:
+        from pytorch_ps_mpi_tpu.parallel.ring import ring_attention
+
+        out = ring_attention(q, k, v, seq_axis, causal=causal)
+    else:
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / d ** 0.5
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    flat = out.reshape(out.shape[0], out.shape[1], -1)   # [b, l, h_loc*hd]
+    return row_parallel(flat, _sq(params["wo"]), tp_axis, params["bo"])
+
+
+# ---------------------------------------------------------------------------
+# Host-side parameter construction (leading [tp] shard axis)
+# ---------------------------------------------------------------------------
+
+def init_tp_mlp(key, d: int, f: int, tp: int, scale: float = 0.02) -> PyTree:
+    assert f % tp == 0, (f, tp)
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": scale * jax.random.normal(k1, (tp, d, f // tp), jnp.float32),
+        "b1": jnp.zeros((tp, f // tp), jnp.float32),
+        "w2": scale * jax.random.normal(k2, (tp, f // tp, d), jnp.float32),
+        "b2": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def init_tp_attention(
+    key, d: int, heads: int, tp: int, scale: float = 0.02
+) -> PyTree:
+    assert heads % tp == 0 and d % heads == 0, (d, heads, tp)
+    hd = d // heads
+    k1, k2 = jax.random.split(key)
+    return {
+        "wqkv": scale
+        * jax.random.normal(k1, (tp, d, 3, heads // tp, hd), jnp.float32),
+        "wo": scale
+        * jax.random.normal(k2, (tp, (heads // tp) * hd, d), jnp.float32),
+        "bo": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def tp_param_spec(params: PyTree, tp_axis: str):
+    """PartitionSpec pytree: leaves with the leading [tp] axis are sharded
+    over ``tp_axis``; replicated otherwise. Convention: sharded leaves are
+    exactly those with ndim > 1 here (b2/bo are the 1-D replicated ones)."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(
+        lambda x: P(tp_axis) if x.ndim > 1 else P(), params
+    )
+
+
+def dense_equivalent_mlp(params: PyTree):
+    """Concatenate the TP shards back into the dense weights (test oracle)."""
+    w1 = jnp.concatenate([params["w1"][i] for i in range(params["w1"].shape[0])], axis=-1)
+    b1 = jnp.concatenate([params["b1"][i] for i in range(params["b1"].shape[0])], axis=-1)
+    w2 = jnp.concatenate([params["w2"][i] for i in range(params["w2"].shape[0])], axis=0)
+    return w1, b1, w2, params["b2"]
+
+
+def dense_equivalent_attention(params: PyTree):
+    wqkv = jnp.concatenate(
+        [params["wqkv"][i] for i in range(params["wqkv"].shape[0])], axis=2
+    )                                                  # [d, 3, h, hd]
+    wo = jnp.concatenate(
+        [params["wo"][i] for i in range(params["wo"].shape[0])], axis=0
+    )                                                  # [h*hd, d]
+    return wqkv, wo, params["bo"]
