@@ -8,8 +8,13 @@ test:
 bench:
 	python bench.py
 
+# Opportunistic TPU bench watcher: probes tunnel liveness all session and
+# runs the full suite the moment it's up, appending to BENCH_TPU_WATCH.jsonl
+tpu-watch:
+	python tools/tpu_watch.py
+
 native:
 	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libwirecodec.so native/wirecodec.cpp
 	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp
 
-.PHONY: test bench native
+.PHONY: test bench native tpu-watch

@@ -57,6 +57,7 @@ from pytorch_ps_mpi_tpu.optim import SGDHyper, init_sgd_state, sgd_update
 WORKERS = 8
 REPS = 20  # lowered to 5 at runtime on the CPU-fallback path
 TRAIN_BATCH = 256
+SCAN_K = 20  # steps fused into one program for dispatch-amortized timing
 
 # bf16 peak FLOP/s per JAX device, keyed by device_kind substring
 # (lowercased). MFU is reported against these, the standard convention.
@@ -217,7 +218,36 @@ def run_ours(structs):
         params, state = step(params, state, grads_stacked)
         jax.block_until_ready(params)
         times.append(time.perf_counter() - t0)
-    return min(times)
+
+    # Dispatch-amortized device time: the tunneled axon backend pays a
+    # large host<->TPU RTT on every dispatch, which a real TPU VM (local
+    # PCIe dispatch) would not. K identical aggregation+update steps
+    # chained in ONE lax.scan program cost one dispatch; wall/K isolates
+    # what the device itself spends per step.
+    k = SCAN_K
+
+    @jax.jit
+    def step_scanned(params, state, grads_stacked):
+        def body(carry, _):
+            p, s = carry
+            summed = jax.tree.map(
+                lambda g, pp: code.decode_sum(g, pp.shape, pp.dtype),
+                grads_stacked, p,
+            )
+            return sgd_update(p, summed, s, h), None
+
+        (p, s), _ = jax.lax.scan(body, (params, state), None, length=k)
+        return p, s
+
+    p2, s2 = step_scanned(params, state, grads_stacked)  # compile
+    jax.block_until_ready(p2)
+    stimes = []
+    for _ in range(max(3, REPS // 4)):
+        t0 = time.perf_counter()
+        p2, s2 = step_scanned(params, state, grads_stacked)
+        jax.block_until_ready(p2)
+        stimes.append(time.perf_counter() - t0)
+    return min(times), min(stimes) / k
 
 
 # ---------------------------------------------------------------------------
@@ -270,6 +300,29 @@ def run_train_bench():
         times.append(time.perf_counter() - t0)
     step_s = min(times)
 
+    # dispatch-amortized: SCAN_K train steps in one program (see run_ours)
+    @jax.jit
+    def train_scanned(params, state, batch):
+        def body(carry, _):
+            p, s = carry
+            p2, s2, loss = train_step(p, s, batch)
+            return (p2, s2), loss
+
+        (p, s), losses = jax.lax.scan(
+            body, (params, state), None, length=SCAN_K
+        )
+        return p, s, losses
+
+    p3, s3, _ = train_scanned(params, state, (x, y))
+    jax.block_until_ready(p3)
+    stimes = []
+    for _ in range(max(3, REPS // 4)):
+        t0 = time.perf_counter()
+        p3, s3, _ = train_scanned(p3, s3, (x, y))
+        jax.block_until_ready(p3)
+        stimes.append(time.perf_counter() - t0)
+    scan_step_s = min(stimes) / SCAN_K
+
     # CPU anchor: identical program on the host backend (skip if we're
     # already ON the host backend — then vs_baseline is 1.0 by definition)
     cpu_s = None
@@ -291,7 +344,7 @@ def run_train_bench():
             cpu_s = min(ctimes)
         except Exception:
             cpu_s = None
-    return step_s, flops, cpu_s
+    return step_s, scan_step_s, flops, cpu_s
 
 
 def main():
@@ -306,7 +359,7 @@ def main():
     n_params = sum(int(np.prod(s)) for s in shapes)
 
     ref_s = run_reference_baseline(shapes)
-    ours_s = run_ours(structs)
+    ours_s, ours_dev_s = run_ours(structs)
     emit(
         f"resnet18_{n_params//10**6}M_grad_aggregation_sgd_update_ms",
         ours_s * 1e3,
@@ -314,12 +367,17 @@ def main():
         ref_s / ours_s,
         live,
         pallas_mosaic=smoke,
-        baseline="reference-style numpy/pickle pipeline on this host CPU",
+        device_ms_scan_amortized=round(ours_dev_s * 1e3, 4),
+        vs_baseline_scan_amortized=round(ref_s / ours_dev_s, 2),
+        baseline="reference-style numpy/pickle pipeline on this host CPU; "
+        "scan_amortized divides one fused 20-step program's wall by 20 "
+        "(removes per-dispatch tunnel RTT)",
     )
 
-    step_s, flops, cpu_s = run_train_bench()
+    step_s, scan_step_s, flops, cpu_s = run_train_bench()
     peak = peak_flops_for(device_kind())
     mfu = (flops / step_s / peak) if (peak > 0 and flops > 0) else 0.0
+    mfu_scan = (flops / scan_step_s / peak) if (peak > 0 and flops > 0) else 0.0
     if jax.default_backend() == "cpu":
         vs, note = 1.0, "this IS the host CPU backend (ratio 1.0 by definition)"
     elif cpu_s is not None:
@@ -336,6 +394,9 @@ def main():
         step_ms=round(step_s * 1e3, 3),
         flops_per_step=flops,
         mfu=round(mfu, 4),
+        steps_per_sec_scan_amortized=round(1.0 / scan_step_s, 2),
+        step_ms_scan_amortized=round(scan_step_s * 1e3, 3),
+        mfu_scan_amortized=round(mfu_scan, 4),
         baseline=note,
     )
 

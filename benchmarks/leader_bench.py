@@ -24,16 +24,33 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# leader mode needs a multi-device mesh; the one tunneled TPU chip can't
-# host one, so this benchmark runs on the 8-device virtual CPU mesh (the
-# flag only affects the host platform; harmless elsewhere)
+# leader mode needs a multi-device mesh. Only pin to the 8-device virtual
+# CPU mesh when the ambient backend can't form one (the single tunneled
+# TPU chip today); a future multi-chip machine benches its real mesh
+# (VERDICT r2 weak #6). The probe runs in a subprocess so a wedged tunnel
+# can't hang us and the parent's backend choice stays open.
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+import subprocess
+
+_ndev = 0
+try:
+    _out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(len(jax.devices()))"],
+        timeout=75, capture_output=True, text=True,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+    )
+    _ndev = int(_out.stdout.strip() or 0) if _out.returncode == 0 else 0
+except (subprocess.TimeoutExpired, ValueError):
+    _ndev = 0
+
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if _ndev < 2:
+    jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np

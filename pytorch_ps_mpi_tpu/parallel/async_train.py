@@ -1,0 +1,258 @@
+"""Async PS training with REAL jitted compute in every process.
+
+The full AsySG-InCon stack the reference ran — every rank doing actual
+backprop, gradients shipped through the wire, a PS applying them in
+arrival order (reference ``README.md:61-81`` pseudo-code; hook/pool
+overlap ``ps.py:65-66,98-101``) — realized end-to-end across OS
+processes:
+
+  worker process:  read latest params (inconsistent read, seqlock)
+                   → jitted ``value_and_grad`` of a flax model on device
+                   → codec ``encode`` (jitted, CodecWire)
+                   → payload BYTES into the shm mailbox
+  server process:  poll mailboxes in arrival order
+                   → codec ``decode`` (jitted)
+                   → jitted fused ``sgd_update``/``adam_update``
+                   → publish new snapshot (version += 1)
+
+No gradient anywhere is computed outside ``jax.jit``. Staleness is
+measured against publish versions and bounded by the server
+(``max_staleness`` drops, ``stale_drops`` counter); a deliberately slow
+worker exercises both the nontrivial staleness histogram and the drops.
+
+Two serve disciplines, for the async-vs-sync wall-clock comparison the
+algorithm exists for (Lian et al. 2015, arXiv:1506.08272):
+
+- ``serve(..., sync_barrier=False)`` — AsySG: apply each gradient the
+  moment it arrives. Throughput tracks the FAST workers.
+- ``serve(..., sync_barrier=True)``  — synchronous PS oracle: collect one
+  gradient from EVERY worker per round, apply the batch, publish once.
+  Throughput collapses to the slowest worker (the straggler effect the
+  reference's two-phase protocol fought, ``mpi_comms.py:190-191``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+
+def _model_by_name(name: str, **kw):
+    if name == "mlp":
+        from pytorch_ps_mpi_tpu.models import MLP
+
+        return MLP(features=tuple(kw.get("features", (32, 8))))
+    if name == "resnet18":
+        from pytorch_ps_mpi_tpu.models import ResNet18
+
+        return ResNet18(num_classes=kw.get("num_classes", 10),
+                        small_inputs=True)
+    if name == "resnet50":
+        from pytorch_ps_mpi_tpu.models import ResNet50
+
+        return ResNet50(num_classes=kw.get("num_classes", 10),
+                        small_inputs=True)
+    raise ValueError(f"unknown model {name!r}")
+
+
+def make_problem(cfg: Dict[str, Any]):
+    """(model, params0, batch_fn, loss_fn) deterministically from ``cfg``
+    — every process (server and workers) rebuilds the same problem from
+    the same dict, the rank-parameterized-oracle pattern of the
+    reference's tests (SURVEY §4) applied to a train job."""
+    import jax
+    import jax.numpy as jnp
+
+    model = _model_by_name(cfg["model"], **cfg.get("model_kw", {}))
+    in_shape = tuple(cfg.get("in_shape", (8,)))
+    batch = int(cfg.get("batch", 32))
+    k = jax.random.key(int(cfg.get("seed", 0)))
+    kp, kx, kw = jax.random.split(k, 3)
+    x0 = jnp.zeros((1,) + in_shape, jnp.float32)
+    params0 = model.init(kp, x0)
+
+    n_out = int(cfg.get("model_kw", {}).get("num_classes", 0)) or (
+        tuple(cfg.get("model_kw", {}).get("features", (32, 8)))[-1]
+        if cfg["model"] == "mlp" else 10
+    )
+
+    if cfg["model"] == "mlp":
+        # regression against a fixed random linear teacher: smooth convex-
+        # ish loss whose value cleanly separates trained from untrained
+        d_in = int(np.prod(in_shape))
+        w_true = jax.random.normal(kw, (d_in, n_out)) / d_in ** 0.5
+
+        def batch_fn(step: int, worker: int):
+            kk = jax.random.fold_in(jax.random.fold_in(kx, worker), step)
+            x = jax.random.normal(kk, (batch,) + in_shape)
+            y = x.reshape(batch, -1) @ w_true
+            return x, y
+
+        def loss_fn(params, b):
+            x, y = b
+            pred = model.apply(params, x)
+            return jnp.mean((pred - y) ** 2)
+    else:
+        def batch_fn(step: int, worker: int):
+            kk = jax.random.fold_in(jax.random.fold_in(kx, worker), step)
+            x = jax.random.normal(kk, (batch,) + in_shape)
+            y = jax.random.randint(jax.random.fold_in(kk, 1), (batch,), 0, n_out)
+            return x, y
+
+        def loss_fn(params, b):
+            x, y = b
+            logits = model.apply(params, x)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    return model, params0, batch_fn, loss_fn
+
+
+def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
+    """Worker process body: jitted fwd/bwd → encode → push bytes.
+    Returns the number of gradients pushed."""
+    import jax
+
+    from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSWorker
+
+    code = None
+    if cfg.get("codec"):
+        from pytorch_ps_mpi_tpu.codecs import get_codec
+
+        code = get_codec(cfg["codec"], **cfg.get("codec_kw", {}))
+
+    _, params0, batch_fn, loss_fn = make_problem(cfg)
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))  # ONLY grad source
+
+    slow_ms = float(cfg.get("slow_ms", {}).get(str(worker_id), 0.0)) if isinstance(
+        cfg.get("slow_ms"), dict) else 0.0
+    steps = int(cfg.get("worker_steps", {}).get(str(worker_id),
+                cfg.get("steps", 10))) if isinstance(
+        cfg.get("worker_steps"), dict) else int(cfg.get("steps", 10))
+
+    w = ShmPSWorker(name, worker_id, params0, code=code,
+                    timeout=float(cfg.get("open_timeout", 60.0)))
+    pushed = 0
+    try:
+        for step in range(steps):
+            params, version = w.read_params()
+            loss, grads = grad_fn(params, batch_fn(step, worker_id))
+            jax.block_until_ready(grads)
+            if slow_ms:
+                time.sleep(slow_ms / 1e3)  # deliberate straggler
+            w.push_grad(grads, version,
+                        timeout=float(cfg.get("push_timeout", 60.0)))
+            pushed += 1
+    finally:
+        w.close()
+    return pushed
+
+
+def serve(
+    server,
+    cfg: Dict[str, Any],
+    total_grads: int,
+    *,
+    sync_barrier: bool = False,
+    total_received: Optional[int] = None,
+    timeout: float = 300.0,
+) -> Tuple[PyTree, Dict[str, float]]:
+    """Server body: poll → (decode) → jitted optimizer update → publish.
+
+    ``total_grads`` counts APPLIED gradients (stale drops don't count).
+    When ``total_received`` is given, the loop instead runs until that
+    many gradients were CONSUMED (applied + stale-dropped) — the right
+    stop condition when workers push a fixed count and some pushes are
+    expected to be dropped (otherwise their final blocked pushes would
+    time out). Returns (final params, metrics incl. steps/sec and final
+    loss on a held-out evaluation batch).
+    """
+    import jax
+
+    from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+
+    _, params, batch_fn, loss_fn = make_problem(cfg)
+    hyper_cls, init_state, update_fn = OPTIMIZERS[cfg.get("optim", "sgd")]
+    h = hyper_cls(**cfg.get("hyper", {"lr": 0.05}))
+    state = init_state(params)
+    update = jax.jit(lambda p, g, s: update_fn(p, g, s, h))
+    eval_loss = jax.jit(loss_fn)
+    eval_batch = batch_fn(10**6, 10**6)  # never used by any worker
+
+    loss0 = float(eval_loss(params, eval_batch))
+    server.publish(params)
+    applied = 0
+    n_workers = server.num_workers
+    pending: Dict[int, PyTree] = {}
+    t0 = time.perf_counter()
+    deadline = t0 + timeout
+
+    def keep_going():
+        if total_received is not None:
+            return server.grads_received < total_received
+        return applied < total_grads
+
+    while keep_going() and time.perf_counter() < deadline:
+        item = server.poll_grad()
+        if item is None:
+            time.sleep(0.0005)
+            continue
+        wid, _, grad = item
+        if sync_barrier:
+            # synchronous oracle: hold until one grad from every worker
+            pending[wid] = grad
+            if len(pending) < n_workers:
+                continue
+            batch_grads = list(pending.values())
+            pending.clear()
+            summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
+            params, state = update(params, summed, state)
+            applied += n_workers
+        else:
+            params, state = update(params, grad, state)
+            applied += 1
+        server.publish(jax.tree.map(np.asarray, params))
+    wall = time.perf_counter() - t0
+    m = dict(server.metrics())
+    m.update(
+        applied=float(applied),
+        wall_s=wall,
+        updates_per_sec=applied / wall if wall > 0 else 0.0,
+        loss_initial=loss0,
+        loss_final=float(eval_loss(params, eval_batch)),
+        staleness_hist={int(k): int(v) for k, v in server.staleness_seen.items()},
+    )
+    return params, m
+
+
+def spawn_worker(name: str, worker_id: int, cfg: Dict[str, Any],
+                 env: Optional[Dict[str, str]] = None):
+    """Launch ``worker_main`` in a fresh OS process (its own JAX runtime,
+    pinned to the host backend so tests/benches never contend for the one
+    tunneled TPU chip)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    src = (
+        "import json,sys\n"
+        # the axon TPU plugin ignores the JAX_PLATFORMS env var; the
+        # config flag is the pin it respects (workers must never contend
+        # for the one tunneled chip)
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from pytorch_ps_mpi_tpu.parallel.async_train import worker_main\n"
+        "name, wid, cfg = sys.argv[1], int(sys.argv[2]), json.loads(sys.argv[3])\n"
+        "sys.exit(0 if worker_main(name, wid, cfg) >= 0 else 1)\n"
+    )
+    e = dict(os.environ)
+    e.update({"JAX_PLATFORMS": "cpu"})
+    e.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, "-c", src, name, str(worker_id), json.dumps(cfg)],
+        env=e,
+    )

@@ -267,20 +267,27 @@ class ShmPSServer:
         cursor = getattr(self, "_cursor", None)
         if cursor is None:
             cursor = self._cursor = ctypes.c_uint32(0)
-        n = self._lib.psq_pop_grad(
-            self._h, _u8(self._grad_buf.view(np.uint8)), self._grad_buf.nbytes,
-            ctypes.byref(worker), ctypes.byref(version), ctypes.byref(cursor),
-        )
-        if n <= 0:
-            return None
-        staleness = self.version - int(version.value)
-        self.staleness_seen[staleness] = self.staleness_seen.get(staleness, 0) + 1
-        self.last_seen[int(worker.value)] = time.time()
-        self.grads_received += 1
-        self.bytes_received += int(n)
-        if staleness > self.max_staleness:
+        while True:  # iterative stale drain — a deep backlog of stale
+            # gradients (one slow worker after a long server pause) must
+            # not grow the Python stack
+            n = self._lib.psq_pop_grad(
+                self._h, _u8(self._grad_buf.view(np.uint8)),
+                self._grad_buf.nbytes,
+                ctypes.byref(worker), ctypes.byref(version),
+                ctypes.byref(cursor),
+            )
+            if n <= 0:
+                return None
+            staleness = self.version - int(version.value)
+            self.staleness_seen[staleness] = (
+                self.staleness_seen.get(staleness, 0) + 1
+            )
+            self.last_seen[int(worker.value)] = time.time()
+            self.grads_received += 1
+            self.bytes_received += int(n)
+            if staleness <= self.max_staleness:
+                break
             self.stale_drops += 1
-            return self.poll_grad()
         expected = self.wire.wire_bytes if self.wire else _flat_size(self.template) * 4
         if int(n) != expected:
             # the wire spec is a one-time agreement — enforce it, or a

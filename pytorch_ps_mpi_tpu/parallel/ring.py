@@ -85,7 +85,10 @@ def ring_attention(
     # every axis q varies over (seq, plus data/model when composed with
     # DP/TP). Deriving the initial accumulators FROM q inherits exactly
     # q's vma — version-portable, and XLA folds the arithmetic away.
-    z = jnp.transpose(q, (0, 2, 1, 3)) * 0             # [b, h, l_q, d]
+    # The isfinite select keeps ±inf activations (overflowed upstream)
+    # from poisoning the accumulators via 0 * inf = NaN.
+    zq = jnp.transpose(q, (0, 2, 1, 3))                # [b, h, l_q, d]
+    z = jnp.where(jnp.isfinite(zq), zq * 0, 0.0)
     num0 = z
     den0 = z[..., 0]
     mx0 = z[..., 0] + _NEG_BIG

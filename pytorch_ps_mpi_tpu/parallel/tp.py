@@ -95,6 +95,10 @@ def tp_self_attention(
     else:
         d = q.shape[-1]
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / d ** 0.5
+        if causal:
+            l = q.shape[1]
+            mask = jnp.tril(jnp.ones((l, l), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
     flat = out.reshape(out.shape[0], out.shape[1], -1)   # [b, l, h_loc*hd]

@@ -191,8 +191,10 @@ def _fn_cache_key(fn: Optional[Callable]) -> Any:
     once. Anything that can change behavior distinguishes the key:
     closure cell values, default args, and the method receiver; unhashable
     values are wrapped in :class:`_IdKey` (identity + strong ref).
-    Known limit: a function reading a rebound module-level *global* is
-    indistinguishable — same caveat as ``jax.jit`` itself."""
+    Known limits (same caveats as ``jax.jit`` identity keying avoids): a
+    function reading a rebound module-level *global* is indistinguishable,
+    and a captured hashable object *mutated in place* yields a stale hit —
+    pass a fresh closure when either changes behavior."""
     if fn is None or not hasattr(fn, "__code__"):
         return fn
 
@@ -203,7 +205,13 @@ def _fn_cache_key(fn: Optional[Callable]) -> Any:
         except TypeError:
             return _IdKey(v)
 
-    cells = tuple(h(c.cell_contents) for c in (fn.__closure__ or ()))
+    def cell(c):
+        try:
+            return h(c.cell_contents)
+        except ValueError:  # empty (not-yet-assigned) cell
+            return _IdKey(c)
+
+    cells = tuple(cell(c) for c in (fn.__closure__ or ()))
     defaults = tuple(h(d) for d in (fn.__defaults__ or ()))
     bound_self = _IdKey(fn.__self__) if hasattr(fn, "__self__") else None
     return (fn.__code__, cells, defaults, bound_self)
@@ -348,15 +356,22 @@ class MPI_PS:
             # preserves leaf dtypes and lets XLA fuse per-tensor.
             from jax.sharding import NamedSharding
 
-            self.opt_state = jax.tree.map(
-                lambda x: jax.device_put(
-                    x,
-                    NamedSharding(
-                        self.mesh, P(axis_name) if x.ndim > 0 else P()
-                    ),
+            # Construct the state *directly sharded* (jit + out_shardings)
+            # so no device ever materializes the full [world, shard_len]
+            # stack — a host-side build-then-reshard would transiently use
+            # world× the sharded memory, defeating ZeRO-1's point at the
+            # model scales it targets.
+            def build(p):
+                return leader_init_state(p, init_state, self.size)
+
+            structs = jax.eval_shape(build, params)
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(
+                    self.mesh, P(axis_name) if len(s.shape) > 0 else P()
                 ),
-                leader_init_state(params, init_state, self.size),
+                structs,
             )
+            self.opt_state = jax.jit(build, out_shardings=shardings)(params)
         else:
             self.opt_state = init_state(params)
         self._rng = jax.random.key(seed)

@@ -113,7 +113,12 @@ class Trainer:
         ``loss_fn``) over ``num_batches`` batches, without touching
         optimizer state."""
         fn = eval_fn if eval_fn is not None else self.loss_fn
-        key = ("eval", fn)
+        # key by behavior, not object identity: a bound method or fresh
+        # lambda per call must not recompile every evaluate() (same
+        # machinery MPI_PS.step uses for loss_fn)
+        from pytorch_ps_mpi_tpu.ps import _fn_cache_key
+
+        key = ("eval", _fn_cache_key(fn))
         if key not in self._eval_compiled:
             self._eval_compiled[key] = jax.jit(fn)
         compiled = self._eval_compiled[key]
