@@ -1,0 +1,122 @@
+"""ResNet-50 async (AsySG shm PS) vs synchronous-barrier PS — BASELINE
+config #3's async/straggler story, measured.
+
+Same worker fleet both times (real jitted ResNet-50 fwd/bwd in every
+worker process — no closed-form gradients anywhere), one deliberate
+straggler. The synchronous PS applies one gradient from EVERY worker per
+round, so its update rate is paced by the straggler; AsySG applies each
+gradient on arrival, so fast workers keep streaming. The measured ratio
+is the wall-clock benefit asynchrony exists for (Lian et al. 2015).
+
+Honest labeling: this host is a single CPU core driving N worker
+processes, so absolute steps/sec are meaningless — the async/sync RATIO
+under an injected straggler is the evidence (and the protocol is
+host-side by design; the device compute inside each worker is whatever
+JAX backend the worker runs).
+
+Run: ``python benchmarks/async_bench.py [--workers 4] [--batch 2]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # protocol bench: never touch the TPU
+
+from pytorch_ps_mpi_tpu.parallel import dcn
+from pytorch_ps_mpi_tpu.parallel.async_train import (
+    make_problem,
+    serve,
+    spawn_worker,
+)
+from pytorch_ps_mpi_tpu.utils.backend_guard import enable_compilation_cache
+
+enable_compilation_cache()
+
+
+def run(cfg, n_workers: int, sync_barrier: bool, total: int):
+    _, params0, _, _ = make_problem(cfg)
+    name = f"/psq_bench_{os.getpid()}_{int(sync_barrier)}"
+    server = dcn.ShmPSServer(
+        name, num_workers=n_workers, template=params0, max_staleness=10**9,
+    )
+    try:
+        procs = [spawn_worker(name, i, cfg) for i in range(n_workers)]
+        _, m = serve(server, cfg, total_grads=0, total_received=total,
+                     sync_barrier=sync_barrier, timeout=3600.0)
+        for p in procs:
+            rc = p.wait(timeout=600)
+            if rc != 0:
+                raise RuntimeError(f"worker exited {rc}")
+    finally:
+        server.close()
+    return m
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--fast-steps", type=int, default=8)
+    ap.add_argument("--slow-steps", type=int, default=2)
+    ap.add_argument("--slow-ms", type=float, default=4000.0)
+    ap.add_argument("--model", default="resnet50")
+    args = ap.parse_args()
+
+    w = args.workers
+    base = {
+        "model": args.model,
+        "model_kw": {"num_classes": 10},
+        "in_shape": (32, 32, 3),
+        "batch": args.batch,
+        "seed": 5,
+        "optim": "sgd",
+        "hyper": {"lr": 0.01},
+        "slow_ms": {str(w - 1): args.slow_ms},
+        "open_timeout": 600.0,
+        "push_timeout": 600.0,
+    }
+
+    # sync barrier: every worker contributes to every round, so all push
+    # the same count; async: fast workers stream while the straggler naps
+    sync_cfg = dict(base)
+    sync_cfg["worker_steps"] = {str(i): args.slow_steps for i in range(w)}
+    m_sync = run(sync_cfg, w, sync_barrier=True, total=w * args.slow_steps)
+
+    async_cfg = dict(base)
+    async_cfg["worker_steps"] = {
+        **{str(i): args.fast_steps for i in range(w - 1)},
+        str(w - 1): args.slow_steps,
+    }
+    m_async = run(
+        async_cfg, w, sync_barrier=False,
+        total=(w - 1) * args.fast_steps + args.slow_steps,
+    )
+
+    print(json.dumps({
+        "metric": f"{args.model}_async_vs_syncbarrier_updates_per_sec_ratio",
+        "value": round(m_async["updates_per_sec"] / m_sync["updates_per_sec"], 2),
+        "unit": "x",
+        "vs_baseline": round(
+            m_async["updates_per_sec"] / m_sync["updates_per_sec"], 2
+        ),
+        "async_updates_per_sec": round(m_async["updates_per_sec"], 3),
+        "sync_updates_per_sec": round(m_sync["updates_per_sec"], 3),
+        "async_loss": round(m_async["loss_final"], 4),
+        "sync_loss": round(m_sync["loss_final"], 4),
+        "workers": w,
+        "straggler_ms": args.slow_ms,
+        "backend": "cpu (protocol bench; single-core host, ratio is the "
+                   "evidence, absolute rates are not)",
+    }, ensure_ascii=False), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -40,34 +39,18 @@ CODECS = [  # (label, registry name, kwargs)
 PALLAS_PAIRS = ["int8", "sign"]
 
 
-def bench_codec(name, kw, n, reps=20):
+def bench_codec(name, kw, n, k=32):
+    """Device ms for one encode+decode round-trip at ``n`` elements —
+    the shared honest-timing recipe (``utils/devtime.py``: k-step fused
+    scan with a data dependence, scalar fetch, RTT floor subtracted)."""
+    from pytorch_ps_mpi_tpu.utils.devtime import codec_roundtrip_seconds
+
     code = get_codec(name, **kw)
     # powersgd wants a matrix view; give every codec the same 2-D shape
     shape = (n // 1024, 1024)
-    g = jax.random.normal(jax.random.key(0), shape)
-    state = code.init_state(shape, g.dtype)
-    rng = jax.random.key(1) if code.needs_rng else None
-
-    enc = jax.jit(lambda g, s: code.encode(g, s, rng))
-    payload, _ = enc(g, state)
-    dec = jax.jit(lambda p: code.decode(p, shape, g.dtype))
-    out = dec(payload)
-    jax.block_until_ready(out)
-
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        payload, _ = enc(g, state)
-    jax.block_until_ready(payload)
-    t_enc = (time.perf_counter() - t0) / reps
-
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = dec(payload)
-    jax.block_until_ready(out)
-    t_dec = (time.perf_counter() - t0) / reps
-
+    t_rt = codec_roundtrip_seconds(code, shape, jnp.float32, k=k)
     bits = code.payload_bits(shape, jnp.float32)
-    return t_enc, t_dec, bits / 8
+    return t_rt, bits / 8
 
 
 def main():
@@ -77,12 +60,12 @@ def main():
     raw_bytes = n * 4
     backend = jax.default_backend()
     print(f"backend={backend} fallback={not live} n={n} raw={raw_bytes/1e6:.1f} MB")
-    print("| codec | encode ms | decode ms | wire MB | ratio |")
-    print("|---|---|---|---|---|")
+    print("| codec | enc+dec ms (device) | wire MB | ratio |")
+    print("|---|---|---|---|")
     for label, name, kw in CODECS:
-        t_enc, t_dec, wire = bench_codec(name, kw, n)
+        t_rt, wire = bench_codec(name, kw, n)
         print(
-            f"| {label} | {t_enc*1e3:.2f} | {t_dec*1e3:.2f} "
+            f"| {label} | {t_rt*1e3:.2f} "
             f"| {wire/1e6:.2f} | {raw_bytes/wire:.1f}x |"
         )
 
@@ -90,12 +73,14 @@ def main():
         print()
         print("| kernel | pallas enc+dec ms | jnp enc+dec ms | speedup |")
         print("|---|---|---|---|")
+        from pytorch_ps_mpi_tpu.utils.devtime import safe_ratio
+
         for name in PALLAS_PAIRS:
-            pe, pd, _ = bench_codec(name, {"use_pallas": True}, n)
-            je, jd, _ = bench_codec(name, {"use_pallas": False}, n)
+            pt, _ = bench_codec(name, {"use_pallas": True}, n)
+            jt, _ = bench_codec(name, {"use_pallas": False}, n)
             print(
-                f"| {name} | {(pe+pd)*1e3:.2f} | {(je+jd)*1e3:.2f} "
-                f"| {(je+jd)/(pe+pd):.2f}x |"
+                f"| {name} | {pt*1e3:.2f} | {jt*1e3:.2f} "
+                f"| {safe_ratio(jt, pt):.2f}x |"
             )
     else:
         print("(pallas-vs-jnp column skipped: kernels run interpreted off-TPU)")

@@ -21,8 +21,10 @@
 #include <cstdint>
 #include <cstring>
 #include <fcntl.h>
+#include <sched.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <time.h>
 #include <unistd.h>
 
 namespace {
@@ -172,11 +174,23 @@ int psq_publish_params(void* hv, const uint8_t* buf, uint64_t len,
 
 // Worker: consistent read of the latest params. Returns byte length,
 // stores the snapshot's version. Retries while the seqlock is odd/moved.
+// Backs off (sched_yield, then short sleeps) between retries: on an
+// oversubscribed host a server republishing at full rate can otherwise
+// livelock a starved reader, which would see the seq move on every
+// attempt (observed as spurious -2 with 4 ResNet-50 workers on 1 core).
 int64_t psq_read_params(void* hv, uint8_t* buf, uint64_t cap,
                         uint64_t* version_out) {
   Handle* h = (Handle*)hv;
   Header* H = hdr(h);
-  for (int attempt = 0; attempt < 1000000; ++attempt) {
+  for (int attempt = 0; attempt < 100000; ++attempt) {
+    if (attempt > 16) {
+      if (attempt < 1024) {
+        sched_yield();
+      } else {  // ~50 us: lets the writer finish even on one core
+        struct timespec ts = {0, 50000};
+        nanosleep(&ts, nullptr);
+      }
+    }
     uint64_t s1 = H->param_seq.load(std::memory_order_acquire);
     if (s1 & 1) continue;  // write in progress
     uint64_t len = H->param_len.load(std::memory_order_relaxed);

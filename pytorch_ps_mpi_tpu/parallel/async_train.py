@@ -187,7 +187,15 @@ def serve(
     server.publish(params)
     applied = 0
     n_workers = server.num_workers
-    pending: Dict[int, PyTree] = {}
+    # sync_barrier holds a FIFO per worker: the server pops mailboxes
+    # eagerly (the single-slot mailbox never back-pressures a fast
+    # worker), so a worker may deliver several gradients before a
+    # straggler's first — queueing them, not overwriting, keeps the
+    # oracle a true synchronous PS in which EVERY gradient enters exactly
+    # one averaged round.
+    import collections
+
+    pending: Dict[int, Any] = collections.defaultdict(collections.deque)
     t0 = time.perf_counter()
     deadline = t0 + timeout
 
@@ -203,12 +211,12 @@ def serve(
             continue
         wid, _, grad = item
         if sync_barrier:
-            # synchronous oracle: hold until one grad from every worker
-            pending[wid] = grad
-            if len(pending) < n_workers:
+            # synchronous oracle: a round completes when every worker has
+            # at least one queued gradient; one per worker is consumed
+            pending[wid].append(grad)
+            if sum(1 for q in pending.values() if q) < n_workers:
                 continue
-            batch_grads = list(pending.values())
-            pending.clear()
+            batch_grads = [pending[w].popleft() for w in range(n_workers)]
             summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
             params, state = update(params, summed, state)
             applied += n_workers

@@ -378,6 +378,13 @@ class ShmPSWorker:
                 self._h, _u8(self._param_buf.view(np.uint8)),
                 self._param_buf.nbytes, ctypes.byref(version),
             )
+            if n == -2:
+                # seqlock starved (server republishing faster than this
+                # reader gets scheduled) — retriable until the deadline
+                if time.time() > deadline:
+                    raise TimeoutError("psq_read_params starved (seqlock)")
+                time.sleep(0.01)
+                continue
             if n < 0:
                 raise RuntimeError(f"psq_read_params -> {n}")
             if version.value > 0:

@@ -752,6 +752,7 @@ class MPI_PS:
         batch: Optional[PyTree] = None,
         aux_state: Optional[PyTree] = None,
         closure: Optional[Callable] = None,
+        profile: bool = False,
     ) -> Tuple[Optional[jax.Array], Dict[str, float]]:
         """Run one distributed step; returns ``(loss, data)`` exactly like
         the reference (``ps.py:193`` — its known deviation from the torch
@@ -762,6 +763,15 @@ class MPI_PS:
         (aggregation-only, the reference's own division of labor).
         ``closure`` is accepted for signature parity (``ps.py:110-112``)
         and invoked for its loss value if given.
+
+        ``profile=True`` traces THIS step with ``jax.profiler`` and fills
+        ``comm_wait`` (the reference's collective-wait metric,
+        ``ps.py:162``) with the fused program's real per-device mean
+        communication time — the comm/compute split ``instrument=True``
+        cannot measure because it splits the program. Extra keys
+        ``profile_device_busy``/``profile_compute``/``profile_devices``
+        carry the rest of the split. For per-stage encode/decode/update
+        walls, use ``instrument=True`` instead.
         """
         t0 = time.perf_counter()
         data = self._schema_dict()
@@ -795,9 +805,14 @@ class MPI_PS:
                 self._compiled[key] = self._build_grad_step(loss_fn, has_aux)
             fn = self._compiled[key]
             extra = (aux_state,) if has_aux else ()
-            (self.params, self.opt_state, self.codec_state, loss, new_aux) = fn(
+            call = lambda: fn(
                 self.params, self.opt_state, self.codec_state, batch, rng, *extra
             )
+            if profile:
+                out, split = self._profiled_call(call, data)
+            else:
+                out = call()
+            (self.params, self.opt_state, self.codec_state, loss, new_aux) = out
             if has_aux:
                 self.aux_state = new_aux
         elif grads is not None:
@@ -810,9 +825,14 @@ class MPI_PS:
             if key not in self._compiled:
                 self._compiled[key] = self._build_grads_only_step()
             fn = self._compiled[key]
-            self.params, self.opt_state, self.codec_state = fn(
+            call = lambda: fn(
                 self.params, self.opt_state, self.codec_state, grads, rng
             )
+            if profile:
+                out, split = self._profiled_call(call, data)
+            else:
+                out = call()
+            self.params, self.opt_state, self.codec_state = out
         else:
             raise ValueError("pass grads or loss_fn+batch")
 
@@ -821,11 +841,25 @@ class MPI_PS:
 
         jax.block_until_ready(self.params)
         # The fused program has no separable comm/decode/update stages —
-        # only step_time is a real measurement here; the per-stage keys
-        # stay 0.0 and instrument=True fills them with honest wall times.
+        # step_time is always a real measurement; profile=True adds the
+        # trace-derived comm/compute split, and instrument=True (separate
+        # mode) fills the remaining per-stage keys with host wall times.
         data["step_time"] = time.perf_counter() - t0
         self._step_count += 1
         return loss, data
+
+    def _profiled_call(self, call, data: Dict[str, float]):
+        """Run one compiled fused step under the JAX profiler and fill the
+        reference's ``comm_wait`` (``ps.py:162``) with the program's real
+        per-device mean collective time (VERDICT r2 item 6)."""
+        from pytorch_ps_mpi_tpu.utils.tracing import profiled_device_split
+
+        out, split = profiled_device_split(call)
+        data["comm_wait"] = split["comm_s"]
+        data["profile_device_busy"] = split["device_busy_s"]
+        data["profile_compute"] = split["compute_s"]
+        data["profile_devices"] = float(split["devices"])
+        return out, split
 
     def state_dict(self) -> Dict[str, Any]:
         """Checkpointable state in this repo's schema (params/opt_state/

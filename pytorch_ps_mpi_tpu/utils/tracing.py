@@ -5,8 +5,13 @@ inside a fused XLA program)."""
 
 from __future__ import annotations
 
+import collections
 import contextlib
-from typing import Iterator, Optional
+import glob
+import os
+import shutil
+import tempfile
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 import jax
 
@@ -30,6 +35,81 @@ def annotate(name: str) -> Iterator[None]:
     """Named region inside a trace (shows up on the timeline)."""
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+# HLO/primitive names that are interconnect work. Covers both the jax
+# primitive names XLA:CPU surfaces (``psum.7``) and the HLO collective op
+# names TPU planes use (``all-reduce-start.1`` etc.).
+_COMM_SUBSTRINGS = (
+    "psum", "all-reduce", "allreduce", "all-gather", "allgather",
+    "reduce-scatter", "reducescatter", "collective", "ppermute",
+    "all-to-all", "alltoall",
+)
+
+
+def profiled_device_split(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]]:
+    """Run ``thunk()`` once under the JAX profiler and split *device* op
+    time into communication vs compute.
+
+    This measures the real fused program — the split host wall-clocks
+    around separate sub-programs (``MPI_PS`` ``instrument=True``)
+    structurally cannot see, because splitting the program changes what
+    XLA can overlap. Only events carrying an ``hlo_op`` stat (device op
+    executions) are counted; host-side compile/dispatch events have no
+    ``hlo_op`` and are excluded, so tracing a first (compiling) call
+    still yields a clean device split.
+
+    Returns ``(thunk result, split)`` where split has per-device *mean*
+    seconds: ``device_busy_s``, ``comm_s``, ``compute_s``, plus
+    ``devices`` and the ``top_ops`` time sinks. Empty split (zeros,
+    ``devices=0``) when the backend emits no device events (some
+    remote/tunneled backends do not support tracing).
+    """
+    d = tempfile.mkdtemp(prefix="jaxtrace_")
+    try:
+        jax.profiler.start_trace(d)
+        try:
+            out = thunk()
+            jax.block_until_ready(out)
+        finally:
+            jax.profiler.stop_trace()
+        per_dev: Dict[Any, list] = collections.defaultdict(lambda: [0.0, 0.0])
+        top: collections.Counter = collections.Counter()
+        for f in glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True):
+            try:
+                pd = jax.profiler.ProfileData.from_file(f)
+            except Exception:
+                continue
+            for plane in pd.planes:
+                for line in plane.lines:
+                    for e in line.events:
+                        dur = e.duration_ns or 0.0
+                        if dur <= 0:
+                            continue
+                        st = dict(e.stats)
+                        if "hlo_op" not in st:
+                            continue
+                        dev = st.get("device_ordinal", plane.name)
+                        nm = str(e.name).lower()
+                        per_dev[dev][1] += dur
+                        top[str(e.name)] += dur
+                        if any(s in nm for s in _COMM_SUBSTRINGS):
+                            per_dev[dev][0] += dur
+        ndev = len(per_dev)
+        scale = 1e9 * max(1, ndev)
+        comm = sum(v[0] for v in per_dev.values()) / scale
+        busy = sum(v[1] for v in per_dev.values()) / scale
+        return out, {
+            "devices": ndev,
+            "device_busy_s": busy,
+            "comm_s": comm,
+            "compute_s": busy - comm,
+            "top_ops": [
+                (name, ns / 1e9) for name, ns in top.most_common(8)
+            ],
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def device_memory_stats() -> Optional[dict]:

@@ -383,3 +383,31 @@ def test_leader_mode_run_steps(mesh8):
         ),
         a.params, b.params,
     )
+
+
+def test_profile_step_fills_trace_derived_comm_split(mesh8):
+    """profile=True traces the fused step and fills comm_wait with the
+    program's real device collective time (VERDICT r2 item 6): nonzero
+    comm on a psum step, comm + compute == device busy, and the step's
+    numerics are identical to an unprofiled step."""
+    params = {"w": jnp.zeros((512,), jnp.float32)}
+    world = 8
+    grads = {"w": jnp.ones((world, 512), jnp.float32)}
+
+    opt = SGD(params, lr=0.1, mesh=mesh8)
+    _, data = opt.step(grads=grads, profile=True)
+
+    assert data["profile_devices"] == world
+    assert data["comm_wait"] > 0.0, data
+    assert data["profile_device_busy"] >= data["comm_wait"]
+    np.testing.assert_allclose(
+        data["comm_wait"] + data["profile_compute"],
+        data["profile_device_busy"], rtol=1e-6,
+    )
+
+    # numerics identical to the unprofiled path
+    opt2 = SGD(params, lr=0.1, mesh=mesh8)
+    opt2.step(grads=grads)
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]), np.asarray(opt2.params["w"])
+    )
