@@ -241,8 +241,10 @@ def run_ours(structs):
 # Line 2: end-to-end ResNet-18 train step, steps/sec + MFU
 # ---------------------------------------------------------------------------
 
-def make_train_step():
-    model = ResNet18(num_classes=10, small_inputs=True)
+def make_train_step(dtype=jnp.float32):
+    # f32 params either way; dtype is the conv/matmul compute precision
+    # (bf16 is the MXU's native width — the TPU-first configuration)
+    model = ResNet18(num_classes=10, small_inputs=True, dtype=dtype)
     h = SGDHyper(lr=0.01, momentum=0.9)
 
     def loss_fn(params, batch):
@@ -259,9 +261,11 @@ def make_train_step():
     return model, train_step
 
 
-def run_train_bench():
-    """Returns (step_seconds, flops_per_step, cpu_step_seconds)."""
-    model, train_step = make_train_step()
+def run_train_bench(dtype=jnp.float32, cpu_anchor=True):
+    """Returns (wall_s_per_call, device_s_per_step, flops_per_step,
+    cpu_step_seconds_or_None) — wall includes the tunnel fetch RTT,
+    device is the scan-amortized RTT-subtracted time."""
+    model, train_step = make_train_step(dtype)
     x = jax.random.normal(jax.random.key(1), (TRAIN_BATCH, 32, 32, 3))
     y = jax.random.randint(jax.random.key(2), (TRAIN_BATCH,), 0, 10)
     params = jax.jit(model.init)(jax.random.key(0), x[:1])
@@ -302,7 +306,7 @@ def run_train_bench():
     # CPU anchor: identical program on the host backend (skip if we're
     # already ON the host backend — then vs_baseline is 1.0 by definition)
     cpu_s = None
-    if jax.default_backend() != "cpu":
+    if cpu_anchor and jax.default_backend() != "cpu":
         try:
             cpu = jax.devices("cpu")[0]
             xc, yc = jax.device_put((x, y), cpu)
@@ -377,6 +381,24 @@ def main():
         mfu=round(mfu, 4),
         baseline=note,
     )
+
+    # Line 3 (accelerator only): the TPU-first configuration — bf16
+    # compute (f32 params), the MXU's native precision
+    if jax.default_backend() != "cpu":
+        bw, bd, bflops, _ = run_train_bench(jnp.bfloat16, cpu_anchor=False)
+        bmfu = safe_ratio(bflops, bd * peak) if peak > 0 else 0.0
+        emit(
+            f"resnet18_train_step_b{TRAIN_BATCH}_bf16_steps_per_sec",
+            safe_ratio(1.0, bd),
+            "steps/sec",
+            safe_ratio(step_dev_s, bd),
+            live,
+            step_ms_device=round(bd * 1e3, 3),
+            wall_ms_per_call=round(bw * 1e3, 3),
+            flops_per_step=bflops,
+            mfu=round(bmfu, 4),
+            baseline="same model with f32 compute (line 2) on this device",
+        )
 
 
 if __name__ == "__main__":

@@ -78,7 +78,9 @@ def main():
         "batch": args.batch,
         "seed": 5,
         "optim": "sgd",
-        "hyper": {"lr": 0.01},
+        # per-arrival updates (no averaging) need a cooler rate than a
+        # synchronous sweep or the ResNet-50 loss visibly diverges
+        "hyper": {"lr": 1e-4},
         "slow_ms": {str(w - 1): args.slow_ms},
         "open_timeout": 600.0,
         "push_timeout": 600.0,
@@ -100,13 +102,16 @@ def main():
         total=(w - 1) * args.fast_steps + args.slow_steps,
     )
 
+    from pytorch_ps_mpi_tpu.utils.devtime import safe_ratio
+
+    ratio = round(
+        safe_ratio(m_async["updates_per_sec"], m_sync["updates_per_sec"]), 2
+    )  # 0.0 = "sync run applied nothing before its deadline; not measured"
     print(json.dumps({
         "metric": f"{args.model}_async_vs_syncbarrier_updates_per_sec_ratio",
-        "value": round(m_async["updates_per_sec"] / m_sync["updates_per_sec"], 2),
+        "value": ratio,
         "unit": "x",
-        "vs_baseline": round(
-            m_async["updates_per_sec"] / m_sync["updates_per_sec"], 2
-        ),
+        "vs_baseline": ratio,
         "async_updates_per_sec": round(m_async["updates_per_sec"], 3),
         "sync_updates_per_sec": round(m_sync["updates_per_sec"], 3),
         "async_loss": round(m_async["loss_final"], 4),

@@ -1,0 +1,110 @@
+"""Async (AsySG-InCon) training CLI — the reference's README pseudo-code
+(``/root/reference/README.md:61-81``: workers compute gradients against
+whatever parameters they last read; a parameter server applies them in
+arrival order) as an actual runnable, with real jitted compute in every
+process (``parallel/async_train.py``).
+
+The server runs in this process; each worker is its own OS process with
+its own JAX runtime (pinned to the host backend so fleets never contend
+for a single tunneled TPU chip). Gradients travel as codec-encoded
+payload bytes through the native shared-memory transport
+(``native/psqueue.cpp``).
+
+Examples:
+  python examples/train_async.py --model mlp --workers 4 --steps 50
+  python examples/train_async.py --model resnet18 --codec sign \
+      --workers 4 --steps 10 --straggler-ms 500 --max-staleness 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # server process: host backend
+
+from pytorch_ps_mpi_tpu.parallel import dcn
+from pytorch_ps_mpi_tpu.parallel.async_train import (
+    make_problem,
+    serve,
+    spawn_worker,
+)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["mlp", "resnet18", "resnet50"],
+                    default="mlp")
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=50,
+                    help="gradient pushes per worker")
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--optim", choices=["sgd", "adam"], default="sgd")
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--codec", default=None,
+                    help="codec registry name (e.g. sign, int8, threshold)")
+    ap.add_argument("--max-staleness", type=int, default=4)
+    ap.add_argument("--straggler-ms", type=float, default=0.0,
+                    help="inject this delay into the last worker's loop")
+    ap.add_argument("--sync-barrier", action="store_true",
+                    help="synchronous-PS oracle mode (for comparison runs)")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    in_shape = (8,) if args.model == "mlp" else (32, 32, 3)
+    cfg = {
+        "model": args.model,
+        "model_kw": {"num_classes": 10} if args.model != "mlp" else
+                    {"features": (64, 8)},
+        "in_shape": list(in_shape),
+        "batch": args.batch,
+        "seed": 0,
+        "optim": args.optim,
+        "hyper": {"lr": args.lr},
+        "steps": args.steps,
+        "open_timeout": args.timeout,
+        "push_timeout": args.timeout,
+    }
+    if args.codec:
+        cfg["codec"] = args.codec
+    if args.straggler_ms:
+        cfg["slow_ms"] = {str(args.workers - 1): args.straggler_ms}
+
+    code = None
+    if args.codec:
+        from pytorch_ps_mpi_tpu.codecs import get_codec
+
+        code = get_codec(args.codec)
+
+    _, params0, _, _ = make_problem(cfg)
+    name = f"/psq_train_{os.getpid()}"
+    server = dcn.ShmPSServer(
+        name, num_workers=args.workers, template=params0,
+        max_staleness=args.max_staleness, code=code,
+    )
+    total = args.workers * args.steps
+    try:
+        procs = [spawn_worker(name, i, cfg) for i in range(args.workers)]
+        params, metrics = serve(
+            server, cfg, total_grads=0, total_received=total,
+            sync_barrier=args.sync_barrier, timeout=args.timeout,
+        )
+        for p in procs:
+            rc = p.wait(timeout=args.timeout)
+            if rc != 0:
+                raise SystemExit(f"worker exited {rc}")
+    finally:
+        server.close()
+
+    print(json.dumps(metrics, default=str))
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -33,17 +33,22 @@ class TernGradCodec(Codec):
 
     def encode(self, grad, state=(), rng=None):
         assert rng is not None, "TernGradCodec needs a PRNG key"
-        flat = grad.reshape(-1).astype(jnp.float32)
-        n = flat.shape[0]
-        scale = jnp.maximum(jnp.max(jnp.abs(flat)), 1e-12)
-        p = jnp.abs(flat) / scale
-        keep = jax.random.bernoulli(rng, p)
+        g = grad.astype(jnp.float32)
+        n = int(np.prod(g.shape)) if g.shape else 1
+        scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
+        # draw the Bernoulli randoms in the gradient's NATIVE shape and
+        # flatten only the resulting uint8 digits: fusing a 132M-element
+        # threefry with a reshape-derived probability tensor crashes the
+        # TPU compile helper (observed on v5e; 1-D and native-shape forms
+        # compile fine)
+        keep = jax.random.uniform(rng, g.shape) < (jnp.abs(g) / scale)
         # ternary digit: 0 -> -1, 1 -> 0, 2 -> +1
-        digit = jnp.where(keep, jnp.where(flat >= 0, 2, 0), 1).astype(jnp.uint8)
+        digit = jnp.where(keep, jnp.where(g >= 0, 2, 0), 1).astype(jnp.uint8)
+        flat = digit.reshape(-1)
         pad = _packed_len(n) * 4 - n
-        digit = jnp.pad(digit, (0, pad), constant_values=1).reshape(-1, 4)
+        flat = jnp.pad(flat, (0, pad), constant_values=1).reshape(-1, 4)
         weights = jnp.asarray(_WEIGHTS, jnp.uint8)
-        packed = (digit * weights).sum(axis=1).astype(jnp.uint8)
+        packed = (flat * weights).sum(axis=1).astype(jnp.uint8)
         return {"packed": packed, "scale": scale.astype(jnp.float32)}, state
 
     def _unpack(self, packed, n):

@@ -91,6 +91,14 @@ class ResNet(nn.Module):
     norm: str = "group"          # 'group' (stateless) or 'batch'
     small_inputs: bool = False   # CIFAR stem: 3x3 conv, no maxpool
     dtype: Any = jnp.float32
+    # With norm='batch', set to the mesh data axis ('data') to get TRUE
+    # SyncBatchNorm: batch statistics are psum-averaged across replicas
+    # inside the forward pass (flax BatchNorm axis_name), so distributed
+    # normalization matches a single device seeing the global batch —
+    # torch DDP's SyncBatchNorm semantics. Requires running inside
+    # shard_map/pmap with that axis bound (MPI_PS's loss_fn path is).
+    # None = per-device BN (each replica normalizes with its local batch).
+    bn_axis: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -98,7 +106,8 @@ class ResNet(nn.Module):
             norm = functools.partial(AdaptiveGroupNorm, dtype=self.dtype)
         else:
             norm = functools.partial(
-                nn.BatchNorm, use_running_average=not train, dtype=self.dtype
+                nn.BatchNorm, use_running_average=not train, dtype=self.dtype,
+                axis_name=self.bn_axis,
             )
         x = x.astype(self.dtype)
         if self.small_inputs:

@@ -1,26 +1,45 @@
 """Parallelism strategies.
 
 The reference is data-parallel only (SURVEY §2.5); this package provides
-its two DP topologies plus the async mode, and goes beyond it with
-sequence/context parallelism (ring attention) — the natural extension the
-comms layer's ``ppermute`` ring primitive enables.
+its two DP topologies plus the async mode, and completes the parallelism
+matrix beyond it — sequence/context (ring attention), tensor (Megatron),
+pipeline (GPipe), and expert (GShard MoE) parallelism, all composing
+over one ``jax.sharding.Mesh``.
 
 - ``dp``: functional sync data-parallel train-step builder (decentralized
   allgather-sum and leader-PS topologies — reference ``ps.py:75`` and
   ``mpi_comms.py:60-133``).
 - ``async_ps``: AsySG-InCon bounded-staleness asynchronous training
   (reference README.md:56-81, Lian et al. 2015).
+- ``async_train``: the full async stack across OS processes with real
+  jitted compute (workers: jitted value_and_grad -> codec encode -> shm
+  payload bytes; server: jitted decode + fused updates in arrival order).
+- ``dcn``: the multi-process shared-memory PS transport + codec wire.
 - ``ring``: ring attention over a sequence-sharded mesh axis (context
   parallelism; no reference analog — TPU-first extension).
+- ``tp``: Megatron column/row tensor parallelism (one psum per block).
+- ``pp``: GPipe microbatch pipeline parallelism (scan + ppermute,
+  backward via autodiff; vma-checked shard_map required).
+- ``ep``: GShard top-1 MoE expert parallelism (capacity dispatch +
+  all_to_all; vma-checked shard_map when differentiating).
 """
 
 from pytorch_ps_mpi_tpu.parallel.dp import make_sync_train_step
 from pytorch_ps_mpi_tpu.parallel.async_ps import AsyncPS
 from pytorch_ps_mpi_tpu.parallel.ring import ring_attention, ring_self_attention
+from pytorch_ps_mpi_tpu.parallel.tp import tp_mlp, tp_self_attention
+from pytorch_ps_mpi_tpu.parallel.pp import pipeline_apply, pipeline_loss
+from pytorch_ps_mpi_tpu.parallel.ep import moe_apply, moe_dense_oracle
 
 __all__ = [
     "make_sync_train_step",
     "AsyncPS",
     "ring_attention",
     "ring_self_attention",
+    "tp_mlp",
+    "tp_self_attention",
+    "pipeline_apply",
+    "pipeline_loss",
+    "moe_apply",
+    "moe_dense_oracle",
 ]

@@ -1,0 +1,142 @@
+"""Expert parallelism: GShard-style top-1 MoE over a mesh axis.
+
+No reference analog (SURVEY §2.5: EP absent — out of reference scope) —
+added to complete the parallelism matrix (DP × SP × TP × PP × EP). The
+design is the canonical TPU one (Lepikhin et al. 2020, GShard,
+arXiv:2006.16668 — public technique): static-shape capacity-limited
+dispatch so XLA sees fixed tensors, and ``lax.all_to_all`` over the
+expert axis as the only collective — the exact op class the reference's
+MPI stack explored but never shipped (``test_mpi.py:20`` Ialltoallv).
+
+Shapes (inside ``shard_map`` with ``expert_axis`` of size D bound):
+
+- tokens ``x [n_loc, d]`` — this device's slice of the batch.
+- every device holds ``e_loc = E // D`` experts' FFN weights, stacked on
+  a leading local axis (host-side ``[E, ...]`` sharded ``P(expert_axis)``).
+- router weights ``wr [d, E]`` replicated.
+
+Per device: route → build per-expert capacity buffers ``[E, C, d]`` →
+``all_to_all`` (each device sends every other device the buffer slots of
+THAT device's experts, receives its own experts' tokens from everyone)
+→ run local experts → ``all_to_all`` back → combine with the gate.
+
+Capacity semantics: ``C`` is per **(expert, source device)** — each
+device dispatches at most C of ITS tokens to any one expert, so an
+expert serves up to ``n_dev * C`` tokens per step and the dispatch/
+all_to_all buffers are ``[E, C, d]`` *per device*. Sizing against a
+GShard-style global per-expert budget B means ``capacity = B / n_dev``.
+Overflowing tokens are dropped (output 0 for them — GShard semantics);
+size C generously in tests to compare exactly against the dense oracle.
+
+Like ``parallel/pp.py``: wrap in a vma-checked ``shard_map`` (the default
+``check_vma=True``) when differentiating, so the collective transposes
+are exact; shard tokens over the expert axis (or jointly over data ×
+expert — the GShard layout) so each device contributes its own slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def init_moe(key, d: int, f: int, n_experts: int, scale: float = 0.1) -> PyTree:
+    """Host-side MoE params: router (replicated) + per-expert FFN weights
+    stacked on a leading ``[E]`` axis for ``P(expert_axis)`` sharding."""
+    kr, k1, k2 = jax.random.split(key, 3)
+    return {
+        "wr": scale * jax.random.normal(kr, (d, n_experts), jnp.float32),
+        "w1": scale * jax.random.normal(k1, (n_experts, d, f), jnp.float32),
+        "w2": scale * jax.random.normal(k2, (n_experts, f, d), jnp.float32),
+    }
+
+
+def moe_spec(params: PyTree, expert_axis: str):
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "wr": P(),
+        "w1": P(expert_axis),
+        "w2": P(expert_axis),
+    }
+
+
+def _route_top1(x, wr) -> Tuple[jax.Array, jax.Array]:
+    """(expert index, gate) per token — softmax prob of the argmax."""
+    probs = jax.nn.softmax(x @ wr, axis=-1)          # [n, E]
+    eidx = jnp.argmax(probs, axis=-1)                # [n]
+    gate = jnp.take_along_axis(probs, eidx[:, None], axis=1)[:, 0]
+    return eidx, gate
+
+
+def moe_apply(
+    x: jax.Array,
+    params: Dict[str, jax.Array],
+    expert_axis: str,
+    *,
+    capacity: int,
+) -> jax.Array:
+    """Top-1 MoE forward for this device's tokens.
+
+    Returns ``[n_loc, d]``: each token's gated expert output (zeros for
+    capacity-dropped tokens). Differentiable end to end — the dispatch/
+    combine are scatter-adds/gathers and the collective is all_to_all
+    (whose transpose is the reverse all_to_all).
+    """
+    n_loc, d = x.shape
+    n_dev = lax.axis_size(expert_axis)
+    w1, w2 = params["w1"], params["w2"]         # [e_loc, d, f], [e_loc, f, d]
+    e_loc = w1.shape[0]
+    n_experts = params["wr"].shape[1]
+    assert n_experts == n_dev * e_loc, (n_experts, n_dev, e_loc)
+
+    eidx, gate = _route_top1(x, params["wr"])   # [n], [n]
+
+    # slot of each token within its expert's capacity buffer (among THIS
+    # device's tokens): running count of same-expert tokens before it
+    onehot = jax.nn.one_hot(eidx, n_experts, dtype=jnp.int32)      # [n, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot                       # 1-based
+    slot0 = pos.max(axis=1) - 1                                     # [n]
+    keep = (slot0 >= 0) & (slot0 < capacity)
+    slot = jnp.clip(slot0, 0, capacity - 1)
+
+    # dispatch: [E, C, d] buffer, capacity-dropped tokens masked out
+    buf = jnp.zeros((n_experts, capacity, d), x.dtype)
+    buf = buf.at[eidx, slot].add(
+        jnp.where(keep[:, None], x, jnp.zeros_like(x))
+    )
+
+    # all_to_all over the expert axis: send device j its experts' slots,
+    # receive my experts' tokens from every device
+    buf = buf.reshape(n_dev, e_loc, capacity, d)
+    recv = lax.all_to_all(buf, expert_axis, split_axis=0, concat_axis=0)
+    # [n_dev, e_loc, C, d] — recv[j] = device j's tokens for MY experts
+
+    tok = recv.transpose(1, 0, 2, 3).reshape(e_loc, n_dev * capacity, d)
+    h = jax.nn.gelu(jnp.einsum("etd,edf->etf", tok, w1))
+    y = jnp.einsum("etf,efd->etd", h, w2)
+    y = y.reshape(e_loc, n_dev, capacity, d).transpose(1, 0, 2, 3)
+
+    # return trip: outputs for device j's tokens go back to device j
+    back = lax.all_to_all(y, expert_axis, split_axis=0, concat_axis=0)
+    out_buf = back.reshape(n_experts, capacity, d)
+
+    # combine: each kept token reads its slot, scaled by its gate
+    tok_out = out_buf[eidx, slot] * gate[:, None]
+    return jnp.where(keep[:, None], tok_out, jnp.zeros_like(tok_out))
+
+
+def moe_dense_oracle(x: jax.Array, params: Dict[str, jax.Array]) -> jax.Array:
+    """Single-device reference: every token through its own top-1 expert
+    (no capacity limit) — the equality oracle for tests."""
+    eidx, gate = _route_top1(x, params["wr"])
+    w1 = params["w1"][eidx]                      # [n, d, f]
+    w2 = params["w2"][eidx]                      # [n, f, d]
+    h = jax.nn.gelu(jnp.einsum("td,tdf->tf", x, w1))
+    y = jnp.einsum("tf,tfd->td", h, w2)
+    return y * gate[:, None]

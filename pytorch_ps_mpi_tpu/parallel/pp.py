@@ -1,0 +1,158 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+No reference analog (SURVEY §2.5 marks PP "not required for parity" —
+the reference's constraint was models-fit-on-one-device) — added so the
+parallelism matrix (DP × SP × TP × PP) is complete. TPU-first shape:
+
+- **Homogeneous stages.** The pipelined body is S copies of one stage
+  function (the standard homogeneous-transformer-stack setting); stage
+  s's parameters carry a leading ``[pipe]`` shard axis, sharded
+  ``P(pipe_axis)`` host-side — each device owns exactly its stage's
+  weights AND (because grads come back shard-local) its stage's
+  optimizer state: pipeline parallelism shards the optimizer for free.
+- **One XLA program.** The schedule is a ``lax.scan`` over S + M − 1
+  ticks inside ``shard_map``: at tick t, device s runs the stage on
+  microbatch t − s (garbage-in, masked-out when t − s is outside
+  [0, M)), then hands its activation to stage s+1 with a one-hop
+  ``lax.ppermute`` — the same neighbor primitive ring attention uses.
+  XLA overlaps the permute with the next tick's compute.
+- **Training via autodiff.** ``jax.grad`` through the scan + ppermute
+  yields the reverse pipeline automatically (ppermute's transpose is the
+  reverse hop), so ``value_and_grad(pipeline loss)`` IS the backward
+  schedule — no hand-written 1F1B state machine to get wrong. The cost
+  is GPipe's bubble (S − 1 idle ticks per direction), amortized by M.
+
+All functions run INSIDE ``shard_map`` with ``pipe_axis`` bound, mirroring
+``parallel/tp.py``'s convention (leading local shard axis squeezed with
+``x[0]``).
+
+IMPORTANT: wrap these in ``shard_map`` with vma checking ENABLED (the
+default ``check_vma=True``). With ``check_vma=False`` the transpose of
+``lax.psum`` degrades to another psum, so differentiating through the
+final loss/output replication multiplies every gradient by the stage
+count (observed: exactly S× too large). The scan initializers below are
+built device-varying (the ring.py trick) so the carry typechecks under
+vma."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PyTree = Any
+
+
+def pipeline_apply(
+    stage_params: PyTree,
+    x_mb: jax.Array,
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    pipe_axis: str,
+) -> jax.Array:
+    """Run ``stage_fn`` S times (once per pipeline stage) over M
+    microbatches.
+
+    Args:
+      stage_params: THIS device's stage parameters (leaves carry the
+        local ``[1, ...]`` shard axis of the host-side ``[pipe, ...]``
+        stack; squeezed here).
+      x_mb: ``[M, mb, ...]`` microbatched input, replicated across the
+        pipe axis (stage 0 consumes it).
+      stage_fn: ``stage_fn(params, x) -> y`` with ``y.shape == x.shape``
+        (homogeneous stages; the transformer-stack case).
+      pipe_axis: mesh axis name the stages live on.
+
+    Returns ``[M, mb, ...]`` outputs of the final stage, replicated
+    across the pipe axis (devices other than the last contribute zeros
+    to a psum, so every device returns the same value — out_specs P()).
+    """
+    out, is_last = _pipeline_scan(stage_params, x_mb, stage_fn, pipe_axis)
+    # only the last stage holds real outputs; replicate via psum
+    return lax.psum(jnp.where(is_last, out, 0.0), pipe_axis)
+
+
+def _pipeline_scan(stage_params, x_mb, stage_fn, pipe_axis):
+    """The tick schedule. Returns (out, is_last): ``out`` holds the real
+    final-stage outputs only on the last stage (zeros elsewhere) —
+    consumers mask with ``is_last`` and psum to replicate."""
+    params = jax.tree.map(lambda p: p[0], stage_params)
+    s_count = lax.axis_size(pipe_axis)
+    my_stage = lax.axis_index(pipe_axis)
+    m = x_mb.shape[0]
+    is_first = my_stage == 0
+    is_last = my_stage == s_count - 1
+    ticks = s_count + m - 1
+    # device-varying zero (axis_index varies over the pipe axis): the
+    # scan carries are written with stage-varying data every tick, so
+    # their initial vma type must already vary or check_vma rejects the
+    # loop (same trick as ring.py's accumulator init)
+    vzero = (my_stage * 0).astype(x_mb.dtype)
+
+    def tick(carry, t):
+        cur, out = carry
+        mb_idx = t - my_stage                     # microbatch this stage sees
+        valid = (mb_idx >= 0) & (mb_idx < m)
+        feed = x_mb[jnp.clip(t, 0, m - 1)]
+        x_in = jnp.where(is_first, feed, cur)
+        # double-where: on warmup/drain ticks this stage holds garbage
+        # (zeros or a dead activation); substitute a benign input BEFORE
+        # the stage so fns with data-dependent division (RMS-norm etc.)
+        # stay finite — otherwise the NaN reaches the banked outputs via
+        # 0*NaN in the mask (forward) or the zero-cotangent VJP (backward)
+        safe_in = jnp.where(valid, x_in, jnp.ones_like(x_in))
+        y = stage_fn(params, safe_in)
+        # last stage banks finished microbatches (select, not multiply)
+        slot = jnp.clip(mb_idx, 0, m - 1)
+        write = is_last & valid
+        out = out.at[slot].add(jnp.where(write, y, jnp.zeros_like(y)))
+        # hand the activation to the next stage (ring hop; the wrap-around
+        # S-1 -> 0 edge carries garbage that stage 0 ignores via is_first)
+        nxt = lax.ppermute(
+            y, pipe_axis, [(i, (i + 1) % s_count) for i in range(s_count)]
+        )
+        return (nxt, out), None
+
+    out0 = x_mb * 0 + vzero
+    cur0 = x_mb[0] * 0 + vzero
+    (_, out), _ = lax.scan(tick, (cur0, out0), jnp.arange(ticks))
+    return out, is_last
+
+
+def pipeline_loss(
+    stage_params: PyTree,
+    x_mb: jax.Array,
+    y_mb: jax.Array,
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    pipe_axis: str,
+) -> jax.Array:
+    """Mean of ``loss_fn(pipeline(x_mb), y_mb)`` over microbatches —
+    differentiate THIS with ``jax.grad`` for the backward pipeline; the
+    returned gradients for ``stage_params`` are shard-local (each device
+    gets d/d(its own stage's weights)).
+
+    The scalar is computed on the LAST stage only and psum-replicated —
+    one live loss copy, one cotangent stream through the reverse ring.
+    Requires a vma-checked shard_map (module docstring)."""
+    out, is_last = _pipeline_scan(stage_params, x_mb, stage_fn, pipe_axis)
+    local_loss = jax.vmap(loss_fn)(out, y_mb).mean()
+    return lax.psum(jnp.where(is_last, local_loss, 0.0), pipe_axis)
+
+
+def init_stage_stack(key, s_count: int, init_one: Callable) -> PyTree:
+    """Host-side ``[pipe]``-stacked parameters: ``init_one(key_i)`` per
+    stage, leaves stacked on a new leading axis for ``P(pipe_axis)``
+    sharding (the tp.py convention)."""
+    keys = jax.random.split(key, s_count)
+    stages = [init_one(k) for k in keys]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def stage_spec(params: PyTree, pipe_axis: str):
+    """PartitionSpec pytree: every stacked leaf sharded over the pipe
+    axis."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _: P(pipe_axis), params)

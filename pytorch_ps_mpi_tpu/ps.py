@@ -595,10 +595,15 @@ class MPI_PS:
         With ``has_aux``, ``loss_fn(params, aux_state, batch) -> (loss,
         new_aux_state)`` supports mutable-state models (flax
         ``batch_stats``): each step's per-worker aux is cross-replica
-        averaged with ``pmean``. Note this averages the *running* stats
-        across replicas — normalization inside the forward pass still uses
-        per-replica batch statistics (plain per-device BN, not full
-        SyncBatchNorm semantics)."""
+        averaged with ``pmean``. By default that averages only the
+        *running* stats — normalization inside the forward still uses
+        per-replica batch statistics (plain per-device BN). For TRUE
+        SyncBatchNorm semantics, build the model with its BN axis bound
+        to this optimizer's data axis (e.g. ``ResNet(norm='batch',
+        bn_axis='data')``): flax's BatchNorm then psum-averages the batch
+        statistics across replicas inside this shard_map, matching a
+        single device seeing the global batch (equivalence tested in
+        ``tests/test_models.py::test_syncbn_matches_global_batch_oracle``)."""
         axis = self.axis_name
 
         def spmd(params, opt_state, codec_state, batch, rng, *maybe_aux):
@@ -669,16 +674,23 @@ class MPI_PS:
         )
 
     def step_accumulate(
-        self, loss_fn: Callable, microbatches: PyTree
+        self, loss_fn: Callable, microbatches: PyTree, *,
+        profile: bool = False,
     ) -> Tuple[jax.Array, Dict[str, float]]:
         """One optimizer step over ``accum_steps`` microbatches per worker.
         ``microbatches`` leaves are ``[accum_steps, global_batch, ...]``;
-        returns ``(mean_loss, data)``."""
+        returns ``(mean_loss, data)``.
+
+        ``instrument=True`` cannot stage-time this path (the accumulation
+        scan is one fused program by design); ``profile=True`` CAN — it
+        traces the fused program and fills ``comm_wait`` with the real
+        per-device collective time, same as :meth:`step`."""
         if self.instrument:
             raise NotImplementedError(
                 "instrument=True does not support step_accumulate (the "
                 "accumulation scan is one fused program; per-stage times "
-                "are not separable)"
+                "are not separable) — use step_accumulate(profile=True) "
+                "for the trace-derived comm/compute split instead"
             )
         accum_steps = int(jax.tree.leaves(microbatches)[0].shape[0])
         key = ("accum", _fn_cache_key(loss_fn), accum_steps)
@@ -688,9 +700,14 @@ class MPI_PS:
         data = self._schema_dict()
         data["accum_steps"] = float(accum_steps)
         self._rng, rng = jax.random.split(self._rng)
-        self.params, self.opt_state, self.codec_state, loss = self._compiled[key](
+        call = lambda: self._compiled[key](
             self.params, self.opt_state, self.codec_state, microbatches, rng
         )
+        if profile:
+            out, _ = self._profiled_call(call, data)
+        else:
+            out = call()
+        self.params, self.opt_state, self.codec_state, loss = out
         jax.block_until_ready(self.params)
         self._step_count += 1
         data["step_time"] = time.perf_counter() - t0
@@ -779,13 +796,23 @@ class MPI_PS:
         self._rng, rng = jax.random.split(self._rng)
 
         if self.instrument:
+            if profile:
+                raise ValueError(
+                    "profile=True and instrument=True are mutually "
+                    "exclusive: instrument runs a staged pipeline (host "
+                    "walls per stage) while profile traces the fused "
+                    "program — construct the optimizer without "
+                    "instrument=True to use profile"
+                )
             if loss_fn is None and grads is None:
                 raise ValueError("pass grads or loss_fn+batch")
             if loss_fn is not None and batch is None:
                 raise ValueError("loss_fn requires batch")
             if aux_state is not None:
                 raise NotImplementedError(
-                    "instrument=True does not support aux_state models yet"
+                    "instrument=True does not support aux_state models yet "
+                    "— step(..., profile=True) works with aux_state and "
+                    "yields the trace-derived comm/compute split"
                 )
             loss = self._step_instrumented(
                 data, rng, grads=grads, loss_fn=loss_fn, batch=batch

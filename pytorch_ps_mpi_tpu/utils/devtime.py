@@ -70,10 +70,11 @@ def safe_ratio(num: float, den: float) -> float:
 def fetch_sync(out: Any) -> None:
     """Force *real* completion of ``out`` by fetching one scalar element
     of its first array leaf (a data-dependent host read — the only sync
-    primitive the tunneled backend honors)."""
+    primitive the tunneled backend honors). Non-array leaves (a Python
+    float metric first in the pytree) are already host values."""
     leaf = jax.tree.leaves(out)[0]
-    idx = (0,) * getattr(leaf, "ndim", 0)
-    np.asarray(jax.device_get(leaf[idx] if leaf.ndim else leaf))
+    ndim = getattr(leaf, "ndim", 0)
+    np.asarray(jax.device_get(leaf[(0,) * ndim] if ndim else leaf))
 
 
 def rtt_floor(reps: int = 10) -> float:

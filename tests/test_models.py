@@ -144,3 +144,55 @@ def test_resnet_batchnorm_aux_state_distributed(mesh8):
         last, _ = opt.step(loss_fn=loss_fn, batch=(x0, y0),
                            aux_state=opt.aux_state)
     assert np.isfinite(float(last))
+
+
+def test_syncbn_matches_global_batch_oracle(mesh8):
+    """TRUE SyncBatchNorm (VERDICT r2 item 9): with ``bn_axis='data'``,
+    a data-sharded forward inside shard_map must produce exactly the
+    logits and updated running stats of one device seeing the global
+    batch — torch DDP SyncBatchNorm semantics, realized as a psum in the
+    flax BatchNorm instead of a separate wrapper module."""
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_ps_mpi_tpu.models import ResNet18
+
+    sync = ResNet18(num_classes=4, small_inputs=True, num_filters=8,
+                    norm="batch", bn_axis="data")
+    dense = ResNet18(num_classes=4, small_inputs=True, num_filters=8,
+                     norm="batch")  # bn_axis=None: plain BN
+
+    x = jax.random.normal(jax.random.key(1), (16, 8, 8, 3))
+    # init under train=False: stats aren't computed, so no bound axis
+    # is needed at init time
+    variables = dense.init(jax.random.key(0), x[:1], train=False)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    def fwd_sync(p, aux, x):
+        return sync.apply(
+            {"params": p, "batch_stats": aux}, x, train=True,
+            mutable=["batch_stats"],
+        )
+
+    logits_sh, upd_sh = jax.jit(
+        jax.shard_map(
+            fwd_sync, mesh=mesh8,
+            in_specs=(P(), P(), P("data")),
+            out_specs=(P("data"), P()),
+            check_vma=False,
+        )
+    )(params, stats, x)
+
+    logits_ref, upd_ref = dense.apply(
+        {"params": params, "batch_stats": stats}, x, train=True,
+        mutable=["batch_stats"],
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(logits_sh), np.asarray(logits_ref), rtol=2e-5, atol=2e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(upd_sh["batch_stats"]),
+        jax.tree.leaves(upd_ref["batch_stats"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)

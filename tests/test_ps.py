@@ -411,3 +411,22 @@ def test_profile_step_fills_trace_derived_comm_split(mesh8):
     np.testing.assert_allclose(
         np.asarray(opt.params["w"]), np.asarray(opt2.params["w"])
     )
+
+
+def test_profile_step_accumulate(mesh8):
+    """step_accumulate(profile=True): the one fused-program path that
+    instrument=True structurally cannot stage-time gets its comm split
+    from the trace instead."""
+    params = {"w": jnp.zeros((64,), jnp.float32)}
+    opt = SGD(params, lr=0.1, mesh=mesh8)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    x = jax.random.normal(jax.random.key(0), (2, 16, 64))   # [accum, batch, d]
+    y = jax.random.normal(jax.random.key(1), (2, 16))
+    loss, data = opt.step_accumulate(loss_fn, (x, y), profile=True)
+    assert np.isfinite(float(loss))
+    assert data["comm_wait"] > 0.0
+    assert data["profile_devices"] == 8
