@@ -48,7 +48,6 @@ from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM, mlm_loss
 from pytorch_ps_mpi_tpu.optim import AdamHyper, adam_update, init_adam_state
 from pytorch_ps_mpi_tpu.utils.devtime import (
     codec_roundtrip_seconds,
-    fetch_sync,
     peak_flops_for,
     rtt_floor,
     safe_ratio,
@@ -108,8 +107,6 @@ def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10):
         (p, s), _ = jax.lax.scan(body, (params, state), None, length=scan_k)
         return p, s
 
-    fetch_sync(fn(params, state, b))
-    fetch_sync(scanned(params, state, b))
     wall_s, dev_s = timed(
         lambda: fn(params, state, b),
         lambda: scanned(params, state, b),
@@ -143,11 +140,13 @@ def distributed_bench(seq: int, reps: int = 3):
     cfg = BertConfig(max_position=max(512, seq))
     model = BertMLM(cfg)
     cpu0 = cpu_devices[0]
-    b = jax.device_put(
-        make_batch(jax.random.key(1), 8, seq, cfg.vocab_size), cpu0
-    )
     with jax.default_device(cpu0):
+        b = make_batch(jax.random.key(1), 8, seq, cfg.vocab_size)
         params = jax.jit(model.init)(jax.random.key(0), b[0][:1])
+    # rehost: single-device-committed arrays conflict with the 8-device
+    # shard_map placement; numpy leaves let the jitted step shard freely
+    params = jax.tree.map(np.asarray, params)
+    b = jax.tree.map(np.asarray, b)
     opt = Adam(params, lr=1e-4, mesh=mesh)
 
     def loss_fn(p, batch):

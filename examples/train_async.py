@@ -89,6 +89,7 @@ def main(argv=None):
         max_staleness=args.max_staleness, code=code,
     )
     total = args.workers * args.steps
+    procs = []
     try:
         procs = [spawn_worker(name, i, cfg) for i in range(args.workers)]
         params, metrics = serve(
@@ -101,6 +102,10 @@ def main(argv=None):
                 raise SystemExit(f"worker exited {rc}")
     finally:
         server.close()
+        for p in procs:  # never leave orphan workers if serve() raised
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
 
     print(json.dumps(metrics, default=str))
     return metrics

@@ -11,5 +11,6 @@ bfloat16-friendly, static shapes, ring-attention option for long context.
 from pytorch_ps_mpi_tpu.models.mlp import MLP
 from pytorch_ps_mpi_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM
+from pytorch_ps_mpi_tpu.models.moe import SwitchConfig, SwitchMLM
 
-__all__ = ["MLP", "ResNet", "ResNet18", "ResNet50", "BertConfig", "BertMLM"]
+__all__ = ["MLP", "ResNet", "ResNet18", "ResNet50", "BertConfig", "BertMLM", "SwitchConfig", "SwitchMLM"]

@@ -21,6 +21,14 @@ cheaper (ICI) and preferred — SURVEY §2.5's disposition.
 Codec compression applies on the simulated wire: each worker's gradient
 goes encode → decode before the server sees it, matching the reference's
 encode-before-send/decode-on-receive placement (``ps.py:94,166``).
+
+Scope note: this module is the *algorithm-semantics* vehicle (bounded
+staleness as explicit data inside one XLA program, on a fixed schedule);
+the *wall-clock* benefit asynchrony exists for — fast workers streaming
+past a straggler — is demonstrated by the multi-process stack with real
+jitted compute in ``parallel/async_train.py`` (measured 2.7× a
+synchronous barrier under a forced straggler,
+``benchmarks/async_bench.py``).
 """
 
 from __future__ import annotations

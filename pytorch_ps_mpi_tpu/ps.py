@@ -689,7 +689,8 @@ class MPI_PS:
             raise NotImplementedError(
                 "instrument=True does not support step_accumulate (the "
                 "accumulation scan is one fused program; per-stage times "
-                "are not separable) — use step_accumulate(profile=True) "
+                "are not separable) — construct the optimizer WITHOUT "
+                "instrument=True and call step_accumulate(profile=True) "
                 "for the trace-derived comm/compute split instead"
             )
         accum_steps = int(jax.tree.leaves(microbatches)[0].shape[0])

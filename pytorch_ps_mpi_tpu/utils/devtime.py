@@ -69,12 +69,16 @@ def safe_ratio(num: float, den: float) -> float:
 
 def fetch_sync(out: Any) -> None:
     """Force *real* completion of ``out`` by fetching one scalar element
-    of its first array leaf (a data-dependent host read — the only sync
-    primitive the tunneled backend honors). Non-array leaves (a Python
-    float metric first in the pytree) are already host values."""
-    leaf = jax.tree.leaves(out)[0]
-    ndim = getattr(leaf, "ndim", 0)
-    np.asarray(jax.device_get(leaf[(0,) * ndim] if ndim else leaf))
+    of its first ARRAY leaf (a data-dependent host read — the only sync
+    primitive the tunneled backend honors). Host-scalar leaves (Python
+    floats mixed into a metrics pytree) are skipped — syncing on one of
+    those would await nothing."""
+    leaf = next(
+        (l for l in jax.tree.leaves(out) if hasattr(l, "ndim")), None
+    )
+    if leaf is None:
+        return  # no array leaves: nothing on device to await
+    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim] if leaf.ndim else leaf))
 
 
 def rtt_floor(reps: int = 10) -> float:
@@ -105,16 +109,26 @@ def timed(
     """(per-call wall seconds incl. fetch, per-step device seconds).
 
     ``call()`` runs one step; ``scanned_call()`` runs ``k`` dependent
-    steps in one program (callers build it with ``lax.scan``). Both are
-    assumed pre-compiled (invoke once before timing).
+    steps in one program (callers build it with ``lax.scan``). Warm-up
+    (compile) of both is handled HERE — callers must not pre-run
+    ``scanned_call`` themselves, because on a backend with a negligible
+    fetch RTT (< 1 ms — the host CPU fallback, where block/fetch are
+    genuinely synchronous) the scanned pass is skipped entirely: per-call
+    wall already IS device time, and even one warm-up execution of a
+    k-step program would multiply an already-slow fallback's wall clock
+    for no information.
     """
     rtt = rtt_floor()
+    fetch_sync(call())  # compile + warm
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
         fetch_sync(call())
         ts.append(time.perf_counter() - t0)
     per_call = min(ts)
+    if rtt < 1e-3:
+        return per_call, per_call
+    fetch_sync(scanned_call())  # compile + warm (only when it will run)
     ts = []
     for _ in range(max(3, reps // 2)):
         t0 = time.perf_counter()

@@ -113,3 +113,35 @@ def test_print_summary(capsys):
     print_summary({"a": jnp.zeros((3, 4)), "b": [1, jnp.ones(2)], "c": "x"})
     out = capsys.readouterr().out
     assert "array(3, 4)" in out and "'x'" in out
+
+
+def test_devtime_helpers():
+    """fetch_sync forces completion on any pytree (incl. a non-array
+    first leaf); safe_ratio never raises on the RTT-noise zero clamp;
+    scan_timed measures a pre-compiled loop without crashing on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_ps_mpi_tpu.utils.devtime import (
+        fetch_sync,
+        rtt_floor,
+        safe_ratio,
+        scan_timed,
+    )
+
+    fetch_sync((1.0, jnp.ones((3, 3))))  # tuple: float genuinely first
+    fetch_sync({"metric": 1.0})          # no array leaves at all
+    fetch_sync(jnp.ones(()))             # 0-d array
+    assert safe_ratio(1.0, 0.0) == 0.0
+    assert safe_ratio(6.0, 3.0) == 2.0
+    assert rtt_floor() >= 0.0
+
+    @jax.jit
+    def loop(x):
+        def body(c, _):
+            return c * 1.000001, None
+        out, _ = jax.lax.scan(body, x, None, length=4)
+        return out
+
+    t = scan_timed(lambda: loop(jnp.ones((8, 8))), k=4)
+    assert t >= 0.0
