@@ -337,7 +337,9 @@ def main():
 
     ref_s = run_reference_baseline(shapes)
     ours_wall_s, ours_dev_s = run_ours(structs)
-    if rtt_floor() >= 1e-3:
+    from pytorch_ps_mpi_tpu.utils.devtime import scan_pass_runs
+
+    if scan_pass_runs():
         method = (
             f"value = device time per step from a fused {SCAN_K}-step scan "
             "(carry-dependent grads, so aggregation cannot be hoisted) with "

@@ -20,6 +20,17 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
+from pytorch_ps_mpi_tpu.utils.backend_guard import (
+    enable_compilation_cache,
+    ensure_live_backend,
+)
+
+# probe the accelerator BEFORE jax initializes a backend: the axon TPU
+# tunnel can hang indefinitely on the first device op when it is down,
+# and this CLI should fall back to the host CPU instead of freezing
+ensure_live_backend()
+enable_compilation_cache()
+
 import jax
 import jax.numpy as jnp
 
@@ -30,12 +41,26 @@ from pytorch_ps_mpi_tpu.models import MLP, BertConfig, BertMLM, ResNet18, ResNet
 from pytorch_ps_mpi_tpu.models.bert import mlm_loss
 from pytorch_ps_mpi_tpu.trainer import Trainer
 
-CONFIGS = ["mlp_mnist", "resnet18_cifar10", "resnet50_imagenet", "bert_mlm"]
+CONFIGS = ["mlp_mnist", "resnet18_cifar10", "resnet50_imagenet", "bert_mlm",
+           "switch_mlm"]
 
 
 def build(config: str, batch: int, seed: int = 0):
     """Returns (params, loss_fn, batch_iterator)."""
     key = jax.random.key(seed)
+    if config == "switch_mlm":
+        from pytorch_ps_mpi_tpu.models import SwitchConfig, SwitchMLM
+
+        scfg = SwitchConfig(vocab_size=8192, hidden_size=256, num_layers=4,
+                            num_heads=8, intermediate_size=512, n_experts=8,
+                            max_position=128)
+        model = SwitchMLM(scfg)
+        data = synthetic_mlm(batch, seq_len=128, vocab_size=scfg.vocab_size)
+        b0 = next(data)
+        params = model.init(key, b0["tokens"])
+        def loss_fn(p, b):
+            return mlm_loss(model.apply(p, b["tokens"]), b["targets"], b["mask"])
+        return params, loss_fn, data
     if config == "mlp_mnist":
         model = MLP(features=(128, 10))
         data = synthetic_images("mnist", batch)

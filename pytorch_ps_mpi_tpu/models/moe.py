@@ -85,15 +85,9 @@ class MoEFFN(nn.Module):
         if c.expert_axis is not None:
             out = moe_apply(tok, params, c.expert_axis, capacity=c.capacity)
         else:
-            # dense routing (single-device / oracle): gather each token's
-            # expert weights
-            probs = jax.nn.softmax(tok @ params["wr"], axis=-1)
-            eidx = jnp.argmax(probs, axis=-1)
-            gate = jnp.take_along_axis(probs, eidx[:, None], axis=1)[:, 0]
-            w1 = params["w1"][eidx]
-            w2 = params["w2"][eidx]
-            h = jax.nn.gelu(jnp.einsum("td,tdf->tf", tok, w1))
-            out = jnp.einsum("tf,tfd->td", h, w2) * gate[:, None]
+            from pytorch_ps_mpi_tpu.parallel.ep import moe_dense_oracle
+
+            out = moe_dense_oracle(tok, params)
         return out.reshape(b, l, d)
 
 

@@ -133,10 +133,18 @@ def moe_apply(
 
 def moe_dense_oracle(x: jax.Array, params: Dict[str, jax.Array]) -> jax.Array:
     """Single-device reference: every token through its own top-1 expert
-    (no capacity limit) — the equality oracle for tests."""
+    (no capacity limit) — the equality oracle for tests AND the dense
+    fallback ``models/moe.py`` runs outside ``shard_map``.
+
+    Computes all experts for all tokens and combines with a one-hot
+    select (n·E·f work) rather than gathering per-token weight copies: a
+    ``w1[eidx]`` gather materializes ``[n, d, f]`` — 4.3 GB per layer at
+    8K tokens for BERT-ish sizes — while the all-experts activations are
+    ``[n, E, f]``, ~30x smaller there. Gradients are identical: the
+    one-hot zeroes non-selected experts' paths exactly like the gather.
+    """
     eidx, gate = _route_top1(x, params["wr"])
-    w1 = params["w1"][eidx]                      # [n, d, f]
-    w2 = params["w2"][eidx]                      # [n, f, d]
-    h = jax.nn.gelu(jnp.einsum("td,tdf->tf", x, w1))
-    y = jnp.einsum("tf,tfd->td", h, w2)
-    return y * gate[:, None]
+    h = jax.nn.gelu(jnp.einsum("td,edf->tef", x, params["w1"]))
+    y_all = jnp.einsum("tef,efd->ted", h, params["w2"])
+    onehot = jax.nn.one_hot(eidx, params["wr"].shape[1], dtype=x.dtype)
+    return jnp.einsum("ted,te->td", y_all, onehot) * gate[:, None]

@@ -45,6 +45,14 @@ def ensure_live_backend(
     """
     import jax
 
+    # already pinned to the host platform (e.g. the test conftest) —
+    # there is no accelerator to probe, and a probe subprocess would try
+    # the axon plugin anyway (it ignores the JAX_PLATFORMS env var) and
+    # hang the caller for the full timeout
+    pinned = jax.config.jax_platforms
+    if pinned and "cpu" in str(pinned):
+        return False
+
     if timeout is None:
         timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "420"))
     if retries is None:

@@ -31,6 +31,18 @@ import numpy as np
 
 _RTT: float | None = None
 
+# Below this fetch RTT the backend is effectively synchronous: per-call
+# wall IS device time and the scanned pass is skipped. The ONE constant
+# both timed() and its callers' provenance labels consult.
+RTT_SCAN_THRESHOLD = 1e-3
+
+
+def scan_pass_runs() -> bool:
+    """True iff :func:`timed` will run (and subtract-RTT-amortize) the
+    scanned pass on this backend — callers labeling methodology must use
+    this, not a re-derived threshold."""
+    return rtt_floor() >= RTT_SCAN_THRESHOLD
+
 # bf16 peak FLOP/s per JAX device, keyed by device_kind substring
 # (lowercased) — the single table every benchmark's MFU is reported
 # against (v3 entry is per core; 2 cores/chip).
@@ -126,7 +138,7 @@ def timed(
         fetch_sync(call())
         ts.append(time.perf_counter() - t0)
     per_call = min(ts)
-    if rtt < 1e-3:
+    if not scan_pass_runs():
         return per_call, per_call
     fetch_sync(scanned_call())  # compile + warm (only when it will run)
     ts = []
