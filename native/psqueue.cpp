@@ -227,6 +227,18 @@ int psq_push_grad(void* hv, uint32_t worker, const uint8_t* buf, uint64_t len,
   return 1;
 }
 
+// Server/controller: forcibly return a worker's mailbox to EMPTY. For
+// elastic replacement of a CRASHED worker: a process killed inside its
+// WRITING window leaves the slot wedged (every replacement push would
+// see state!=EMPTY forever). Caller guarantees the previous owner is
+// dead before resetting; any half-written payload is discarded.
+int psq_reset_slot(void* hv, uint32_t worker) {
+  Handle* h = (Handle*)hv;
+  if (worker >= hdr(h)->n_workers) return -1;
+  slot(h, worker)->state.store(EMPTY, std::memory_order_release);
+  return 0;
+}
+
 // Anyone: is worker w's mailbox currently FULL (pushed, unconsumed)?
 // Lets liveness checks distinguish "server hasn't polled" from "worker
 // hasn't pushed".

@@ -63,6 +63,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                  ctypes.POINTER(ctypes.c_uint32)]
     lib.psq_grad_pending.restype = ctypes.c_int
     lib.psq_grad_pending.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.psq_reset_slot.restype = ctypes.c_int
+    lib.psq_reset_slot.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     _lib = lib
     return _lib
 
@@ -306,6 +308,21 @@ class ShmPSServer:
             flat = self._grad_buf[: n // 4].copy()
             grad = _unflatten(flat, self.template)
         return int(worker.value), int(version.value), grad
+
+    def reset_worker_slot(self, worker: int) -> None:
+        """Elastic replacement of a CRASHED worker: forcibly empty its
+        mailbox (a process killed while its slot was in the WRITING state
+        of the EMPTY/WRITING/FULL machine leaves it wedged, so a
+        replacement could never push). Call only after confirming the
+        previous owner is dead — a half-written payload is discarded,
+        which the async protocol tolerates (one lost gradient). Also
+        restarts the worker's liveness clock so ``stragglers()`` gives
+        the replacement its startup grace instead of instantly re-
+        flagging the id it inherits."""
+        rc = self._lib.psq_reset_slot(self._h, worker)
+        if rc != 0:
+            raise ValueError(f"psq_reset_slot({worker}) -> {rc}")
+        self.last_seen[int(worker)] = time.time()
 
     def stragglers(self, timeout: float) -> Dict[int, float]:
         """Workers with no sign of life for ``timeout`` seconds: no

@@ -170,3 +170,118 @@ def test_poll_grad_deep_stale_backlog_iterative():
         assert server.grads_received == n_workers
     finally:
         server.close()
+
+
+def test_worker_crash_and_elastic_replacement():
+    """Failure recovery the reference's MPI lacked (SURVEY §5.3: any rank
+    failure killed the whole job): a worker process is KILLED mid-
+    training; the server keeps serving the survivors, flags the dead
+    worker as a straggler, and a REPLACEMENT process attached to the same
+    mailbox id resumes pushing — training continues to convergence with
+    no server restart and no state loss."""
+    import signal
+    import time as _time
+
+    cfg = {
+        "model": "mlp",
+        "model_kw": {"features": (32, 4)},
+        "in_shape": (8,),
+        "batch": 64,
+        "seed": 11,
+        "optim": "sgd",
+        "hyper": {"lr": 0.05},
+        "steps": 400,  # far more than needed; victim dies early
+    }
+    _, params0, batch_fn, loss_fn = make_problem(cfg)
+    name = f"/psq_elastic_{os.getpid()}"
+    server = dcn.ShmPSServer(name, num_workers=2, template=params0,
+                             max_staleness=10**9)
+    try:
+        survivor = spawn_worker(name, 0, cfg)
+        victim = spawn_worker(name, 1, cfg)
+
+        # phase 1: run until both workers have contributed
+        import jax
+        from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+
+        params = params0
+        hyper_cls, init_state, update_fn = OPTIMIZERS["sgd"]
+        h = hyper_cls(lr=0.05)
+        state = init_state(params)
+        update = jax.jit(lambda p, g, s: update_fn(p, g, s, h))
+        eval_loss = jax.jit(loss_fn)
+        eval_batch = batch_fn(10**6, 10**6)
+        loss0 = float(eval_loss(params, eval_batch))
+        server.publish(params)
+
+        seen_workers = set()
+        applied = 0
+        deadline = _time.time() + 240
+        killed = False
+        replacement = None
+        while applied < 120 and _time.time() < deadline:
+            item = server.poll_grad()
+            if item is None:
+                _time.sleep(0.001)
+                continue
+            wid, _, grad = item
+            seen_workers.add(wid)
+            params, state = update(params, grad, state)
+            server.publish(jax.tree.map(np.asarray, params))
+            applied += 1
+            if not killed and applied >= 30 and {0, 1} <= seen_workers:
+                victim.send_signal(signal.SIGKILL)  # mid-flight crash
+                victim.wait(timeout=30)
+                killed = True
+                t_kill = _time.time()
+            if killed and replacement is None and applied >= 60:
+                # dead worker shows up in the straggler report: wait for
+                # its pending push (if any) to drain and its 0.5 s
+                # silence window to elapse — timing-robust, the survivor
+                # keeps streaming meanwhile
+                flag_deadline = _time.time() + 30
+                flagged = False
+                while _time.time() < flag_deadline and not flagged:
+                    drained = server.poll_grad()
+                    if drained is not None:
+                        wid_d, _, grad_d = drained
+                        params, state = update(params, grad_d, state)
+                        server.publish(jax.tree.map(np.asarray, params))
+                        applied += 1
+                    flagged = 1 in server.stragglers(timeout=0.5)
+                    if not flagged:
+                        _time.sleep(0.05)
+                assert flagged
+                # ...and an elastic replacement reuses its mailbox id.
+                # Reset the slot first: a SIGKILL inside the WRITING
+                # window would leave it wedged and the replacement could
+                # never push (psq_reset_slot exists for exactly this).
+                server.reset_worker_slot(1)
+                replacement = spawn_worker(name, 1, cfg)
+
+        assert killed and replacement is not None
+        assert applied >= 120
+        # replacement actually contributed after the crash: keep
+        # draining until a wid==1 gradient arrives (its fresh process
+        # needs seconds of jax import + compile before the first push)
+        deadline = _time.time() + 180
+        saw_replacement = False
+        while not saw_replacement and _time.time() < deadline:
+            item = server.poll_grad()
+            if item is None:
+                _time.sleep(0.001)
+                continue
+            wid, _, grad = item
+            params, state = update(params, grad, state)
+            server.publish(jax.tree.map(np.asarray, params))
+            if wid == 1:
+                saw_replacement = True
+        assert saw_replacement
+        assert float(eval_loss(params, eval_batch)) < 0.5 * loss0
+
+        survivor.kill()
+        survivor.wait(timeout=30)
+        replacement.kill()
+        replacement.wait(timeout=30)
+    finally:
+        server.close()

@@ -242,3 +242,30 @@ def test_codec_wire_spec_roundtrip():
     out = wire.decode_from_bytes(buf)
     np.testing.assert_allclose(out["a"], grad["a"])
     np.testing.assert_allclose(out["b"], grad["b"])
+
+
+def test_reset_worker_slot_unblocks_replacement():
+    """Elastic-replacement primitive: after a worker dies leaving its
+    mailbox occupied, reset_worker_slot discards the stale payload and a
+    replacement on the same id can push again."""
+    name = f"/psq_test_{os.getpid()}_r"
+    server = dcn.ShmPSServer(name, num_workers=1, template=TEMPLATE)
+    try:
+        server.publish({"w": TEMPLATE["w"].copy()})
+        w = dcn.ShmPSWorker(name, 0, TEMPLATE)
+        _, v = w.read_params()
+        w.push_grad({"w": np.ones(6, np.float32)}, v)
+        w.close()  # "crash" with an unconsumed payload in the slot
+        server.reset_worker_slot(0)
+        assert server._lib.psq_grad_pending(server._h, 0) == 0
+        w2 = dcn.ShmPSWorker(name, 0, TEMPLATE)
+        w2.push_grad({"w": 2 * np.ones(6, np.float32)}, v)
+        item = server.poll_grad()
+        assert item is not None
+        _, _, grad = item
+        np.testing.assert_allclose(grad["w"], 2 * np.ones(6))
+        w2.close()
+        with pytest.raises(ValueError):
+            server.reset_worker_slot(99)
+    finally:
+        server.close()
