@@ -13,6 +13,21 @@ from pytorch_ps_mpi_tpu.mesh import make_mesh
 from pytorch_ps_mpi_tpu.parallel import tp
 
 
+def _dense_attention_oracle(params, x, causal=False):
+    """Reference attention from the concatenated TP shards — the ONE
+    oracle every attention test in this file compares against."""
+    wqkv, wo, bo = tp.dense_equivalent_attention(params)
+    qkv = jnp.einsum("bld,dche->blche", x, wqkv)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / q.shape[-1] ** 0.5
+    if causal:
+        l = x.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((l, l), bool))[None, None], s, -1e30)
+    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    return o.reshape(x.shape[0], x.shape[1], -1) @ wo + bo
+
+
+
 @pytest.fixture(scope="module")
 def mesh_tp():
     return make_mesh(shape=(8,), axis_names=("model",))
@@ -61,14 +76,7 @@ def test_tp_attention_matches_dense(mesh_tp):
     )
     out = fn(params, x)
 
-    wqkv, wo, bo = tp.dense_equivalent_attention(params)
-    qkv = jnp.einsum("bld,dche->blche", x, wqkv)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    hd = d // heads
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / hd ** 0.5
-    p_attn = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p_attn, v)
-    expected = o.reshape(2, 6, -1) @ wo + bo
+    expected = _dense_attention_oracle(params, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=1e-5, atol=1e-6)
 
@@ -166,12 +174,34 @@ def test_tp_attention_composes_with_ring(mesh_dp_tp):
     )
     out = fn(params, x)
 
-    wqkv, wo, bo = tp.dense_equivalent_attention(params)
-    qkv = jnp.einsum("bld,dche->blche", x, wqkv)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    hd = d // heads
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / hd ** 0.5
-    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
-    expected = o.reshape(2, seq, -1) @ wo + bo
+    expected = _dense_attention_oracle(params, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_tp_attention_causal_dense_branch(mesh_dp_tp):
+    """ADVICE r2 (medium): tp_self_attention(causal=True) without a
+    sequence axis must actually mask — regression for the silently
+    non-causal dense branch."""
+    d, heads, b, l = 16, 4, 2, 6
+    tpp = tp.init_tp_attention(jax.random.key(1), d=d, heads=heads, tp=4)
+    x = jax.random.normal(jax.random.key(2), (b, l, d))
+
+    def run(causal):
+        return jax.jit(
+            jax.shard_map(
+                lambda x, p: tp.tp_self_attention(x, p, "model",
+                                                  causal=causal),
+                mesh=mesh_dp_tp,
+                in_specs=(P(), tp.tp_param_spec(tpp, "model")),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )(x, tpp)
+
+    out = run(causal=True)
+    oracle = _dense_attention_oracle(tpp, x, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               rtol=1e-5, atol=1e-5)
+    # and the mask is load-bearing: causal != non-causal
+    assert float(jnp.max(jnp.abs(out - run(causal=False)))) > 1e-4
