@@ -129,6 +129,9 @@ def main(argv=None):
         code = get_codec(args.codec, **kw)
 
     params, loss_fn, data = build(args.config, args.batch)
+    from pytorch_ps_mpi_tpu.data import prefetch
+
+    data = prefetch(data)  # overlap host batch construction with the step
     hyper = {"lr": args.lr}
     if args.optim == "sgd":
         hyper["momentum"] = args.momentum

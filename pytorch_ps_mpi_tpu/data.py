@@ -61,3 +61,40 @@ def synthetic_mlm(
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def prefetch(it: Iterator, depth: int = 2) -> Iterator:
+    """Host-side input pipelining: a background thread keeps up to
+    ``depth`` batches ready so batch construction overlaps the device
+    step — the role the reference's 200-thread encode pool played for
+    its host-bound pipeline (``ps.py:85``), applied where a host thread
+    still helps a TPU program (the input side; gradient work lives
+    inside the jitted step here).
+
+    Exceptions in the source iterator propagate to the consumer;
+    ``StopIteration`` ends the stream cleanly. The thread is a daemon, so
+    an abandoned iterator never blocks interpreter exit.
+    """
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    _END = object()
+
+    def pump():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:  # noqa: BLE001 — forwarded, not dropped
+            q.put(("__prefetch_error__", e))
+            return
+        q.put(_END)
+
+    threading.Thread(target=pump, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] == "__prefetch_error__":
+            raise item[1]
+        yield item

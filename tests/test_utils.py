@@ -145,3 +145,26 @@ def test_devtime_helpers():
 
     t = scan_timed(lambda: loop(jnp.ones((8, 8))), k=4)
     assert t >= 0.0
+
+
+def test_data_prefetch():
+    """prefetch(): order-preserving, bounded, propagates source errors."""
+    from pytorch_ps_mpi_tpu.data import prefetch
+
+    assert list(prefetch(iter(range(10)), depth=3)) == list(range(10))
+
+    def boom():
+        yield 1
+        raise RuntimeError("source failed")
+
+    it = prefetch(boom(), depth=2)
+    assert next(it) == 1
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="source failed"):
+        next(it)
+
+    # overlaps: consuming 3 of an endless stream returns promptly
+    import itertools
+    vals = list(itertools.islice(prefetch(iter(int, 1), depth=2), 3))
+    assert vals == [0, 0, 0]
