@@ -72,29 +72,47 @@ def prefetch(it: Iterator, depth: int = 2) -> Iterator:
     inside the jitted step here).
 
     Exceptions in the source iterator propagate to the consumer;
-    ``StopIteration`` ends the stream cleanly. The thread is a daemon, so
-    an abandoned iterator never blocks interpreter exit.
+    ``StopIteration`` ends the stream cleanly. Closing or abandoning the
+    consumer generator stops the pump thread (it checks a stop event
+    around its bounded puts), so long-lived processes don't accumulate
+    blocked threads holding queued batches; the thread is also a daemon,
+    so interpreter exit never blocks on it.
     """
     import queue
     import threading
 
     q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
     _END = object()
+
+    def offer(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def pump():
         try:
             for item in it:
-                q.put(item)
+                if not offer(item):
+                    return
         except BaseException as e:  # noqa: BLE001 — forwarded, not dropped
-            q.put(("__prefetch_error__", e))
+            offer(("__prefetch_error__", e))
             return
-        q.put(_END)
+        offer(_END)
 
     threading.Thread(target=pump, daemon=True).start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, tuple) and len(item) == 2 and item[0] == "__prefetch_error__":
-            raise item[1]
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if (isinstance(item, tuple) and len(item) == 2
+                    and item[0] == "__prefetch_error__"):
+                raise item[1]
+            yield item
+    finally:
+        stop.set()  # consumer closed/abandoned: release the pump

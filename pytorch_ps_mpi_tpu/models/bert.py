@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_ps_mpi_tpu.parallel.ring import ring_attention
+from pytorch_ps_mpi_tpu.parallel.ulysses import ulysses_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -31,8 +32,8 @@ class BertConfig:
     intermediate_size: int = 3072
     max_position: int = 512
     dtype: Any = jnp.float32
-    attention: str = "full"       # 'full' or 'ring'
-    seq_axis: str = "seq"         # mesh axis for ring attention
+    attention: str = "full"       # 'full', 'ring', or 'ulysses'
+    seq_axis: str = "seq"         # mesh axis for ring/ulysses attention
 
     @staticmethod
     def base() -> "BertConfig":
@@ -61,10 +62,19 @@ class SelfAttention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if c.attention == "ring":
             out = ring_attention(q, k, v, c.seq_axis, causal=False)
-        else:
+        elif c.attention == "ulysses":
+            out = ulysses_attention(q, k, v, c.seq_axis, causal=False)
+        elif c.attention == "full":
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / head_dim ** 0.5
             p = jax.nn.softmax(s, axis=-1)
             out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        else:
+            # a typo'd mode must not silently run shard-local dense
+            # attention (valid shapes, quietly wrong model under SP)
+            raise ValueError(
+                f"unknown attention={c.attention!r}: "
+                "expected 'full', 'ring', or 'ulysses'"
+            )
         return nn.DenseGeneral(
             c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="out"
         )(out)

@@ -17,6 +17,8 @@ over one ``jax.sharding.Mesh``.
 - ``dcn``: the multi-process shared-memory PS transport + codec wire.
 - ``ring``: ring attention over a sequence-sharded mesh axis (context
   parallelism; no reference analog — TPU-first extension).
+- ``ulysses``: the all-to-all flavor of sequence parallelism (DeepSpeed-
+  Ulysses): one head/seq exchange each way, plain attention in between.
 - ``tp``: Megatron column/row tensor parallelism (one psum per block).
 - ``pp``: GPipe microbatch pipeline parallelism (scan + ppermute,
   backward via autodiff; vma-checked shard_map required).
@@ -27,6 +29,7 @@ over one ``jax.sharding.Mesh``.
 from pytorch_ps_mpi_tpu.parallel.dp import make_sync_train_step
 from pytorch_ps_mpi_tpu.parallel.async_ps import AsyncPS
 from pytorch_ps_mpi_tpu.parallel.ring import ring_attention, ring_self_attention
+from pytorch_ps_mpi_tpu.parallel.ulysses import ulysses_attention
 from pytorch_ps_mpi_tpu.parallel.tp import tp_mlp, tp_self_attention
 from pytorch_ps_mpi_tpu.parallel.pp import pipeline_apply, pipeline_loss
 from pytorch_ps_mpi_tpu.parallel.ep import moe_apply, moe_dense_oracle
@@ -36,6 +39,7 @@ __all__ = [
     "AsyncPS",
     "ring_attention",
     "ring_self_attention",
+    "ulysses_attention",
     "tp_mlp",
     "tp_self_attention",
     "pipeline_apply",

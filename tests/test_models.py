@@ -196,3 +196,42 @@ def test_syncbn_matches_global_batch_oracle(mesh8):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_bert_ulysses_attention_matches_full():
+    """Ulysses-attention BERT == full-attention BERT on the same params
+    (4 seq shards; tiny config's 4 heads give 1 head per device)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+    cfg_full = BertConfig.tiny()
+    cfg_uly = BertConfig.tiny(attention="ulysses")
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0,
+                                cfg_full.vocab_size)
+    params = BertMLM(cfg_full).init(jax.random.key(0), tokens)
+    ref = BertMLM(cfg_full).apply(params, tokens)
+
+    l_local = 32 // 4
+
+    def spmd(params, tokens):
+        import jax.lax as lax
+        offset = lax.axis_index("seq") * l_local
+        return BertMLM(cfg_uly).apply(params, tokens, position_offset=offset)
+
+    out = jax.jit(
+        jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_bert_unknown_attention_mode_raises():
+    cfg = BertConfig.tiny(attention="ulises")  # typo must not run silently
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="unknown attention"):
+        BertMLM(cfg).init(jax.random.key(0), tokens)
