@@ -43,10 +43,18 @@ enable_compilation_cache()
 
 def run(cfg, n_workers: int, sync_barrier: bool, total: int):
     _, params0, _, _ = make_problem(cfg)
-    name = f"/psq_bench_{os.getpid()}_{int(sync_barrier)}"
-    server = dcn.ShmPSServer(
-        name, num_workers=n_workers, template=params0, max_staleness=10**9,
-    )
+    if cfg.get("transport") == "tcp":
+        from pytorch_ps_mpi_tpu.parallel import tcp
+
+        server = tcp.TcpPSServer(
+            0, num_workers=n_workers, template=params0, max_staleness=10**9,
+        )
+        name = f"127.0.0.1:{server.port}"
+    else:
+        name = f"/psq_bench_{os.getpid()}_{int(sync_barrier)}"
+        server = dcn.ShmPSServer(
+            name, num_workers=n_workers, template=params0, max_staleness=10**9,
+        )
     try:
         procs = [spawn_worker(name, i, cfg) for i in range(n_workers)]
         _, m = serve(server, cfg, total_grads=0, total_received=total,
@@ -68,10 +76,14 @@ def main():
     ap.add_argument("--slow-steps", type=int, default=2)
     ap.add_argument("--slow-ms", type=float, default=4000.0)
     ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--transport", default="shm", choices=["shm", "tcp"],
+                    help="PS wire: shm (co-hosted) or tcp (the cross-host "
+                         "DCN-role transport, here over localhost)")
     args = ap.parse_args()
 
     w = args.workers
     base = {
+        "transport": args.transport,
         "model": args.model,
         "model_kw": {"num_classes": 10},
         "in_shape": (32, 32, 3),
@@ -117,6 +129,7 @@ def main():
         "async_loss": round(m_async["loss_final"], 4),
         "sync_loss": round(m_sync["loss_final"], 4),
         "workers": w,
+        "transport": args.transport,
         "straggler_ms": args.slow_ms,
         "backend": "cpu (protocol bench; single-core host, ratio is the "
                    "evidence, absolute rates are not)",

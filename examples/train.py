@@ -100,6 +100,13 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--optim", choices=["sgd", "adam"], default="sgd")
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--lr-schedule", choices=["constant", "warmup_cosine",
+                                              "step_decay"], default=None,
+                    help="in-program lr schedule over --lr (evaluated on "
+                         "the traced step counter; no recompiles)")
+    ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument("--decay-boundaries", default="",
+                    help="comma ints for step_decay, e.g. 100,200")
     ap.add_argument("--momentum", type=float, default=0.9)
     ap.add_argument("--mode", choices=["allgather", "leader"], default="allgather")
     ap.add_argument("--codec", default=None,
@@ -133,7 +140,18 @@ def main(argv=None):
     from pytorch_ps_mpi_tpu.data import prefetch
 
     data = prefetch(data)  # overlap host batch construction with the step
-    hyper = {"lr": args.lr}
+    lr = args.lr
+    if args.lr_schedule == "warmup_cosine":
+        from pytorch_ps_mpi_tpu.optim import warmup_cosine
+
+        lr = warmup_cosine(args.lr, total_steps=args.steps,
+                           warmup_steps=args.warmup_steps)
+    elif args.lr_schedule == "step_decay":
+        from pytorch_ps_mpi_tpu.optim import step_decay
+
+        bounds = tuple(int(b) for b in args.decay_boundaries.split(",") if b)
+        lr = step_decay(args.lr, boundaries=bounds or (args.steps // 2,))
+    hyper = {"lr": lr}
     if args.optim == "sgd":
         hyper["momentum"] = args.momentum
     opt = MPI_PS(

@@ -1,0 +1,467 @@
+// tcpps: TCP parameter-server transport for cross-host async training.
+//
+// The cross-HOST face of the AsySG-InCon wire: psqueue.cpp covers
+// co-hosted processes over shared memory; this covers workers on other
+// hosts — the role the reference's MPI-over-Ethernet/IB deployment played
+// (reference README.md:19-23 "run on a cluster", mpi_comms.py:88,132) —
+// over plain TCP, the transport a TPU pod's DCN exposes to host code.
+// Same protocol semantics as psqueue:
+//
+//   * a versioned parameter snapshot the server owns; workers request the
+//     latest at any time (inconsistent reads — no barrier; two workers
+//     may receive different versions concurrently).
+//   * version-tagged gradient pushes, acknowledged by the server on
+//     receipt, so a worker has at most one unacknowledged push in flight
+//     (the back-pressure psqueue gets from its single-slot mailbox).
+//
+// Server side is single-threaded and non-blocking: the Python serve loop
+// calls tps_server_pump() (accept + progress all connections + parse
+// frames) then tps_server_pop_grad(). Worker side is blocking with
+// timeouts — workers spend their time in jitted compute, not in the
+// transport. No threads anywhere; ctypes calls release the GIL so a
+// blocked worker never stalls a pumping server in the same process.
+//
+// Wire frame (little-endian, 28-byte header then payload):
+//   u32 magic 'TPS1' | u8 op | u8 pad[3] | u32 worker | u64 version | u64 len
+//   ops: 1 HELLO (worker->server, announces worker id)
+//        2 GET_PARAMS (worker->server)
+//        3 PARAMS (server->worker; version+payload, len 0 until first publish)
+//        4 PUSH_GRAD (worker->server; version = params version used)
+//        5 ACK (server->worker; confirms one PUSH_GRAD was queued)
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x31535054;  // "TPS1"
+
+enum Op : uint8_t {
+  HELLO = 1,
+  GET_PARAMS = 2,
+  PARAMS = 3,
+  PUSH_GRAD = 4,
+  ACK = 5,
+};
+
+#pragma pack(push, 1)
+struct FrameHdr {
+  uint32_t magic;
+  uint8_t op;
+  uint8_t pad[3];
+  uint32_t worker;
+  uint64_t version;
+  uint64_t len;
+};
+#pragma pack(pop)
+static_assert(sizeof(FrameHdr) == 28, "frame header must be 28 bytes");
+
+struct GradMsg {
+  uint32_t worker;
+  uint64_t version;
+  std::vector<uint8_t> bytes;
+};
+
+struct Conn {
+  int fd = -1;
+  int32_t worker = -1;  // -1 until HELLO
+  std::vector<uint8_t> rx;
+  std::vector<uint8_t> tx;
+};
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  uint32_t n_workers = 0;
+  uint64_t max_msg = 0;
+  std::vector<Conn*> conns;
+  std::deque<GradMsg> grads;
+  std::vector<uint8_t> params;
+  uint64_t param_version = 0;
+};
+
+struct Worker {
+  int fd = -1;
+  uint32_t id = 0;
+  std::vector<uint8_t> rx;
+};
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void append_frame(std::vector<uint8_t>& tx, uint8_t op, uint32_t worker,
+                  uint64_t version, const uint8_t* payload, uint64_t len) {
+  FrameHdr h{};
+  h.magic = kMagic;
+  h.op = op;
+  h.worker = worker;
+  h.version = version;
+  h.len = len;
+  const uint8_t* hp = reinterpret_cast<const uint8_t*>(&h);
+  tx.insert(tx.end(), hp, hp + sizeof(h));
+  if (len) tx.insert(tx.end(), payload, payload + len);
+}
+
+// Queue bound: with push-ACK back-pressure each connected worker has at
+// most one unacknowledged push, but a server that pumps without popping
+// could still accumulate. When the queue is at cap, PUSH_GRAD frames stay
+// unparsed in the connection's rx buffer (no ACK sent), so the worker
+// blocks awaiting its ack and TCP back-pressure does the rest — a queued
+// gradient is NEVER silently dropped once acknowledged, which the
+// consumed-count stop conditions (serve's total_received, server_main's
+// expected) and the sync-barrier "every gradient enters exactly one
+// round" oracle all rely on.
+size_t queue_cap(const Server* s) { return 4 * (size_t)s->n_workers + 16; }
+
+void close_conn(Server* s, size_t i) {
+  Conn* c = s->conns[i];
+  if (c->fd >= 0) close(c->fd);
+  delete c;
+  s->conns.erase(s->conns.begin() + i);
+}
+
+// Parse every complete frame in c->rx; returns false on protocol error
+// (caller closes the connection).
+bool handle_frames(Server* s, Conn* c) {
+  size_t off = 0;
+  while (c->rx.size() - off >= sizeof(FrameHdr)) {
+    FrameHdr h;
+    std::memcpy(&h, c->rx.data() + off, sizeof(h));
+    if (h.magic != kMagic || h.len > s->max_msg) return false;
+    if (c->rx.size() - off < sizeof(h) + h.len) break;  // partial payload
+    const uint8_t* payload = c->rx.data() + off + sizeof(h);
+    switch (h.op) {
+      case HELLO:
+        c->worker = (int32_t)h.worker;
+        break;
+      case GET_PARAMS:
+        append_frame(c->tx, PARAMS, 0, s->param_version, s->params.data(),
+                     s->params.size());
+        break;
+      case PUSH_GRAD: {
+        if (s->grads.size() >= queue_cap(s)) {
+          // keep the frame buffered, send no ACK: the pushing worker
+          // stalls until pop_grad frees a slot (processed next pump)
+          if (off) c->rx.erase(c->rx.begin(), c->rx.begin() + off);
+          return true;
+        }
+        GradMsg m;
+        m.worker = h.worker;
+        m.version = h.version;
+        m.bytes.assign(payload, payload + h.len);
+        s->grads.push_back(std::move(m));
+        append_frame(c->tx, ACK, h.worker, h.version, nullptr, 0);
+        break;
+      }
+      default:
+        return false;
+    }
+    off += sizeof(h) + h.len;
+  }
+  if (off) c->rx.erase(c->rx.begin(), c->rx.begin() + off);
+  return true;
+}
+
+// Blocking read of exactly n bytes with a deadline; 0 ok, -1 error/EOF,
+// -2 timeout.
+int read_full(int fd, uint8_t* buf, size_t n, int timeout_ms) {
+  struct timespec t0;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  size_t got = 0;
+  while (got < n) {
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    long elapsed = (now.tv_sec - t0.tv_sec) * 1000 +
+                   (now.tv_nsec - t0.tv_nsec) / 1000000;
+    long left = timeout_ms - elapsed;
+    if (left <= 0) return -2;
+    struct pollfd p{fd, POLLIN, 0};
+    int pr = poll(&p, 1, (int)left);
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (pr == 0) return -2;
+    ssize_t r = recv(fd, buf + got, n - got, 0);
+    if (r == 0) return -1;  // peer closed
+    if (r < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      return -1;
+    }
+    got += (size_t)r;
+  }
+  return 0;
+}
+
+int write_full(int fd, const uint8_t* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        struct pollfd p{fd, POLLOUT, 0};
+        poll(&p, 1, 100);
+        continue;
+      }
+      return -1;
+    }
+    sent += (size_t)r;
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ---------------------------------------------------------------
+
+// Listen on 0.0.0.0:port (0 = auto-assign; read back with
+// tps_server_port). max_msg bounds any single frame payload (params or
+// gradient bytes). Returns NULL on failure.
+void* tps_server_create(uint16_t port, uint32_t n_workers, uint64_t max_msg) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  set_nonblock(fd);
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->n_workers = n_workers;
+  s->max_msg = max_msg;
+  return s;
+}
+
+uint16_t tps_server_port(void* sv) { return ((Server*)sv)->port; }
+
+// Store the new snapshot; served to every subsequent GET_PARAMS.
+int tps_server_publish(void* sv, const uint8_t* buf, uint64_t len,
+                       uint64_t version) {
+  Server* s = (Server*)sv;
+  if (len > s->max_msg) return -1;
+  s->params.assign(buf, buf + len);
+  s->param_version = version;
+  return 0;
+}
+
+// One non-blocking sweep: accept, read, parse, reply, flush. Returns the
+// number of complete frames/connection events progressed (0 = idle).
+int tps_server_pump(void* sv) {
+  Server* s = (Server*)sv;
+  int events = 0;
+  for (;;) {  // accept everything pending
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) break;
+    set_nonblock(fd);
+    set_nodelay(fd);
+    Conn* c = new Conn();
+    c->fd = fd;
+    s->conns.push_back(c);
+    ++events;
+  }
+  for (size_t i = 0; i < s->conns.size();) {
+    Conn* c = s->conns[i];
+    bool dead = false;
+    // per-conn memory bound: once a full max-size frame is buffered
+    // (possible only while the grad queue back-pressures), stop reading
+    // until handle_frames consumes it
+    while (c->rx.size() <= sizeof(FrameHdr) + s->max_msg) {
+      uint8_t buf[65536];
+      ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+      if (r > 0) {
+        c->rx.insert(c->rx.end(), buf, buf + r);
+        ++events;
+        continue;
+      }
+      if (r == 0) dead = true;  // EOF
+      else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+        dead = true;
+      break;
+    }
+    if (!dead && !handle_frames(s, c)) dead = true;  // protocol error
+    if (!dead && !c->tx.empty()) {                   // flush replies
+      ssize_t w = send(c->fd, c->tx.data(), c->tx.size(), MSG_NOSIGNAL);
+      if (w > 0) {
+        c->tx.erase(c->tx.begin(), c->tx.begin() + w);
+        ++events;
+      } else if (w < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                 errno != EINTR) {
+        dead = true;
+      }
+    }
+    if (dead) close_conn(s, i);
+    else ++i;
+  }
+  return events;
+}
+
+// Pop one queued gradient (FIFO arrival order). Returns byte length >0
+// and fills worker/version; 0 if none; -1 if the payload exceeds cap.
+int64_t tps_server_pop_grad(void* sv, uint8_t* buf, uint64_t cap,
+                            uint32_t* worker_out, uint64_t* version_out) {
+  Server* s = (Server*)sv;
+  if (s->grads.empty()) return 0;
+  GradMsg& m = s->grads.front();
+  if (m.bytes.size() > cap) return -1;
+  std::memcpy(buf, m.bytes.data(), m.bytes.size());
+  if (worker_out) *worker_out = m.worker;
+  if (version_out) *version_out = m.version;
+  int64_t n = (int64_t)m.bytes.size();
+  s->grads.pop_front();
+  return n;
+}
+
+// Gradients currently queued from this worker (liveness signal: pushed
+// but not yet consumed counts as alive, mirroring psq_grad_pending).
+int tps_server_pending(void* sv, uint32_t worker) {
+  Server* s = (Server*)sv;
+  int n = 0;
+  for (const GradMsg& m : s->grads)
+    if (m.worker == worker) ++n;
+  return n;
+}
+
+// Is a connection claiming this worker id currently open? A crashed
+// worker's socket closes (RST/EOF) and this flips to 0 — the transport-
+// level failure signal shm cannot give; a replacement just reconnects.
+int tps_server_connected(void* sv, uint32_t worker) {
+  Server* s = (Server*)sv;
+  for (const Conn* c : s->conns)
+    if (c->worker == (int32_t)worker) return 1;
+  return 0;
+}
+
+void tps_server_close(void* sv) {
+  Server* s = (Server*)sv;
+  if (!s) return;
+  for (size_t i = s->conns.size(); i-- > 0;) close_conn(s, i);
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  delete s;
+}
+
+// ---- worker ---------------------------------------------------------------
+
+// Connect (retrying until timeout_ms — the server may not be up yet) and
+// send HELLO. Returns NULL on failure.
+void* tps_worker_connect(const char* host, uint16_t port, uint32_t worker_id,
+                         int timeout_ms) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return nullptr;
+  struct timespec t0;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  int fd = -1;
+  for (;;) {
+    fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) break;
+    close(fd);
+    fd = -1;
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    long elapsed = (now.tv_sec - t0.tv_sec) * 1000 +
+                   (now.tv_nsec - t0.tv_nsec) / 1000000;
+    if (elapsed >= timeout_ms) return nullptr;
+    struct timespec ts = {0, 50 * 1000 * 1000};  // 50 ms between attempts
+    nanosleep(&ts, nullptr);
+  }
+  set_nodelay(fd);
+  Worker* w = new Worker();
+  w->fd = fd;
+  w->id = worker_id;
+  std::vector<uint8_t> tx;
+  append_frame(tx, HELLO, worker_id, 0, nullptr, 0);
+  if (write_full(fd, tx.data(), tx.size()) != 0) {
+    close(fd);
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+// Request + receive the latest snapshot. Returns byte length (0 until the
+// server's first publish) and fills version; -1 error, -2 timeout, -3 if
+// the reply exceeds cap.
+int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
+                               uint64_t* version_out, int timeout_ms) {
+  Worker* w = (Worker*)wv;
+  std::vector<uint8_t> tx;
+  append_frame(tx, GET_PARAMS, w->id, 0, nullptr, 0);
+  if (write_full(w->fd, tx.data(), tx.size()) != 0) return -1;
+  FrameHdr h;
+  int rc = read_full(w->fd, reinterpret_cast<uint8_t*>(&h), sizeof(h),
+                     timeout_ms);
+  if (rc != 0) return rc;
+  if (h.magic != kMagic || h.op != PARAMS) return -1;
+  if (h.len > cap) return -3;
+  if (h.len) {
+    rc = read_full(w->fd, buf, h.len, timeout_ms);
+    if (rc != 0) return rc;
+  }
+  if (version_out) *version_out = h.version;
+  return (int64_t)h.len;
+}
+
+// Push one gradient and wait for the server's ACK (back-pressure: at most
+// one unacknowledged push in flight, like psqueue's single-slot mailbox).
+// Returns 1 on ack; -1 error, -2 timeout.
+int tps_worker_push_grad(void* wv, const uint8_t* buf, uint64_t len,
+                         uint64_t version, int timeout_ms) {
+  Worker* w = (Worker*)wv;
+  FrameHdr h{};
+  h.magic = kMagic;
+  h.op = PUSH_GRAD;
+  h.worker = w->id;
+  h.version = version;
+  h.len = len;
+  if (write_full(w->fd, reinterpret_cast<uint8_t*>(&h), sizeof(h)) != 0)
+    return -1;
+  if (len && write_full(w->fd, buf, len) != 0) return -1;
+  FrameHdr ack;
+  int rc = read_full(w->fd, reinterpret_cast<uint8_t*>(&ack), sizeof(ack),
+                     timeout_ms);
+  if (rc != 0) return rc;
+  if (ack.magic != kMagic || ack.op != ACK || ack.len != 0) return -1;
+  return 1;
+}
+
+void tps_worker_close(void* wv) {
+  Worker* w = (Worker*)wv;
+  if (!w) return;
+  if (w->fd >= 0) close(w->fd);
+  delete w;
+}
+
+}  // extern "C"

@@ -11,20 +11,78 @@ Python loop run redundantly on every rank (``ps.py:190``).
 Semantics checked against optax in ``tests/test_optim.py``. Notable
 reference quirk preserved: the momentum buffer is *initialized to the first
 d_p* (``ps.py:203-205``, torch semantics), not to zero.
+
+Learning-rate schedules: ``lr`` may be a float (the reference's only
+option, constant ``ps.py:197``) or a callable ``step -> scalar`` from
+:data:`SCHEDULES` (or any user function built from jnp ops). A schedule is
+evaluated on the optimizer state's traced step counter INSIDE the compiled
+program, so the lr varies per step with zero recompiles — the TPU-native
+shape of torch's host-side ``lr_scheduler.step()`` mutation.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 PyTree = Any
+LR = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+def _lr_at(lr: LR, step: jax.Array):
+    """Resolve a constant-or-schedule lr at a (traced) 0-based step."""
+    return lr(step) if callable(lr) else lr
+
+
+# -- schedules (each returns step -> scalar; all jnp, trace-safe) ------------
+
+def constant_lr(base: float) -> Callable:
+    return lambda step: jnp.float32(base)
+
+
+def warmup_cosine(base: float, total_steps: int, warmup_steps: int = 0,
+                  final_scale: float = 0.0) -> Callable:
+    """Linear warmup 0 -> base over ``warmup_steps``, then cosine decay to
+    ``final_scale * base`` at ``total_steps`` (flat afterwards). The
+    de-facto standard schedule of the BERT/ResNet training recipes the
+    BASELINE configs name."""
+    if total_steps <= warmup_steps:
+        raise ValueError("total_steps must exceed warmup_steps")
+
+    def f(step):
+        s = step.astype(jnp.float32)
+        warm = s / max(warmup_steps, 1)
+        t = jnp.clip((s - warmup_steps) / (total_steps - warmup_steps), 0.0, 1.0)
+        cos = final_scale + (1.0 - final_scale) * 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+        return jnp.float32(base) * jnp.where(s < warmup_steps, warm, cos)
+
+    return f
+
+
+def step_decay(base: float, boundaries: Tuple[int, ...],
+               scale: float = 0.1) -> Callable:
+    """Multiply by ``scale`` at each boundary step (torch MultiStepLR, the
+    classic ResNet recipe)."""
+    bounds = jnp.asarray(boundaries, jnp.int32)
+
+    def f(step):
+        k = jnp.sum(step >= bounds).astype(jnp.float32)
+        return jnp.float32(base) * jnp.float32(scale) ** k
+
+    return f
+
+
+SCHEDULES: Dict[str, Callable[..., Callable]] = {
+    "constant": constant_lr,
+    "warmup_cosine": warmup_cosine,
+    "step_decay": step_decay,
+}
 
 
 class SGDHyper(NamedTuple):
-    lr: float = 0.01
+    lr: LR = 0.01
     momentum: float = 0.0
     dampening: float = 0.0
     weight_decay: float = 0.0
@@ -32,7 +90,7 @@ class SGDHyper(NamedTuple):
 
 
 class AdamHyper(NamedTuple):
-    lr: float = 1e-3
+    lr: LR = 1e-3
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
@@ -70,6 +128,7 @@ def sgd_update(
     """One fused SGD step on the aggregated gradient (reference
     ``ps.py:197-214``)."""
     first = state.step == 0
+    lr = _lr_at(h.lr, state.step)
 
     def leaf(p, g, buf):
         d_p = g + h.weight_decay * p if h.weight_decay else g
@@ -81,7 +140,7 @@ def sgd_update(
             d_p = d_p + h.momentum * new_buf if h.nesterov else new_buf
         else:
             new_buf = buf
-        return p - h.lr * d_p, new_buf
+        return p - lr * d_p, new_buf
 
     out = jax.tree.map(leaf, params, grads, state.momentum_buf)
     new_params = jax.tree.map(lambda o: o[0], out, is_leaf=lambda x: isinstance(x, tuple))
@@ -95,6 +154,7 @@ def adam_update(
     """One fused Adam step (reference ``ps.py:218-261``): moment updates,
     optional amsgrad max-denominator, bias-corrected parameter update."""
     step = state.step + 1
+    lr = _lr_at(h.lr, state.step)
     bias1 = 1.0 - h.b1 ** step.astype(jnp.float32)
     bias2 = 1.0 - h.b2 ** step.astype(jnp.float32)
 
@@ -109,7 +169,7 @@ def adam_update(
         else:
             vmax_new = vmax
             denom = jnp.sqrt(v_new) + h.eps
-        step_size = h.lr * jnp.sqrt(bias2) / bias1
+        step_size = lr * jnp.sqrt(bias2) / bias1
         return p - step_size * m_new / denom, m_new, v_new, vmax_new
 
     out = jax.tree.map(

@@ -15,6 +15,13 @@ over one ``jax.sharding.Mesh``.
   jitted compute (workers: jitted value_and_grad -> codec encode -> shm
   payload bytes; server: jitted decode + fused updates in arrival order).
 - ``dcn``: the multi-process shared-memory PS transport + codec wire.
+- ``tcp``: the cross-host PS transport (native TCP, the DCN role) with
+  the same server/worker surface as ``dcn`` — ``async_train`` runs over
+  either via ``cfg["transport"]``.
+- ``sharded``: sharded parameter servers over TCP (Li et al. OSDI'14) —
+  S server processes each owning a slice of the flat parameter vector,
+  per-shard versions/staleness; the cross-host instantiation of the
+  ZeRO-1 partitioning the in-XLA leader mode does on-device.
 - ``ring``: ring attention over a sequence-sharded mesh axis (context
   parallelism; no reference analog — TPU-first extension).
 - ``ulysses``: the all-to-all flavor of sequence parallelism (DeepSpeed-

@@ -112,12 +112,26 @@ def make_problem(cfg: Dict[str, Any]):
     return model, params0, batch_fn, loss_fn
 
 
+def worker_cfg(cfg: Dict[str, Any], worker_id: int) -> Tuple[float, int]:
+    """Per-worker (slow_ms, steps) from the shared job config — one
+    parser for every worker body (shm, tcp, sharded)."""
+    slow_ms = float(cfg.get("slow_ms", {}).get(str(worker_id), 0.0)) if isinstance(
+        cfg.get("slow_ms"), dict) else 0.0
+    steps = int(cfg.get("worker_steps", {}).get(str(worker_id),
+                cfg.get("steps", 10))) if isinstance(
+        cfg.get("worker_steps"), dict) else int(cfg.get("steps", 10))
+    return slow_ms, steps
+
+
 def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     """Worker process body: jitted fwd/bwd → encode → push bytes.
-    Returns the number of gradients pushed."""
-    import jax
+    Returns the number of gradients pushed.
 
-    from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSWorker
+    ``cfg["transport"]`` selects the wire: ``"shm"`` (default, co-hosted
+    processes, ``dcn.py``) or ``"tcp"`` (cross-host DCN role, ``tcp.py``
+    — ``name`` then carries ``"host:port"``). The compute path is
+    identical either way: no gradient is ever produced outside jit."""
+    import jax
 
     code = None
     if cfg.get("codec"):
@@ -128,14 +142,19 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     _, params0, batch_fn, loss_fn = make_problem(cfg)
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))  # ONLY grad source
 
-    slow_ms = float(cfg.get("slow_ms", {}).get(str(worker_id), 0.0)) if isinstance(
-        cfg.get("slow_ms"), dict) else 0.0
-    steps = int(cfg.get("worker_steps", {}).get(str(worker_id),
-                cfg.get("steps", 10))) if isinstance(
-        cfg.get("worker_steps"), dict) else int(cfg.get("steps", 10))
+    slow_ms, steps = worker_cfg(cfg, worker_id)
 
-    w = ShmPSWorker(name, worker_id, params0, code=code,
-                    timeout=float(cfg.get("open_timeout", 60.0)))
+    if cfg.get("transport", "shm") == "tcp":
+        from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSWorker
+
+        host, port = name.rsplit(":", 1)
+        w = TcpPSWorker(host, int(port), worker_id, params0, code=code,
+                        timeout=float(cfg.get("open_timeout", 60.0)))
+    else:
+        from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSWorker
+
+        w = ShmPSWorker(name, worker_id, params0, code=code,
+                        timeout=float(cfg.get("open_timeout", 60.0)))
     pushed = 0
     try:
         for step in range(steps):

@@ -1,0 +1,291 @@
+"""Sharded parameter servers: the PS scaling axis, across processes/hosts.
+
+The reference's topology is one rank-0 server owning every parameter
+(reference ``ps.py:103-193`` — the centralized PS its ``igather``/
+``ibcast`` implement); that single server is the bandwidth and update-rate
+bottleneck as workers scale. The classic fix (Li et al., OSDI'14,
+"Scaling Distributed Machine Learning with the Parameter Server") is to
+PARTITION the parameter vector across S server shards: each server owns a
+contiguous slice, applies updates for its slice only, and workers
+read/push per-slice. This module is that topology over the cross-host TCP
+transport (``parallel/tcp.py``), composing with everything the
+single-server async path already has — jitted worker compute, codec-
+compressed payload bytes, per-shard bounded staleness, ack back-pressure.
+
+In-XLA, the same idea is the ZeRO-1 ``mode='leader'`` lowering in
+``ps.py:94-166`` (optimizer state partitioned 1/world per device); here it
+is the host-process/DCN instantiation: S OS processes (one per host in
+deployment), each a full :class:`~pytorch_ps_mpi_tpu.parallel.tcp.TcpPSServer`
+for its slice. Asynchrony is genuinely per-shard — each shard advances its
+own version counter at its own pace, so a worker's snapshot is a vector of
+per-shard versions (the "inconsistent read" of AsySG-InCon, now also
+inconsistent ACROSS shards), and staleness is measured and bounded
+shard-locally.
+
+Everything is flat-f32-slice based: optimizer update rules (SGD/momentum,
+Adam) are elementwise, so updating each slice independently is EXACTLY the
+single-server update — sharding changes where state lives, never the math
+(tested: 1-shard and 2-shard runs from the same seed agree when run
+synchronously).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pytorch_ps_mpi_tpu.parallel.dcn import _flat_size, _flatten, _unflatten
+
+PyTree = Any
+
+
+def shard_plan(n_total: int, n_shards: int) -> List[Tuple[int, int]]:
+    """Balanced contiguous [start, stop) slices of a length-``n_total``
+    flat vector; earlier shards get the remainder (sizes differ by ≤1)."""
+    if not 1 <= n_shards <= n_total:
+        raise ValueError(f"need 1 <= n_shards <= {n_total}, got {n_shards}")
+    base, rem = divmod(n_total, n_shards)
+    plan, start = [], 0
+    for s in range(n_shards):
+        stop = start + base + (1 if s < rem else 0)
+        plan.append((start, stop))
+        start = stop
+    return plan
+
+
+def _slice_template(n: int) -> PyTree:
+    return {"flat": np.zeros((n,), np.float32)}
+
+
+def server_main(shard_id: int, n_shards: int, port: int,
+                cfg: Dict[str, Any], out_path: str) -> None:
+    """One shard-server process body: own slice ``shard_id`` of the flat
+    parameter vector, apply jitted elementwise optimizer updates in
+    arrival order with shard-local bounded staleness, and on completion
+    write the final slice + metrics to ``out_path`` (.npz).
+
+    Stops after consuming ``expected`` pushes (applied + stale-dropped):
+    every worker pushes once per step per shard, so the count is exact.
+    ``cfg["server_slow_ms"][str(shard_id)]`` injects a per-update sleep —
+    a deliberately slow SHARD for tests to force per-shard version
+    divergence (the asynchrony axis single-server PS doesn't have).
+    """
+    import jax
+
+    from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+    from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+    from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSServer
+
+    code = None
+    if cfg.get("codec"):
+        from pytorch_ps_mpi_tpu.codecs import get_codec
+
+        code = get_codec(cfg["codec"], **cfg.get("codec_kw", {}))
+
+    _, params0, _, _ = make_problem(cfg)
+    flat0 = _flatten(params0)
+    start, stop = shard_plan(flat0.size, n_shards)[shard_id]
+    template = _slice_template(stop - start)
+    params = {"flat": flat0[start:stop].copy()}
+
+    hyper_cls, init_state, update_fn = OPTIMIZERS[cfg.get("optim", "sgd")]
+    h = hyper_cls(**cfg.get("hyper", {"lr": 0.05}))
+    state = init_state(params)
+    update = jax.jit(lambda p, g, s: update_fn(p, g, s, h))
+
+    from pytorch_ps_mpi_tpu.parallel.async_train import worker_cfg
+
+    n_workers = int(cfg["n_workers"])
+    expected = sum(worker_cfg(cfg, w)[1] for w in range(n_workers))
+    slow_ms = 0.0
+    if isinstance(cfg.get("server_slow_ms"), dict):
+        slow_ms = float(cfg["server_slow_ms"].get(str(shard_id), 0.0))
+
+    server = TcpPSServer(port, num_workers=n_workers, template=template,
+                         max_staleness=int(cfg.get("max_staleness", 4)),
+                         code=code)
+    # the coordinator reads the auto-assigned port from this line
+    print(json.dumps({"shard": shard_id, "port": server.port}), flush=True)
+    try:
+        server.publish(params)
+        deadline = time.time() + float(cfg.get("server_timeout", 300.0))
+        while server.grads_received < expected and time.time() < deadline:
+            item = server.poll_grad()
+            if item is None:
+                time.sleep(0.0005)
+                continue
+            _, _, grad = item
+            params, state = update(params, grad, state)
+            if slow_ms:
+                time.sleep(slow_ms / 1e3)
+            server.publish(jax.tree.map(np.asarray, params))
+        m = server.metrics()
+        np.savez(
+            out_path,
+            flat=np.asarray(params["flat"]),
+            start=start,
+            stop=stop,
+            version=server.version,
+            grads_received=m["grads_received"],
+            stale_drops=m["stale_drops"],
+            compression_ratio=m["compression_ratio"],
+            staleness_hist=json.dumps(
+                {int(k): int(v) for k, v in server.staleness_seen.items()}
+            ),
+        )
+    finally:
+        server.close()
+
+
+def worker_main_sharded(addrs: Sequence[str], worker_id: int,
+                        cfg: Dict[str, Any],
+                        out_path: Optional[str] = None) -> int:
+    """Worker process body against S shard servers: one jitted
+    ``value_and_grad`` per step, then slice the flat gradient and push
+    each slice to its shard tagged with THAT shard's snapshot version.
+    Reads are per-shard (S request/reply round trips) and the versions
+    they return may disagree — recorded and written to ``out_path`` so
+    tests can assert cross-shard divergence actually happened."""
+    import jax
+
+    from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+    from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSWorker
+
+    code = None
+    if cfg.get("codec"):
+        from pytorch_ps_mpi_tpu.codecs import get_codec
+
+        code = get_codec(cfg["codec"], **cfg.get("codec_kw", {}))
+
+    _, params0, batch_fn, loss_fn = make_problem(cfg)
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))  # ONLY grad source
+    flat0 = _flatten(params0)
+    plan = shard_plan(flat0.size, len(addrs))
+
+    conns = []
+    for (start, stop), addr in zip(plan, addrs):
+        host, port = addr.rsplit(":", 1)
+        conns.append(TcpPSWorker(
+            host, int(port), worker_id, _slice_template(stop - start),
+            code=code, timeout=float(cfg.get("open_timeout", 60.0)),
+        ))
+
+    from pytorch_ps_mpi_tpu.parallel.async_train import worker_cfg
+
+    slow_ms, steps = worker_cfg(cfg, worker_id)
+
+    pushed = 0
+    max_version_spread = 0
+    try:
+        flat = np.empty_like(flat0)
+        for step in range(steps):
+            versions = []
+            for (start, stop), w in zip(plan, conns):
+                slice_params, ver = w.read_params(
+                    timeout=float(cfg.get("open_timeout", 60.0)))
+                flat[start:stop] = slice_params["flat"]
+                versions.append(ver)
+            max_version_spread = max(max_version_spread,
+                                     max(versions) - min(versions))
+            params = _unflatten(flat, params0)
+            loss, grads = grad_fn(params, batch_fn(step, worker_id))
+            jax.block_until_ready(grads)
+            if slow_ms:
+                time.sleep(slow_ms / 1e3)
+            g_flat = _flatten(grads)
+            for (start, stop), ver, w in zip(plan, versions, conns):
+                w.push_grad({"flat": g_flat[start:stop]}, ver,
+                            timeout=float(cfg.get("push_timeout", 60.0)))
+            pushed += 1
+    finally:
+        for w in conns:
+            w.close()
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump({"pushed": pushed,
+                       "max_version_spread": max_version_spread}, f)
+    return pushed
+
+
+def assemble(paths: Sequence[str], template: PyTree) -> PyTree:
+    """Reassemble the full parameter tree from the shard .npz files the
+    servers wrote (validates the slices tile the flat vector exactly)."""
+    flat = np.empty(_flat_size(template), np.float32)
+    covered = 0
+    for p in paths:
+        z = np.load(p, allow_pickle=False)
+        start, stop = int(z["start"]), int(z["stop"])
+        flat[start:stop] = z["flat"]
+        covered += stop - start
+    if covered != flat.size:
+        raise ValueError(f"shards cover {covered} of {flat.size} elements")
+    return _unflatten(flat, template)
+
+
+def spawn_shard_server(shard_id: int, n_shards: int, cfg: Dict[str, Any],
+                       out_path: str,
+                       env: Optional[Dict[str, str]] = None):
+    """Launch ``server_main`` in a fresh OS process (port auto-assigned;
+    the child prints ``{"shard": i, "port": p}`` on stdout — use
+    :func:`read_server_port`). Pinned to the host backend like
+    ``async_train.spawn_worker``."""
+    src = (
+        "import json,sys\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from pytorch_ps_mpi_tpu.parallel.sharded import server_main\n"
+        "sid, ns, cfg, out = (int(sys.argv[1]), int(sys.argv[2]),\n"
+        "                     json.loads(sys.argv[3]), sys.argv[4])\n"
+        "server_main(sid, ns, 0, cfg, out)\n"
+    )
+    e = dict(os.environ)
+    e.update({"JAX_PLATFORMS": "cpu"})
+    e.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, "-c", src, str(shard_id), str(n_shards),
+         json.dumps(cfg), out_path],
+        env=e, stdout=subprocess.PIPE, text=True,
+    )
+
+
+def read_server_port(proc, timeout: float = 120.0) -> int:
+    """Block until a spawned shard server prints its port line."""
+    import select
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if r:
+            line = proc.stdout.readline()
+            if line:
+                return int(json.loads(line)["port"])
+        if proc.poll() is not None:
+            raise RuntimeError(f"shard server exited early: {proc.returncode}")
+    raise TimeoutError("shard server never reported its port")
+
+
+def spawn_sharded_worker(addrs: Sequence[str], worker_id: int,
+                         cfg: Dict[str, Any], out_path: str,
+                         env: Optional[Dict[str, str]] = None):
+    """Launch ``worker_main_sharded`` in a fresh OS process."""
+    src = (
+        "import json,sys\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from pytorch_ps_mpi_tpu.parallel.sharded import worker_main_sharded\n"
+        "addrs, wid, cfg, out = (json.loads(sys.argv[1]), int(sys.argv[2]),\n"
+        "                        json.loads(sys.argv[3]), sys.argv[4])\n"
+        "sys.exit(0 if worker_main_sharded(addrs, wid, cfg, out) >= 0 else 1)\n"
+    )
+    e = dict(os.environ)
+    e.update({"JAX_PLATFORMS": "cpu"})
+    e.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, "-c", src, json.dumps(list(addrs)), str(worker_id),
+         json.dumps(cfg), out_path],
+        env=e,
+    )

@@ -1,0 +1,334 @@
+"""Cross-host async parameter server over native TCP (the DCN role).
+
+The second transport for the AsySG-InCon protocol: ``dcn.py`` moves bytes
+between co-hosted processes through shared memory; this module moves the
+same bytes between HOSTS through ``native/tcpps.cpp`` — the deployment
+shape the reference got from MPI over Ethernet/IB (reference
+``README.md:19-23``, ``mpi_comms.py:88,132``), realized as the plain TCP
+a TPU pod's data-center network exposes to host code. On a pod, the
+server runs on one slice's controller and workers on other slices'
+controllers; each host's in-XLA compute path (jit/pjit over its own
+chips) is unchanged.
+
+:class:`TcpPSServer` / :class:`TcpPSWorker` present the same surface as
+``ShmPSServer`` / ``ShmPSWorker`` — ``publish`` / ``poll_grad`` /
+``metrics`` / ``stragglers`` and ``read_params`` / ``push_grad`` — so
+``async_train.serve`` and ``async_train.worker_main`` run over either
+transport unmodified (``cfg["transport"] = "shm" | "tcp"``). Semantics
+preserved across the swap:
+
+- inconsistent reads: a worker gets the latest snapshot whenever it asks;
+  no barrier, concurrent workers may see different versions;
+- bounded staleness: the server drops gradients older than
+  ``max_staleness`` versions, counted in ``stale_drops``;
+- push back-pressure: a push is acknowledged by the server, so a worker
+  has at most one unacknowledged gradient in flight (the shm single-slot
+  mailbox's property, carried by protocol instead of memory layout);
+- codec wire: with ``code=`` only encoded payload BYTES travel
+  (``CodecWire``), decoded server-side — encode-before-send, reference
+  ``ps.py:94,166``.
+
+What TCP adds over shm: worker crash == socket EOF, an explicit liveness
+signal (``connected``), and elastic replacement is just a reconnect — no
+``reset_worker_slot`` surgery needed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from pytorch_ps_mpi_tpu.parallel.dcn import (
+    CodecWire,
+    PyTree,
+    _flat_size,
+    _flatten,
+    _u8,
+    _unflatten,
+)
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Build (once) and load native/tcpps.cpp; None without a toolchain."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    from pytorch_ps_mpi_tpu.utils.native import build_and_load
+
+    lib = build_and_load("tcpps.cpp")
+    if lib is None:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.tps_server_create.restype = ctypes.c_void_p
+    lib.tps_server_create.argtypes = [ctypes.c_uint16, ctypes.c_uint32,
+                                      ctypes.c_uint64]
+    lib.tps_server_port.restype = ctypes.c_uint16
+    lib.tps_server_port.argtypes = [ctypes.c_void_p]
+    lib.tps_server_publish.restype = ctypes.c_int
+    lib.tps_server_publish.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64,
+                                       ctypes.c_uint64]
+    lib.tps_server_pump.restype = ctypes.c_int
+    lib.tps_server_pump.argtypes = [ctypes.c_void_p]
+    lib.tps_server_pop_grad.restype = ctypes.c_int64
+    lib.tps_server_pop_grad.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.tps_server_pending.restype = ctypes.c_int
+    lib.tps_server_pending.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.tps_server_connected.restype = ctypes.c_int
+    lib.tps_server_connected.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.tps_server_close.argtypes = [ctypes.c_void_p]
+    lib.tps_worker_connect.restype = ctypes.c_void_p
+    lib.tps_worker_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                       ctypes.c_uint32, ctypes.c_int]
+    lib.tps_worker_read_params.restype = ctypes.c_int64
+    lib.tps_worker_read_params.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.tps_worker_push_grad.restype = ctypes.c_int
+    lib.tps_worker_push_grad.argtypes = [ctypes.c_void_p, u8p,
+                                         ctypes.c_uint64, ctypes.c_uint64,
+                                         ctypes.c_int]
+    lib.tps_worker_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class TcpPSServer:
+    """Owns params; serves snapshots and consumes gradients arriving over
+    TCP in arrival order. Same role/surface as ``ShmPSServer``; pass
+    ``port=0`` to auto-assign (read back via ``.port`` for workers)."""
+
+    def __init__(self, port: int, num_workers: int, template: PyTree,
+                 max_staleness: int = 4, code=None):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native tcpps unavailable (no g++?)")
+        self._lib = lib
+        self.template = template
+        self.num_workers = num_workers
+        self.max_staleness = max_staleness
+        self.wire = CodecWire(code, template) if code is not None else None
+        nbytes = _flat_size(template) * 4
+        grad_bytes = self.wire.wire_bytes if self.wire else nbytes
+        # one frame must fit the larger of a snapshot or a payload
+        self._h = lib.tps_server_create(port, num_workers,
+                                        max(nbytes, grad_bytes))
+        if not self._h:
+            raise RuntimeError(f"tps_server_create(port={port}) failed")
+        self.port = int(lib.tps_server_port(self._h))
+        self.version = 0
+        if self.wire:
+            self._grad_buf = np.empty(self.wire.wire_bytes, np.uint8)
+        else:
+            self._grad_buf = np.empty(_flat_size(template), np.float32)
+        self.stale_drops = 0
+        self.staleness_seen: Dict[int, int] = {}
+        self.grads_received = 0
+        self.bytes_received = 0
+        self.last_seen: Dict[int, float] = {}
+        self._t0 = time.time()
+
+    def metrics(self) -> Dict[str, float]:
+        """Wire observability, same schema as ``ShmPSServer.metrics``.
+        There is no transport-drop counter: an acknowledged push is never
+        discarded (a full queue back-pressures the pushing worker via its
+        withheld ack instead), so ``stale_drops`` is the only way a
+        consumed gradient can fail to be applied."""
+        raw = self.wire.raw_bytes if self.wire else _flat_size(self.template) * 4
+        wire = self.wire.wire_bytes if self.wire else raw
+        return {
+            "grads_received": float(self.grads_received),
+            "bytes_received": float(self.bytes_received),
+            "raw_bytes_per_grad": float(raw),
+            "wire_bytes_per_grad": float(wire),
+            "compression_ratio": raw / wire,
+            "stale_drops": float(self.stale_drops),
+        }
+
+    def publish(self, params: PyTree) -> None:
+        flat = _flatten(params)
+        self.version += 1
+        rc = self._lib.tps_server_publish(
+            self._h, _u8(flat.view(np.uint8)), flat.nbytes, self.version
+        )
+        if rc != 0:
+            raise RuntimeError("tps_server_publish failed")
+        self._lib.tps_server_pump(self._h)  # serve waiting readers promptly
+
+    def poll_grad(self) -> Optional[Tuple[int, int, PyTree]]:
+        """One pending gradient as (worker, version, grad_tree), or None.
+        Pumps the sockets, then drains stale gradients iteratively (same
+        bounded-staleness discipline as the shm server)."""
+        worker = ctypes.c_uint32()
+        version = ctypes.c_uint64()
+        self._lib.tps_server_pump(self._h)
+        while True:
+            n = self._lib.tps_server_pop_grad(
+                self._h, _u8(self._grad_buf.view(np.uint8)),
+                self._grad_buf.nbytes,
+                ctypes.byref(worker), ctypes.byref(version),
+            )
+            if n == 0:
+                return None
+            if n < 0:
+                raise RuntimeError(
+                    "tps_server_pop_grad: payload exceeds wire spec — worker "
+                    "and server codec configs disagree"
+                )
+            staleness = self.version - int(version.value)
+            self.staleness_seen[staleness] = (
+                self.staleness_seen.get(staleness, 0) + 1
+            )
+            self.last_seen[int(worker.value)] = time.time()
+            self.grads_received += 1
+            self.bytes_received += int(n)
+            if staleness <= self.max_staleness:
+                break
+            self.stale_drops += 1
+        expected = self.wire.wire_bytes if self.wire else _flat_size(self.template) * 4
+        if int(n) != expected:
+            # same one-time wire agreement the shm path enforces: a short
+            # payload would crash the decode, a same-size different layout
+            # would silently corrupt gradients
+            raise RuntimeError(
+                f"payload size {n} != wire spec {expected} bytes: worker "
+                "and server codec configs disagree"
+            )
+        if self.wire:
+            grad = self.wire.decode_from_bytes(self._grad_buf[:n].tobytes())
+        else:
+            flat = self._grad_buf[: n // 4].copy()
+            grad = _unflatten(flat, self.template)
+        return int(worker.value), int(version.value), grad
+
+    def connected(self, worker: int) -> bool:
+        """Transport-level liveness: does a socket claiming this worker id
+        exist right now? A crashed worker's connection closes (EOF/RST) —
+        the positive failure signal shm can't give (SURVEY §5.3)."""
+        self._lib.tps_server_pump(self._h)
+        return bool(self._lib.tps_server_connected(self._h, worker))
+
+    def stragglers(self, timeout: float) -> Dict[int, float]:
+        """Workers silent for ``timeout`` seconds: nothing consumed from
+        them recently, nothing queued from them, and (stronger than shm)
+        no open connection claiming their id — so a live worker that is
+        merely mid-way through one long jitted step is never flagged, and
+        acting on this report (elastic replacement) only ever targets
+        dead sockets. The trade-off: a worker wedged WITH its socket open
+        is not reported; watch ``last_seen`` ages for that."""
+        self._lib.tps_server_pump(self._h)
+        now = time.time()
+        out = {}
+        for w in range(self.num_workers):
+            if self._lib.tps_server_pending(self._h, w) > 0:
+                continue  # pushed, awaiting consumption: alive
+            if self._lib.tps_server_connected(self._h, w) == 1:
+                continue  # open socket: alive (maybe slow), not lost
+            age = now - self.last_seen.get(w, self._t0)
+            if age > timeout:
+                out[w] = age
+        return out
+
+    def close(self):
+        if self._h:
+            self._lib.tps_server_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class TcpPSWorker:
+    """Connects to a :class:`TcpPSServer` (possibly on another host),
+    reads the latest params whenever it likes, pushes version-tagged
+    gradients. Same surface as ``ShmPSWorker``."""
+
+    def __init__(self, host: str, port: int, worker_id: int, template: PyTree,
+                 timeout: float = 30.0, code=None, seed: int = 0):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native tcpps unavailable (no g++?)")
+        self._lib = lib
+        # the native side takes a dotted-quad only; resolve hostnames here
+        # so a bad name fails loudly as what it is, not as a timeout
+        import socket
+
+        try:
+            addr = socket.gethostbyname(host)
+        except OSError as e:
+            raise RuntimeError(f"cannot resolve PS host {host!r}: {e}") from e
+        self._h = lib.tps_worker_connect(
+            addr.encode(), port, worker_id, int(timeout * 1000)
+        )
+        if not self._h:
+            raise TimeoutError(
+                f"tps_worker_connect({host}={addr}:{port}) timed out"
+            )
+        self.worker_id = worker_id
+        self.template = template
+        self.wire = (
+            CodecWire(code, template, seed=seed + worker_id)
+            if code is not None else None
+        )
+        self._param_buf = np.empty(_flat_size(template), np.float32)
+
+    def read_params(self, timeout: float = 30.0) -> Tuple[PyTree, int]:
+        """Latest published snapshot (blocks until the server's first
+        publish, then one request/reply round trip per read)."""
+        version = ctypes.c_uint64()
+        deadline = time.time() + timeout
+        while True:
+            left_ms = max(1, int((deadline - time.time()) * 1000))
+            n = self._lib.tps_worker_read_params(
+                self._h, _u8(self._param_buf.view(np.uint8)),
+                self._param_buf.nbytes, ctypes.byref(version), left_ms,
+            )
+            if n == -2:
+                raise TimeoutError("tps_worker_read_params timed out")
+            if n < 0:
+                raise RuntimeError(f"tps_worker_read_params -> {n}")
+            if version.value > 0:
+                break
+            if time.time() > deadline:
+                raise TimeoutError("no parameter snapshot published yet")
+            time.sleep(0.002)
+        return _unflatten(self._param_buf[: n // 4].copy(), self.template), int(
+            version.value
+        )
+
+    def push_grad(self, grad: PyTree, version: int,
+                  timeout: float = 30.0) -> None:
+        if self.wire:
+            flat = np.frombuffer(self.wire.encode_to_bytes(grad), np.uint8).copy()
+        else:
+            flat = _flatten(grad)
+        rc = self._lib.tps_worker_push_grad(
+            self._h, _u8(flat.view(np.uint8)), flat.nbytes, version,
+            int(timeout * 1000),
+        )
+        if rc == -2:
+            raise TimeoutError("push_grad timed out awaiting server ack")
+        if rc != 1:
+            raise RuntimeError(f"tps_worker_push_grad -> {rc}")
+
+    def close(self):
+        if self._h:
+            self._lib.tps_worker_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

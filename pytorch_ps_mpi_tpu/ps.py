@@ -308,6 +308,11 @@ class MPI_PS:
         one fused XLA program (fast path) and only end-to-end time.
       seed: base PRNG seed for stochastic codecs.
       **hyper: optimizer hyperparameters (lr, momentum, betas, ...).
+        ``lr`` may be a float or a schedule callable ``step -> scalar``
+        from :data:`pytorch_ps_mpi_tpu.optim.SCHEDULES` (e.g.
+        ``warmup_cosine``): it is evaluated on the optimizer's traced
+        step counter inside the compiled program, so the rate varies per
+        step with no recompiles.
     """
 
     def __init__(
