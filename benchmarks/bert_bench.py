@@ -50,6 +50,7 @@ from pytorch_ps_mpi_tpu.utils.devtime import (
     codec_roundtrip_seconds,
     peak_flops_for,
     rtt_floor,
+    rtt_subtracted_ms,
     safe_ratio,
     timed,
 )
@@ -119,7 +120,8 @@ def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10):
         value=round(safe_ratio(1.0, dev_s), 3), unit="steps/sec",
         step_ms_device=round(dev_s * 1e3, 2),
         wall_ms_per_call=round(wall_s * 1e3, 2),
-        rtt_floor_ms=round(rtt_floor() * 1e3, 2),
+        rtt_probe_ms=round(rtt_floor() * 1e3, 2),
+        rtt_subtracted_ms=rtt_subtracted_ms(),
         flops_per_step=flops,
         mfu=round(safe_ratio(flops, dev_s * peak), 4) if peak else 0.0,
         device_kind=jax.devices()[0].device_kind,

@@ -93,23 +93,63 @@ def fetch_sync(out: Any) -> None:
     np.asarray(jax.device_get(leaf[(0,) * leaf.ndim] if leaf.ndim else leaf))
 
 
+def _rtt_sample(x) -> float:
+    t0 = time.perf_counter()
+    fetch_sync(x)
+    return time.perf_counter() - t0
+
+
 def rtt_floor(reps: int = 10) -> float:
     """Measured cost of fetching a scalar from an already-computed
     device array: the per-fetch overhead to subtract from amortized
-    timings. Cached per process."""
+    timings. Cached per process — use ONLY for the is-this-backend-
+    remote decision (:func:`scan_pass_runs`); subtraction must use an
+    RTT co-measured with the timing window (the tunnel RTT swings tens
+    of ms with host load, so a process-start floor subtracted from a
+    later window can swallow or inflate the whole signal)."""
     global _RTT
     if _RTT is None:
         import jax.numpy as jnp
 
         x = jnp.ones((8, 8))
         fetch_sync(x)
-        ts = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            fetch_sync(x)
-            ts.append(time.perf_counter() - t0)
-        _RTT = min(ts)
+        _RTT = min(_rtt_sample(x) for _ in range(reps))
     return _RTT
+
+
+# RTT actually subtracted by the most recent windowed measurement, for
+# benchmark provenance labels (the cached rtt_floor() can drift from it
+# by tens of ms with host load).
+LAST_WINDOW_RTT: float | None = None
+
+
+def rtt_subtracted_ms() -> float | None:
+    """RTT in ms actually subtracted by the most recent windowed
+    measurement (None before any ran) — emit THIS next to device times,
+    not the process-start ``rtt_floor``, so readers can reconcile
+    ``wall − rtt ≈ k * device_per_step`` exactly."""
+    return (
+        round(LAST_WINDOW_RTT * 1e3, 2) if LAST_WINDOW_RTT is not None
+        else None
+    )
+
+
+def _windowed_min(timed_call: Callable[[], float], reps: int) -> Tuple[float, float]:
+    """(min wall of ``timed_call``, min RTT) with the RTT samples
+    interleaved rep-by-rep in the SAME window, so load drift between
+    process start and measurement cannot skew the subtraction."""
+    global LAST_WINDOW_RTT
+    import jax.numpy as jnp
+
+    x = jnp.ones((8, 8))
+    fetch_sync(x)
+    walls, rtts = [], []
+    for _ in range(reps):
+        rtts.append(_rtt_sample(x))
+        walls.append(timed_call())
+        rtts.append(_rtt_sample(x))
+    LAST_WINDOW_RTT = min(rtts)
+    return min(walls), LAST_WINDOW_RTT
 
 
 def timed(
@@ -130,8 +170,9 @@ def timed(
     k-step program would multiply an already-slow fallback's wall clock
     for no information.
     """
-    rtt = rtt_floor()
     fetch_sync(call())  # compile + warm
+    # per-call wall never subtracts RTT (it reports what a user waits),
+    # so a plain min-of-reps needs no co-measured floor
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -141,28 +182,31 @@ def timed(
     if not scan_pass_runs():
         return per_call, per_call
     fetch_sync(scanned_call())  # compile + warm (only when it will run)
-    ts = []
-    for _ in range(max(3, reps // 2)):
+
+    def one_scan():
         t0 = time.perf_counter()
         fetch_sync(scanned_call())
-        ts.append(time.perf_counter() - t0)
-    device_per_step = max(0.0, min(ts) - rtt) / k
+        return time.perf_counter() - t0
+
+    wall, rtt = _windowed_min(one_scan, max(3, reps // 2))
+    device_per_step = max(0.0, wall - rtt) / k
     return per_call, device_per_step
 
 
 def scan_timed(loop_call: Callable[[], Any], k: int, reps: int = 3) -> float:
     """Device seconds per step of a pre-compiled k-step fused loop:
-    min-of-reps wall with one scalar fetch, minus the RTT floor, over k.
-    Returns 0.0 when the signal is below the RTT noise floor (guard
-    divisions with :func:`safe_ratio`)."""
-    rtt = rtt_floor()
+    min-of-reps wall with one scalar fetch, minus a co-measured RTT
+    floor, over k. Returns 0.0 when the signal is below the RTT noise
+    floor (guard divisions with :func:`safe_ratio`)."""
     fetch_sync(loop_call())  # warm / ensure compiled
-    ts = []
-    for _ in range(reps):
+
+    def one():
         t0 = time.perf_counter()
         fetch_sync(loop_call())
-        ts.append(time.perf_counter() - t0)
-    return max(0.0, min(ts) - rtt) / k
+        return time.perf_counter() - t0
+
+    wall, rtt = _windowed_min(one, reps)
+    return max(0.0, wall - rtt) / k
 
 
 def codec_roundtrip_seconds(code, shape, dtype, k: int = 32) -> float:
