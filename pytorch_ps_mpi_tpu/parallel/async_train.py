@@ -171,6 +171,20 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     return pushed
 
 
+def _save_ps_checkpoint(ckpt, params, state, server, applied_total: int) -> None:
+    if getattr(ckpt, "_last_ps_step", None) == applied_total:
+        return  # final save coinciding with a periodic one
+    import jax
+
+    ckpt.save(applied_total, {
+        "params": jax.tree.map(np.asarray, params),
+        "opt_state": jax.tree.map(np.asarray, state),
+        "version": server.version,
+        "applied_total": applied_total,
+    })
+    ckpt._last_ps_step = applied_total
+
+
 def serve(
     server,
     cfg: Dict[str, Any],
@@ -179,6 +193,9 @@ def serve(
     sync_barrier: bool = False,
     total_received: Optional[int] = None,
     timeout: float = 300.0,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
 ) -> Tuple[PyTree, Dict[str, float]]:
     """Server body: poll → (decode) → jitted optimizer update → publish.
 
@@ -189,6 +206,17 @@ def serve(
     expected to be dropped (otherwise their final blocked pushes would
     time out). Returns (final params, metrics incl. steps/sec and final
     loss on a held-out evaluation batch).
+
+    Checkpointing closes the SERVER side of the failure story (workers
+    are already elastic): with ``checkpoint_dir`` set, the full PS state
+    (params, optimizer state, publish version, applied count) is saved
+    every ``checkpoint_every`` applied gradients; a replacement server
+    started with ``resume=True`` restores the latest snapshot and keeps
+    the version counter monotonic, so training continues where the dead
+    server left off — workers just reconnect and read the next snapshot
+    (the reference's MPI job had no analog: a rank-0 death ended the
+    job, SURVEY §5.4/§5.3). ``applied``/counters restart per serve call;
+    the restored ``applied_total`` rides in the metrics.
     """
     import jax
 
@@ -201,6 +229,23 @@ def serve(
     update = jax.jit(lambda p, g, s: update_fn(p, g, s, h))
     eval_loss = jax.jit(loss_fn)
     eval_batch = batch_fn(10**6, 10**6)  # never used by any worker
+
+    ckpt = None
+    applied_before = 0
+    if checkpoint_dir:
+        from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(checkpoint_dir)
+        if resume:
+            template = {"params": params, "opt_state": state,
+                        "version": 0, "applied_total": 0}
+            restored = ckpt.restore(template)
+            params = restored["params"]
+            state = restored["opt_state"]
+            applied_before = int(restored["applied_total"])
+            # publish version stays monotonic across the restart so
+            # staleness accounting of in-flight worker reads is sane
+            server.version = int(restored["version"])
 
     loss0 = float(eval_loss(params, eval_batch))
     server.publish(params)
@@ -243,10 +288,17 @@ def serve(
             params, state = update(params, grad, state)
             applied += 1
         server.publish(jax.tree.map(np.asarray, params))
+        if ckpt and checkpoint_every and applied % checkpoint_every == 0:
+            _save_ps_checkpoint(ckpt, params, state, server,
+                                applied_before + applied)
     wall = time.perf_counter() - t0
+    if ckpt:  # final state always captured, whatever the stop reason
+        _save_ps_checkpoint(ckpt, params, state, server,
+                            applied_before + applied)
     m = dict(server.metrics())
     m.update(
         applied=float(applied),
+        applied_total=float(applied_before + applied),
         wall_s=wall,
         updates_per_sec=applied / wall if wall > 0 else 0.0,
         loss_initial=loss0,

@@ -277,6 +277,63 @@ def test_async_jitted_workers_converge_over_tcp():
     assert m["bytes_received"] == total_pushes * m["wire_bytes_per_grad"]
 
 
+def test_server_checkpoint_resume_continues_training(tmp_path):
+    """The SERVER side of the failure story (workers are elastic
+    already): a PS that checkpoints its full state (params, optimizer
+    state, publish version, applied count) dies; a replacement server on
+    a fresh port resumes from the snapshot and training CONTINUES — the
+    restored model evaluates exactly where the dead server left off, the
+    version counter stays monotonic, and further gradients keep
+    improving the loss. The reference's MPI job had no analog: rank-0
+    death ended the job (SURVEY §5.3/§5.4)."""
+    ckpt_dir = str(tmp_path / "ps_ckpt")
+    cfg = {
+        "transport": "tcp",
+        "model": "mlp",
+        "model_kw": {"features": (32, 4)},
+        "in_shape": (8,),
+        "batch": 64,
+        "seed": 9,
+        "optim": "sgd",
+        "hyper": {"lr": 0.02, "momentum": 0.9},  # momentum: state matters
+        "steps": 400,  # workers outlive each serve phase; killed after
+    }
+    _, params0, _, _ = make_problem(cfg)
+
+    def phase(resume: bool, n_grads: int):
+        server = tcp.TcpPSServer(0, num_workers=2, template=params0,
+                                 max_staleness=10**9)
+        addr = f"127.0.0.1:{server.port}"
+        workers = [spawn_worker(addr, i, cfg) for i in range(2)]
+        try:
+            params, m = serve(
+                server, cfg, total_grads=n_grads, timeout=240.0,
+                checkpoint_dir=ckpt_dir, checkpoint_every=10,
+                resume=resume,
+            )
+            version = server.version
+        finally:
+            for p in workers:
+                p.kill()
+                p.wait(timeout=30)
+            server.close()  # the "crash": state survives only in ckpt
+        return params, m, version
+
+    _, m1, v1 = phase(resume=False, n_grads=30)
+    assert m1["applied"] == 30 and m1["applied_total"] == 30.0
+    assert m1["loss_final"] < m1["loss_initial"]
+
+    _, m2, v2 = phase(resume=True, n_grads=30)
+    # continuity: the replacement starts EXACTLY where the dead server
+    # stopped (same eval batch, restored params)...
+    assert m2["loss_initial"] == pytest.approx(m1["loss_final"], rel=1e-5)
+    # ...the version counter never goes backwards across the restart...
+    assert v2 > v1
+    # ...the applied count accumulates, and training keeps improving
+    assert m2["applied_total"] == 60.0
+    assert m2["loss_final"] < m2["loss_initial"]
+
+
 def test_worker_crash_detected_and_replacement_reconnects():
     """TCP's failure story is STRONGER than shm's: a SIGKILLed worker's
     socket closes, so the server sees ``connected(w) == False`` directly
