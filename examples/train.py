@@ -42,7 +42,7 @@ from pytorch_ps_mpi_tpu.models.bert import mlm_loss
 from pytorch_ps_mpi_tpu.trainer import Trainer
 
 CONFIGS = ["mlp_mnist", "resnet18_cifar10", "resnet50_imagenet", "bert_mlm",
-           "switch_mlm"]
+           "switch_mlm", "gpt_lm"]
 
 
 def build(config: str, batch: int, seed: int = 0):
@@ -60,6 +60,20 @@ def build(config: str, batch: int, seed: int = 0):
         params = model.init(key, b0["tokens"])
         def loss_fn(p, b):
             return mlm_loss(model.apply(p, b["tokens"]), b["targets"], b["mask"])
+        return params, loss_fn, data
+    if config == "gpt_lm":
+        from pytorch_ps_mpi_tpu.data import synthetic_lm
+        from pytorch_ps_mpi_tpu.models import GPTLM, causal_lm_loss, gpt_config
+
+        gcfg = gpt_config(vocab_size=8192, hidden_size=256, num_layers=4,
+                          num_heads=8, intermediate_size=1024,
+                          max_position=256)
+        model = GPTLM(gcfg)
+        data = synthetic_lm(batch, seq_len=128, vocab_size=gcfg.vocab_size)
+        b0 = next(data)
+        params = model.init(key, b0["tokens"])
+        def loss_fn(p, b):
+            return causal_lm_loss(model.apply(p, b["tokens"]), b["tokens"])
         return params, loss_fn, data
     if config == "mlp_mnist":
         model = MLP(features=(128, 10))

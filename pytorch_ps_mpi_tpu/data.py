@@ -58,6 +58,38 @@ def synthetic_mlm(
         }
 
 
+def synthetic_lm(
+    batch: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+    order: int = 1,
+) -> Iterator[Dict[str, jax.Array]]:
+    """Endless iterator of causal-LM batches {'tokens'}: sequences from a
+    fixed random Markov chain, so next-token loss has genuine signal
+    below the uniform-entropy floor (pure-random tokens would make
+    convergence unobservable). ``order=1`` is a plain bigram chain — the
+    state IS the previous token, learnable by a 1-layer model; higher
+    orders hash the last tokens into the state (harder: the model must
+    recover the hash from context)."""
+    rng = np.random.RandomState(seed)
+    n_ctx = min(64, vocab_size)  # contexts hash into this many states
+    table = rng.dirichlet(np.ones(vocab_size) * 0.05, size=n_ctx)
+    cum = np.cumsum(table, axis=-1)
+    while True:
+        toks = np.zeros((batch, seq_len), np.int64)
+        toks[:, 0] = rng.randint(0, vocab_size, size=batch)
+        state = toks[:, 0] % n_ctx
+        for t in range(1, seq_len):
+            u = rng.rand(batch, 1)
+            toks[:, t] = (u < cum[state]).argmax(axis=-1)
+            if order == 1:
+                state = toks[:, t] % n_ctx
+            else:
+                state = (state * 31 + toks[:, t]) % n_ctx
+        yield {"tokens": jnp.asarray(toks)}
+
+
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

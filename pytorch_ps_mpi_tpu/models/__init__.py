@@ -12,5 +12,8 @@ from pytorch_ps_mpi_tpu.models.mlp import MLP
 from pytorch_ps_mpi_tpu.models.resnet import ResNet, ResNet18, ResNet50
 from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM
 from pytorch_ps_mpi_tpu.models.moe import SwitchConfig, SwitchMLM
+from pytorch_ps_mpi_tpu.models.gpt import GPTLM, causal_lm_loss, gpt_config, gpt_tiny
 
-__all__ = ["MLP", "ResNet", "ResNet18", "ResNet50", "BertConfig", "BertMLM", "SwitchConfig", "SwitchMLM"]
+__all__ = ["MLP", "ResNet", "ResNet18", "ResNet50", "BertConfig", "BertMLM",
+           "SwitchConfig", "SwitchMLM", "GPTLM", "causal_lm_loss",
+           "gpt_config", "gpt_tiny"]

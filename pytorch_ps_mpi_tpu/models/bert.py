@@ -34,6 +34,7 @@ class BertConfig:
     dtype: Any = jnp.float32
     attention: str = "full"       # 'full', 'ring', or 'ulysses'
     seq_axis: str = "seq"         # mesh axis for ring/ulysses attention
+    causal: bool = False          # decoder-only masking (GPT family)
 
     @staticmethod
     def base() -> "BertConfig":
@@ -61,11 +62,15 @@ class SelfAttention(nn.Module):
         )(x)                                   # [b, l, 3, h, d]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if c.attention == "ring":
-            out = ring_attention(q, k, v, c.seq_axis, causal=False)
+            out = ring_attention(q, k, v, c.seq_axis, causal=c.causal)
         elif c.attention == "ulysses":
-            out = ulysses_attention(q, k, v, c.seq_axis, causal=False)
+            out = ulysses_attention(q, k, v, c.seq_axis, causal=c.causal)
         elif c.attention == "full":
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / head_dim ** 0.5
+            if c.causal:
+                l = s.shape[-1]
+                mask = jnp.tril(jnp.ones((l, l), bool))
+                s = jnp.where(mask[None, None], s, jnp.asarray(-1e30, s.dtype))
             p = jax.nn.softmax(s, axis=-1)
             out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
         else:

@@ -1,0 +1,81 @@
+"""Decoder-only causal LM (GPT family) — the autoregressive complement
+to the BERT encoder, sharing its MXU-shaped transformer blocks.
+
+The reference repo carries no models at all (they lived in a sibling
+research repo, SURVEY §2.1); this family exists because a PS framework's
+stress cases differ by objective: the MLM stack stresses flat-gradient
+bandwidth, while a causal LM exercises the CAUSAL paths of both
+sequence-parallel designs (ring attention's skip-early-blocks schedule
+and Ulysses' masked local attention) inside a real model rather than a
+kernel test. ``attention='ring'`` with ``causal=True`` is the canonical
+long-context training shape: each device holds a sequence shard and the
+ring skips the blocks the mask would zero anyway.
+
+Weight tying (lm head = token embedding, Press & Wolf 2017) is on by
+default, as in GPT-2.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_ps_mpi_tpu.models.bert import BertConfig, EncoderLayer
+
+
+def gpt_config(**kw) -> BertConfig:
+    """A ``BertConfig`` with causal masking on — the one knob that turns
+    the encoder stack into a decoder stack."""
+    kw.setdefault("causal", True)
+    return BertConfig(**kw)
+
+
+def gpt_tiny(**kw) -> BertConfig:
+    kw.setdefault("causal", True)
+    return BertConfig.tiny(**kw)
+
+
+class GPTLM(nn.Module):
+    """Token-in, next-token-logits-out decoder (pre-norm, tied head).
+
+    ``cfg.causal`` must be True — a non-causal config would silently
+    train a bidirectional model on a next-token objective (trivially
+    cheatable), so it is rejected loudly.
+    """
+
+    cfg: BertConfig
+    tie_embeddings: bool = True
+
+    @nn.compact
+    def __call__(self, tokens, position_offset: int = 0):
+        c = self.cfg
+        if not c.causal:
+            raise ValueError("GPTLM requires cfg.causal=True")
+        tok_emb = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                           name="tok_emb")
+        x = tok_emb(tokens)
+        positions = position_offset + jnp.arange(tokens.shape[-1])
+        pos = nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
+                       name="pos_emb")(positions)
+        x = x + pos[None]
+        for i in range(c.num_layers):
+            x = EncoderLayer(c, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=c.dtype)(x)
+        if self.tie_embeddings:
+            logits = x @ tok_emb.embedding.T.astype(c.dtype)
+        else:
+            logits = nn.Dense(c.vocab_size, dtype=c.dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def causal_lm_loss(logits, tokens, mask=None):
+    """Next-token cross-entropy: position t predicts token t+1. ``mask``
+    (optional, [b, l]) marks VALID input positions; the loss at the last
+    position (no target) is always dropped."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    ll = jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)[..., 0]
+    if mask is None:
+        return -ll.mean()
+    m = mask[:, 1:].astype(logits.dtype)
+    return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
