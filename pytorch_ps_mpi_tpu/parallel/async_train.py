@@ -243,13 +243,24 @@ def serve(
             params = restored["params"]
             state = restored["opt_state"]
             applied_before = int(restored["applied_total"])
+            # the restored step already exists on disk — never re-save it
+            # (Orbax raises StepAlreadyExistsError; the numpy fallback
+            # would silently overwrite)
+            ckpt._last_ps_step = applied_before
             # publish version stays monotonic across the restart so
-            # staleness accounting of in-flight worker reads is sane
-            server.version = int(restored["version"])
+            # staleness accounting of in-flight worker reads is sane.
+            # A REAL crash can have published up to checkpoint_every
+            # versions past the snapshot (no final save), so surviving
+            # workers may hold versions the snapshot never saw — jump
+            # the counter past anything they could have read
+            server.version = (
+                int(restored["version"]) + max(int(checkpoint_every), 0) + 1
+            )
 
     loss0 = float(eval_loss(params, eval_batch))
     server.publish(params)
     applied = 0
+    last_saved = applied_before
     n_workers = server.num_workers
     # sync_barrier holds a FIFO per worker: the server pops mailboxes
     # eagerly (the single-slot mailbox never back-pressures a fast
@@ -288,9 +299,15 @@ def serve(
             params, state = update(params, grad, state)
             applied += 1
         server.publish(jax.tree.map(np.asarray, params))
-        if ckpt and checkpoint_every and applied % checkpoint_every == 0:
+        if (ckpt and checkpoint_every
+                and applied_before + applied - last_saved >= checkpoint_every):
+            # cadence by APPLIED COUNT, not divisibility: sync_barrier
+            # mode advances `applied` by n_workers per round and would
+            # hit an exact multiple only every lcm — losing up to
+            # n_workers x checkpoint_every of progress on a crash
             _save_ps_checkpoint(ckpt, params, state, server,
                                 applied_before + applied)
+            last_saved = applied_before + applied
     wall = time.perf_counter() - t0
     if ckpt:  # final state always captured, whatever the stop reason
         _save_ps_checkpoint(ckpt, params, state, server,

@@ -280,7 +280,10 @@ class ShmPSServer:
             )
             if n <= 0:
                 return None
-            staleness = self.version - int(version.value)
+            # clamp at 0: a future version (worker outliving a server
+            # restart) is simply fresh; a negative key would corrupt the
+            # histogram and dodge the drop check
+            staleness = max(0, self.version - int(version.value))
             self.staleness_seen[staleness] = (
                 self.staleness_seen.get(staleness, 0) + 1
             )

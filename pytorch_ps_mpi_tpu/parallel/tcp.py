@@ -183,7 +183,10 @@ class TcpPSServer:
                     "tps_server_pop_grad: payload exceeds wire spec — worker "
                     "and server codec configs disagree"
                 )
-            staleness = self.version - int(version.value)
+            # clamp at 0: a version from the future (e.g. a worker that
+            # outlived a server restart) is simply fresh, and a negative
+            # key would corrupt the histogram and dodge the drop check
+            staleness = max(0, self.version - int(version.value))
             self.staleness_seen[staleness] = (
                 self.staleness_seen.get(staleness, 0) + 1
             )
