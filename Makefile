@@ -16,5 +16,6 @@ tpu-watch:
 native:
 	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libwirecodec.so native/wirecodec.cpp
 	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp
+	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libtcpps.so native/tcpps.cpp
 
 .PHONY: test bench native tpu-watch

@@ -55,6 +55,17 @@ def main(argv=None):
     ap.add_argument("--sync-barrier", action="store_true",
                     help="synchronous-PS oracle mode (for comparison runs)")
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--transport", default="shm", choices=["shm", "tcp"],
+                    help="PS wire: shm (co-hosted processes) or tcp (the "
+                         "cross-host DCN-role transport)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="tcp transport: listen port (0 = auto)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="checkpoint the PS state every --checkpoint-every "
+                         "applied gradients")
+    ap.add_argument("--checkpoint-every", type=int, default=50)
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the latest PS checkpoint before serving")
     args = ap.parse_args(argv)
 
     in_shape = (8,) if args.model == "mlp" else (32, 32, 3)
@@ -83,11 +94,22 @@ def main(argv=None):
         code = get_codec(args.codec)
 
     _, params0, _, _ = make_problem(cfg)
-    name = f"/psq_train_{os.getpid()}"
-    server = dcn.ShmPSServer(
-        name, num_workers=args.workers, template=params0,
-        max_staleness=args.max_staleness, code=code,
-    )
+    if args.transport == "tcp":
+        from pytorch_ps_mpi_tpu.parallel import tcp
+
+        cfg["transport"] = "tcp"
+        server = tcp.TcpPSServer(
+            args.port, num_workers=args.workers, template=params0,
+            max_staleness=args.max_staleness, code=code,
+        )
+        name = f"127.0.0.1:{server.port}"
+        print(f"tcp PS listening on {name}")
+    else:
+        name = f"/psq_train_{os.getpid()}"
+        server = dcn.ShmPSServer(
+            name, num_workers=args.workers, template=params0,
+            max_staleness=args.max_staleness, code=code,
+        )
     total = args.workers * args.steps
     procs = []
     try:
@@ -95,6 +117,8 @@ def main(argv=None):
         params, metrics = serve(
             server, cfg, total_grads=0, total_received=total,
             sync_barrier=args.sync_barrier, timeout=args.timeout,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every, resume=args.resume,
         )
         for p in procs:
             rc = p.wait(timeout=args.timeout)
