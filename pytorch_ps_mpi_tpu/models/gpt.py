@@ -59,8 +59,9 @@ class GPTLM(nn.Module):
         pos = nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
                        name="pos_emb")(positions)
         x = x + pos[None]
+        layer_cls = nn.remat(EncoderLayer) if c.remat else EncoderLayer
         for i in range(c.num_layers):
-            x = EncoderLayer(c, name=f"layer_{i}")(x)
+            x = layer_cls(c, name=f"layer_{i}")(x)
         x = nn.LayerNorm(dtype=c.dtype)(x)
         if self.tie_embeddings:
             logits = x @ tok_emb.embedding.T.astype(c.dtype)
