@@ -195,7 +195,7 @@ def codec_table(n_params: int, measure: bool):
         if measure:
             try:
                 row["enc_dec_ms_device"] = round(
-                    codec_roundtrip_seconds(code, shape, jnp.float32, k=8)
+                    codec_roundtrip_seconds(code, shape, jnp.float32)
                     * 1e3, 2,
                 )
             except Exception as e:  # one codec OOMing must not kill the table

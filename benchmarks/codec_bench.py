@@ -39,10 +39,11 @@ CODECS = [  # (label, registry name, kwargs)
 PALLAS_PAIRS = ["int8", "sign"]
 
 
-def bench_codec(name, kw, n, k=32):
+def bench_codec(name, kw, n, k=None):
     """Device ms for one encode+decode round-trip at ``n`` elements —
-    the shared honest-timing recipe (``utils/devtime.py``: k-step fused
-    scan with a data dependence, scalar fetch, RTT floor subtracted)."""
+    the shared honest-timing recipe (``utils/devtime.py``: adaptive-k
+    fused scan with a data dependence, scalar fetch, co-measured RTT
+    floor subtracted; k sized so the signal clears the RTT jitter)."""
     from pytorch_ps_mpi_tpu.utils.devtime import codec_roundtrip_seconds
 
     code = get_codec(name, **kw)

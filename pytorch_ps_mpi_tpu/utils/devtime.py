@@ -209,27 +209,55 @@ def scan_timed(loop_call: Callable[[], Any], k: int, reps: int = 3) -> float:
     return max(0.0, wall - rtt) / k
 
 
-def codec_roundtrip_seconds(code, shape, dtype, k: int = 32) -> float:
+def codec_roundtrip_seconds(code, shape, dtype, k: Optional[int] = None) -> float:
     """Device seconds for one ``encode`` + ``decode`` of a codec at
     ``shape`` — a k-iteration fused scan whose iterations carry a
     numerically-negligible data dependence (``+ decoded * 1e-30``) so XLA
     cannot hoist the codec out of the loop. The one shared implementation
     of the honest codec timing recipe (bench consumers must not re-roll
-    it)."""
+    it).
+
+    ``k=None`` picks the scan length ADAPTIVELY: a coarse k=8 estimate
+    sizes the real run so the total signal is ≥ ~20 ms, far above the
+    tunnel's RTT jitter. A fixed small k once measured the same kernel
+    anywhere between 0.05 ms and 1.3 ms run-to-run (a 3 ms signal under
+    ±2 ms jitter), flipping which of two implementations looked faster.
+    k is snapped to {8, 64, 512} so the compilation cache holds across
+    runs."""
     import jax.numpy as jnp
 
     g = jax.random.normal(jax.random.key(0), shape, dtype)
     st = code.init_state(shape, dtype)
     rng = jax.random.key(1) if code.needs_rng else None
 
-    @jax.jit
-    def loop(g, st):
-        def body(carry, _):
-            payload, _ = code.encode(carry, st, rng)
-            d = code.decode(payload, shape, dtype)
-            return carry + d.astype(carry.dtype) * jnp.asarray(1e-30, carry.dtype), None
+    def make_loop(length):
+        @jax.jit
+        def loop(g, st):
+            def body(carry, _):
+                payload, _ = code.encode(carry, st, rng)
+                d = code.decode(payload, shape, dtype)
+                return carry + d.astype(carry.dtype) * jnp.asarray(
+                    1e-30, carry.dtype
+                ), None
 
-        out, _ = jax.lax.scan(body, g, None, length=k)
-        return out
+            out, _ = jax.lax.scan(body, g, None, length=length)
+            return out
 
-    return scan_timed(lambda: loop(g, st), k)
+        return loop
+
+    if k is not None:
+        loop = make_loop(k)
+        return scan_timed(lambda: loop(g, st), k)
+    if not scan_pass_runs():  # synchronous backend: no jitter to outrun
+        loop = make_loop(8)
+        return scan_timed(lambda: loop(g, st), 8)
+    coarse = make_loop(8)
+    est = scan_timed(lambda: coarse(g, st), 8)
+    target = 0.020  # seconds of total signal
+    for kk in (8, 64, 512):
+        if est * kk >= target or kk == 512:
+            break
+    if kk == 8:
+        return est
+    loop = make_loop(kk)
+    return scan_timed(lambda: loop(g, st), kk)

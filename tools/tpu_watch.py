@@ -42,7 +42,7 @@ OUT = os.path.join(REPO, "BENCH_TPU_WATCH.jsonl")
 # is to catch TPU liveness windows quickly, not to redo CPU work.
 STAGES = [
     ("bench", [sys.executable, "bench.py"], 900),
-    ("codec_bench", [sys.executable, "benchmarks/codec_bench.py"], 900),
+    ("codec_bench", [sys.executable, "benchmarks/codec_bench.py"], 1800),
     ("leader_bench", [sys.executable, "benchmarks/leader_bench.py"], 600),
     ("bert_bench",
      [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed"], 900),
