@@ -67,6 +67,8 @@ def main(argv=None):
     ap.add_argument("--resume", action="store_true",
                     help="restore the latest PS checkpoint before serving")
     args = ap.parse_args(argv)
+    if args.resume and not args.checkpoint_dir:
+        ap.error("--resume requires --checkpoint-dir")
 
     in_shape = (8,) if args.model == "mlp" else (32, 32, 3)
     cfg = {

@@ -171,7 +171,8 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     return pushed
 
 
-def _save_ps_checkpoint(ckpt, params, state, server, applied_total: int) -> None:
+def _save_ps_checkpoint(ckpt, params, state, server, applied_total: int,
+                        checkpoint_every: int) -> None:
     if getattr(ckpt, "_last_ps_step", None) == applied_total:
         return  # final save coinciding with a periodic one
     import jax
@@ -181,6 +182,10 @@ def _save_ps_checkpoint(ckpt, params, state, server, applied_total: int) -> None
         "opt_state": jax.tree.map(np.asarray, state),
         "version": server.version,
         "applied_total": applied_total,
+        # the SAVING run's cadence bounds how far past this snapshot the
+        # server can have published before a crash — the resume jump
+        # must use it, not the restarting run's (possibly smaller) one
+        "checkpoint_every": int(checkpoint_every),
     })
     ckpt._last_ps_step = applied_total
 
@@ -232,13 +237,16 @@ def serve(
 
     ckpt = None
     applied_before = 0
+    if resume and not checkpoint_dir:
+        raise ValueError("resume=True requires checkpoint_dir")
     if checkpoint_dir:
         from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
 
         ckpt = CheckpointManager(checkpoint_dir)
         if resume:
             template = {"params": params, "opt_state": state,
-                        "version": 0, "applied_total": 0}
+                        "version": 0, "applied_total": 0,
+                        "checkpoint_every": 0}
             restored = ckpt.restore(template)
             params = restored["params"]
             state = restored["opt_state"]
@@ -249,13 +257,15 @@ def serve(
             ckpt._last_ps_step = applied_before
             # publish version stays monotonic across the restart so
             # staleness accounting of in-flight worker reads is sane.
-            # A REAL crash can have published up to checkpoint_every
-            # versions past the snapshot (no final save), so surviving
-            # workers may hold versions the snapshot never saw — jump
-            # the counter past anything they could have read
-            server.version = (
-                int(restored["version"]) + max(int(checkpoint_every), 0) + 1
-            )
+            # A REAL crash can have published up to the CRASHED run's
+            # checkpoint_every versions past the snapshot (no final
+            # save), so surviving workers may hold versions the snapshot
+            # never saw — jump the counter past anything they could have
+            # read, by the saved cadence (not this run's, which the
+            # operator may have shrunk)
+            jump = max(int(restored["checkpoint_every"]),
+                       int(checkpoint_every), 0)
+            server.version = int(restored["version"]) + jump + 1
 
     loss0 = float(eval_loss(params, eval_batch))
     server.publish(params)
@@ -306,12 +316,12 @@ def serve(
             # hit an exact multiple only every lcm — losing up to
             # n_workers x checkpoint_every of progress on a crash
             _save_ps_checkpoint(ckpt, params, state, server,
-                                applied_before + applied)
+                                applied_before + applied, checkpoint_every)
             last_saved = applied_before + applied
     wall = time.perf_counter() - t0
     if ckpt:  # final state always captured, whatever the stop reason
         _save_ps_checkpoint(ckpt, params, state, server,
-                            applied_before + applied)
+                            applied_before + applied, checkpoint_every)
     m = dict(server.metrics())
     m.update(
         applied=float(applied),

@@ -24,7 +24,7 @@ backends and to rooflines).
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import numpy as np
