@@ -55,6 +55,7 @@ class ThresholdCodec(Codec):
         max_fraction: float = 0.25,
         target_fraction: float = 0.0,
         eta: float = 0.25,
+        compaction: str | None = None,
     ):
         """Args:
           tau: initial threshold in units of the gradient's mean |g|.
@@ -63,15 +64,31 @@ class ThresholdCodec(Codec):
           target_fraction: if >0, adapt tau so the kept fraction tracks
             this value (tau becomes codec state).
           eta: controller gain for the tau adaptation.
+          compaction: ``'sort'`` compacts survivor indices with one
+            sort — a bitonic network the TPU runs vectorized;
+            ``'scatter'`` uses ``jnp.nonzero(size=cap)``, which lowers to
+            an n-sized scatter TPUs execute serially but CPUs run cheaply
+            (measured: scatter 3.4× faster than sort on the host CPU at
+            1M elems, while on TPU the n-scatter is the 72 ms outlier of
+            the codec table). Default ``None`` picks by the ambient
+            backend: sort on TPU, scatter elsewhere. Both produce
+            identical decoded gradients; only the garbage tail beyond
+            ``length`` differs (and decode masks it either way).
         """
         if not 0.0 < max_fraction <= 1.0:
             raise ValueError(f"max_fraction must be in (0, 1], got {max_fraction}")
         if target_fraction and target_fraction > max_fraction:
             raise ValueError("target_fraction must be <= max_fraction")
+        if compaction is None:
+            compaction = "sort" if jax.default_backend() == "tpu" else "scatter"
+        if compaction not in ("sort", "scatter"):
+            raise ValueError(f"compaction must be 'sort' or 'scatter', "
+                             f"got {compaction!r}")
         self.tau = float(tau)
         self.max_fraction = float(max_fraction)
         self.target_fraction = float(target_fraction)
         self.eta = float(eta)
+        self.compaction = compaction
 
     def _cap(self, shape) -> int:
         n = int(np.prod(shape)) if shape else 1
@@ -90,9 +107,19 @@ class ThresholdCodec(Codec):
         mask = jnp.abs(flat) > thr
         kept = jnp.sum(mask)  # true survivor count — data-dependent
         # static-size compaction: indices of the first `cap` survivors in
-        # index order; slots past min(kept, cap) are fill (index 0) and the
-        # values gathered there are garbage by design — see module doc.
-        (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
+        # index order; slots past min(kept, cap) hold garbage by design
+        # (see module doc) — decode masks them by `length` either way.
+        if self.compaction == "sort":
+            # survivors keep their index as the sort key, non-survivors
+            # get index+n: one ascending argsort puts survivor indices
+            # first IN INDEX ORDER. The sort is bitonic — vectorized on
+            # TPU, unlike nonzero's serial n-sized scatter.
+            pos = jnp.arange(n, dtype=jnp.int32)
+            keys = jnp.where(mask, pos, pos + n)
+            idx = jax.lax.sort(keys)[:cap]
+            idx = jnp.where(idx >= n, idx - n, idx)  # unbias garbage tail
+        else:
+            (idx,) = jnp.nonzero(mask, size=cap, fill_value=0)
         payload = {
             "values": jnp.take(flat, idx),
             "indices": idx.astype(jnp.int32),

@@ -330,6 +330,37 @@ def test_threshold_validation():
         ThresholdCodec(max_fraction=0.0)
     with pytest.raises(ValueError):
         ThresholdCodec(max_fraction=0.1, target_fraction=0.2)
+    with pytest.raises(ValueError):
+        ThresholdCodec(compaction="bogus")
+
+
+def test_threshold_sort_and_scatter_compaction_agree():
+    """The sort compaction (TPU-vectorized bitonic) and the nonzero
+    scatter compaction produce the SAME survivor set: identical lengths,
+    identical valid-region indices/values, identical decoded gradients —
+    including under cap overflow (both drop the tail in index order)."""
+    from pytorch_ps_mpi_tpu.codecs import ThresholdCodec
+
+    for tau, max_fraction in [(2.0, 0.25), (0.1, 0.05)]:  # normal, overflow
+        sort_c = ThresholdCodec(tau=tau, max_fraction=max_fraction,
+                                compaction="sort")
+        scat_c = ThresholdCodec(tau=tau, max_fraction=max_fraction,
+                                compaction="scatter")
+        g = jax.random.normal(jax.random.key(7), (64, 32))
+        p_sort, _ = sort_c.encode(g, sort_c.init_state(g.shape, g.dtype))
+        p_scat, _ = scat_c.encode(g, scat_c.init_state(g.shape, g.dtype))
+        k = int(p_sort["length"])
+        assert k == int(p_scat["length"])
+        np.testing.assert_array_equal(
+            np.asarray(p_sort["indices"][:k]), np.asarray(p_scat["indices"][:k])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(p_sort["values"][:k]), np.asarray(p_scat["values"][:k])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(sort_c.decode(p_sort, g.shape, g.dtype)),
+            np.asarray(scat_c.decode(p_scat, g.shape, g.dtype)),
+        )
 
 
 def test_qsgd_levels_bounded():
