@@ -133,6 +133,117 @@ def test_push_pop_integrity_multiworker():
         server.close()
 
 
+def _frame(op, worker=0, version=0, payload=b""):
+    import struct
+
+    return struct.pack("<IB3xIQQ", 0x31535054, op, worker, version,
+                       len(payload)) + payload
+
+
+def test_partial_frames_reassembled_byte_by_byte():
+    """The server's frame parser must tolerate arbitrary TCP segmentation:
+    a HELLO + GET_PARAMS + PUSH_GRAD stream delivered ONE BYTE AT A TIME
+    is handled identically to whole frames."""
+    import socket
+    import struct
+
+    tpl = _template(4)
+    server = tcp.TcpPSServer(0, num_workers=1, template=tpl)
+    try:
+        server.publish({"w": np.arange(4, dtype=np.float32)})
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        grad = np.full(4, 2.5, np.float32).tobytes()
+        stream = (_frame(1, worker=0) + _frame(2, worker=0)
+                  + _frame(4, worker=0, version=1, payload=grad))
+        for i in range(len(stream)):  # worst-case segmentation
+            s.sendall(stream[i:i + 1])
+            server._lib.tps_server_pump(server._h)
+
+        # reply stream: one PARAMS frame then one ACK frame
+        def read_exact(n):
+            buf = b""
+            deadline = time.time() + 30
+            while len(buf) < n and time.time() < deadline:
+                server._lib.tps_server_pump(server._h)
+                try:
+                    s.settimeout(0.05)
+                    chunk = s.recv(n - len(buf))
+                    if chunk:
+                        buf += chunk
+                except socket.timeout:
+                    pass
+            assert len(buf) == n
+            return buf
+
+        hdr = struct.unpack("<IB3xIQQ", read_exact(28))
+        assert hdr[1] == 3 and hdr[3] == 1  # PARAMS, version 1
+        params = np.frombuffer(read_exact(int(hdr[4])), np.float32)
+        np.testing.assert_array_equal(params, np.arange(4, dtype=np.float32))
+        ack = struct.unpack("<IB3xIQQ", read_exact(28))
+        assert ack[1] == 5 and ack[3] == 1  # ACK for the push
+
+        item = server.poll_grad()
+        assert item is not None
+        wid, ver, g = item
+        assert (wid, ver) == (0, 1)
+        np.testing.assert_array_equal(np.asarray(g["w"]),
+                                      np.full(4, 2.5, np.float32))
+        s.close()
+    finally:
+        server.close()
+
+
+def test_bad_magic_or_oversize_frame_closes_connection():
+    """Protocol violations (wrong magic; len > max_msg) close the
+    offending connection instead of corrupting server state; a
+    well-behaved client on a fresh connection still works after."""
+    import socket
+
+    tpl = _template(4)
+    server = tcp.TcpPSServer(0, num_workers=1, template=tpl)
+    try:
+        server.publish({"w": np.zeros(4, np.float32)})
+        for bad in (b"\xde\xad\xbe\xef" + b"\x00" * 24,
+                    _frame(4, version=1, payload=b"")[:20]
+                    + (1 << 40).to_bytes(8, "little")):
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=10)
+            s.sendall(bad)
+            deadline = time.time() + 30
+            closed = False
+            while time.time() < deadline and not closed:
+                server._lib.tps_server_pump(server._h)
+                try:
+                    s.settimeout(0.05)
+                    if s.recv(1) == b"":
+                        closed = True
+                except socket.timeout:
+                    pass
+                except ConnectionError:
+                    closed = True
+            assert closed
+            s.close()
+        # server is still healthy for a real worker
+        w = tcp.TcpPSWorker("127.0.0.1", server.port, 0, tpl)
+        done = {}
+
+        def body():
+            done["params"] = w.read_params(timeout=30)
+
+        t = threading.Thread(target=body)
+        t.start()
+        deadline = time.time() + 30
+        while t.is_alive() and time.time() < deadline:
+            server._lib.tps_server_pump(server._h)
+            time.sleep(0.005)
+        t.join(timeout=1)
+        assert done["params"][1] == 1
+        w.close()
+    finally:
+        server.close()
+
+
 def test_queue_cap_backpressures_never_drops():
     """When the server's gradient queue is at cap (4*workers+16), further
     pushes are NOT acknowledged-then-dropped: the frame stays buffered,
