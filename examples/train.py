@@ -15,6 +15,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import sys
 
@@ -45,7 +46,7 @@ CONFIGS = ["mlp_mnist", "resnet18_cifar10", "resnet50_imagenet", "bert_mlm",
            "switch_mlm", "gpt_lm"]
 
 
-def build(config: str, batch: int, seed: int = 0):
+def build(config: str, batch: int, seed: int = 0, remat: bool = False):
     """Returns (params, loss_fn, batch_iterator)."""
     key = jax.random.key(seed)
     if config == "switch_mlm":
@@ -67,7 +68,7 @@ def build(config: str, batch: int, seed: int = 0):
 
         gcfg = gpt_config(vocab_size=8192, hidden_size=256, num_layers=4,
                           num_heads=8, intermediate_size=1024,
-                          max_position=256)
+                          max_position=256, remat=remat)
         model = GPTLM(gcfg)
         data = synthetic_lm(batch, seq_len=128, vocab_size=gcfg.vocab_size)
         b0 = next(data)
@@ -89,7 +90,7 @@ def build(config: str, batch: int, seed: int = 0):
     elif config == "resnet50_imagenet":
         model = ResNet50(num_classes=1000)
     else:
-        cfg = BertConfig.base()
+        cfg = dataclasses.replace(BertConfig.base(), remat=remat)
         model = BertMLM(cfg)
         data = synthetic_mlm(batch, seq_len=128, vocab_size=cfg.vocab_size)
         b0 = next(data)
@@ -130,6 +131,12 @@ def main(argv=None):
                     help="k=v passed to the codec (repeatable)")
     ap.add_argument("--bf16-comm", action="store_true",
                     help="bfloat16 gradient collectives")
+    ap.add_argument("--donate", action="store_true",
+                    help="donate params/state buffers to XLA (in-place "
+                         "device update; ~one params+state copy less HBM)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize transformer layers in backward "
+                         "(BERT/GPT/Switch configs)")
     ap.add_argument("--scan-chunk", type=int, default=1,
                     help=">1 fuses N steps per XLA program")
     ap.add_argument("--checkpoint-dir", default=None)
@@ -150,7 +157,10 @@ def main(argv=None):
             kw[k] = v
         code = get_codec(args.codec, **kw)
 
-    params, loss_fn, data = build(args.config, args.batch)
+    if args.remat and args.config not in ("bert_mlm", "gpt_lm"):
+        print(f"note: --remat has no effect on {args.config} "
+              "(transformer configs only)")
+    params, loss_fn, data = build(args.config, args.batch, remat=args.remat)
     from pytorch_ps_mpi_tpu.data import prefetch
 
     data = prefetch(data)  # overlap host batch construction with the step
@@ -171,7 +181,8 @@ def main(argv=None):
     opt = MPI_PS(
         params, optim=args.optim, code=code, mode=args.mode,
         average=True, instrument=args.instrument,
-        comm_dtype=jnp.bfloat16 if args.bf16_comm else None, **hyper,
+        comm_dtype=jnp.bfloat16 if args.bf16_comm else None,
+        donate_buffers=args.donate, **hyper,
     )
     print(f"config={args.config} devices={jax.device_count()} "
           f"world={opt.size} codec={args.codec or 'identity'}")

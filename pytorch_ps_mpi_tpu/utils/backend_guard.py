@@ -48,9 +48,12 @@ def ensure_live_backend(
     # already pinned to the host platform (e.g. the test conftest) —
     # there is no accelerator to probe, and a probe subprocess would try
     # the axon plugin anyway (it ignores the JAX_PLATFORMS env var) and
-    # hang the caller for the full timeout
-    pinned = jax.config.jax_platforms
-    if pinned and "cpu" in str(pinned):
+    # hang the caller for the full timeout. Only the PRIMARY platform
+    # counts: this environment's ambient value is 'axon,cpu' (cpu as the
+    # fallback entry), and a substring test silently skipped the probe
+    # AND the pin — callers then hung on the dead tunnel's first op.
+    pinned = str(jax.config.jax_platforms or "")
+    if pinned.split(",")[0].strip() == "cpu":
         return False
 
     if timeout is None:
