@@ -31,11 +31,14 @@ target (kept > target → raise the bar, and vice versa).
 
 Performance note (measured on TPU v5 lite, ``benchmarks/codec_bench.py``):
 the ``nonzero(size=cap)`` compaction lowers to an n-sized scatter, which
-TPUs execute serially — 67 ms at 8M elems, 1.6 s at 132M, orders slower
-than the dense codecs (sign 0.67 ms, int8 0.24 ms at 8M). Use it where
-raggedness itself is the point (the protocol stress test, DCN wires with
-real byte budgets); for on-chip compression at scale prefer
-``topk-approx`` (3.4 ms at 8M) or ``sign``/``terngrad``.
+TPUs execute serially — 67-72 ms at 8M elems, 1.6 s at 132M, orders
+slower than the dense codecs (sign/int8 at ~1 ms or below at 8M). The
+default TPU path therefore compacts with one ``lax.sort`` instead
+(``compaction='sort'``: bitonic, vectorized; see ``__init__``), keeping
+the scatter path for CPUs where it wins. Even so, for on-chip
+compression where raggedness is NOT the point, prefer ``topk-approx``
+or ``sign``/``terngrad``; use this codec where the ragged protocol
+itself is (DCN wires with real byte budgets).
 """
 
 from __future__ import annotations

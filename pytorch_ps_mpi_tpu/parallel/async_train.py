@@ -171,6 +171,24 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     return pushed
 
 
+def _restore_ps_checkpoint(ckpt, params, state, checkpoint_every: int):
+    """Restore the latest PS snapshot; returns (params, opt_state,
+    applied_total, resumed_version). The resumed version is jumped past
+    anything a surviving worker could have read in the crash window (the
+    SAVED run's cadence bounds it — see serve's docstring); the restored
+    step is marked already-saved so it is never re-saved (Orbax raises
+    StepAlreadyExistsError). Shared by the single-server serve loop and
+    the sharded shard-server loop."""
+    template = {"params": params, "opt_state": state,
+                "version": 0, "applied_total": 0, "checkpoint_every": 0}
+    restored = ckpt.restore(template)
+    applied_before = int(restored["applied_total"])
+    ckpt._last_ps_step = applied_before
+    jump = max(int(restored["checkpoint_every"]), int(checkpoint_every), 0)
+    version = int(restored["version"]) + jump + 1
+    return restored["params"], restored["opt_state"], applied_before, version
+
+
 def _save_ps_checkpoint(ckpt, params, state, server, applied_total: int,
                         checkpoint_every: int) -> None:
     if getattr(ckpt, "_last_ps_step", None) == applied_total:
@@ -244,28 +262,9 @@ def serve(
 
         ckpt = CheckpointManager(checkpoint_dir)
         if resume:
-            template = {"params": params, "opt_state": state,
-                        "version": 0, "applied_total": 0,
-                        "checkpoint_every": 0}
-            restored = ckpt.restore(template)
-            params = restored["params"]
-            state = restored["opt_state"]
-            applied_before = int(restored["applied_total"])
-            # the restored step already exists on disk — never re-save it
-            # (Orbax raises StepAlreadyExistsError; the numpy fallback
-            # would silently overwrite)
-            ckpt._last_ps_step = applied_before
-            # publish version stays monotonic across the restart so
-            # staleness accounting of in-flight worker reads is sane.
-            # A REAL crash can have published up to the CRASHED run's
-            # checkpoint_every versions past the snapshot (no final
-            # save), so surviving workers may hold versions the snapshot
-            # never saw — jump the counter past anything they could have
-            # read, by the saved cadence (not this run's, which the
-            # operator may have shrunk)
-            jump = max(int(restored["checkpoint_every"]),
-                       int(checkpoint_every), 0)
-            server.version = int(restored["version"]) + jump + 1
+            params, state, applied_before, server.version = (
+                _restore_ps_checkpoint(ckpt, params, state, checkpoint_every)
+            )
 
     loss0 = float(eval_loss(params, eval_batch))
     server.publish(params)

@@ -75,6 +75,14 @@ def server_main(shard_id: int, n_shards: int, port: int,
     ``cfg["server_slow_ms"][str(shard_id)]`` injects a per-update sleep —
     a deliberately slow SHARD for tests to force per-shard version
     divergence (the asynchrony axis single-server PS doesn't have).
+
+    Failure story matches the single-server loop: with
+    ``cfg["checkpoint_dir"]`` set, each shard snapshots ITS OWN slice +
+    optimizer state under ``<dir>/shard<i>`` every
+    ``cfg["checkpoint_every"]`` applied updates; ``cfg["resume"]``
+    restores it with the same crash-window version jump — shards recover
+    INDEPENDENTLY (a replacement for shard 1 does not touch shard 0,
+    the horizontal-recovery property Li et al.'s design calls out).
     """
     import jax
 
@@ -110,10 +118,33 @@ def server_main(shard_id: int, n_shards: int, port: int,
     server = TcpPSServer(port, num_workers=n_workers, template=template,
                          max_staleness=int(cfg.get("max_staleness", 4)),
                          code=code)
+
+    ckpt = None
+    applied_before = 0
+    checkpoint_every = int(cfg.get("checkpoint_every", 50))
+    if cfg.get("resume") and not cfg.get("checkpoint_dir"):
+        raise ValueError("cfg['resume'] requires cfg['checkpoint_dir']")
+    if cfg.get("checkpoint_dir"):
+        from pytorch_ps_mpi_tpu.parallel.async_train import (
+            _restore_ps_checkpoint,
+            _save_ps_checkpoint,
+        )
+        from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(
+            os.path.join(cfg["checkpoint_dir"], f"shard{shard_id}")
+        )
+        if cfg.get("resume"):
+            params, state, applied_before, server.version = (
+                _restore_ps_checkpoint(ckpt, params, state, checkpoint_every)
+            )
+
     # the coordinator reads the auto-assigned port from this line
     print(json.dumps({"shard": shard_id, "port": server.port}), flush=True)
     try:
         server.publish(params)
+        applied = 0
+        last_saved = applied_before
         deadline = time.time() + float(cfg.get("server_timeout", 300.0))
         while server.grads_received < expected and time.time() < deadline:
             item = server.poll_grad()
@@ -122,9 +153,20 @@ def server_main(shard_id: int, n_shards: int, port: int,
                 continue
             _, _, grad = item
             params, state = update(params, grad, state)
+            applied += 1
             if slow_ms:
                 time.sleep(slow_ms / 1e3)
             server.publish(jax.tree.map(np.asarray, params))
+            if (ckpt and checkpoint_every
+                    and applied_before + applied - last_saved
+                    >= checkpoint_every):
+                _save_ps_checkpoint(ckpt, params, state, server,
+                                    applied_before + applied,
+                                    checkpoint_every)
+                last_saved = applied_before + applied
+        if ckpt:
+            _save_ps_checkpoint(ckpt, params, state, server,
+                                applied_before + applied, checkpoint_every)
         m = server.metrics()
         np.savez(
             out_path,
@@ -132,6 +174,7 @@ def server_main(shard_id: int, n_shards: int, port: int,
             start=start,
             stop=stop,
             version=server.version,
+            applied_total=applied_before + applied,
             grads_received=m["grads_received"],
             stale_drops=m["stale_drops"],
             compression_ratio=m["compression_ratio"],

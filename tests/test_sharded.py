@@ -82,6 +82,78 @@ def test_sharded_slice_updates_equal_whole_vector_updates():
         )
 
 
+def test_sharded_checkpoint_resume_continues_independently(tmp_path):
+    """Each shard server checkpoints and recovers ITS OWN slice: after a
+    full-fleet 'crash', replacement shard servers resume from their
+    snapshots and training continues — applied counts accumulate per
+    shard and the reassembled model keeps improving from exactly where
+    phase 1 ended."""
+    import jax
+
+    from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+
+    n_shards, n_workers, steps = 2, 2, 25
+    base = {
+        "model": "mlp",
+        "model_kw": {"features": (32, 4)},
+        "in_shape": (8,),
+        "batch": 64,
+        "seed": 13,
+        "optim": "sgd",
+        "hyper": {"lr": 0.02, "momentum": 0.9},
+        "n_workers": n_workers,
+        "steps": steps,
+        "max_staleness": 10**9,
+        "server_timeout": 240.0,
+        "checkpoint_dir": str(tmp_path / "ckpt"),
+        "checkpoint_every": 10,
+    }
+    _, params0, batch_fn, loss_fn = make_problem(base)
+
+    def phase(resume: bool, tag: str):
+        cfg = dict(base)
+        cfg["resume"] = resume
+        servers, paths = [], []
+        for s in range(n_shards):
+            out = str(tmp_path / f"{tag}_shard{s}.npz")
+            paths.append(out)
+            servers.append(spawn_shard_server(s, n_shards, cfg, out))
+        workers = []
+        try:
+            ports = [read_server_port(p) for p in servers]
+            addrs = [f"127.0.0.1:{p}" for p in ports]
+            workers = [
+                spawn_sharded_worker(addrs, w, cfg,
+                                     str(tmp_path / f"{tag}_w{w}.json"))
+                for w in range(n_workers)
+            ]
+            for p in workers:
+                assert p.wait(timeout=240) == 0
+            for p in servers:
+                assert p.wait(timeout=240) == 0
+        finally:
+            for p in servers + workers:
+                if p.poll() is None:
+                    p.kill()
+        return paths
+
+    eval_batch = batch_fn(10**6, 10**6)
+    paths1 = phase(resume=False, tag="p1")
+    for path in paths1:
+        z = np.load(path, allow_pickle=False)
+        assert int(z["applied_total"]) == n_workers * steps
+    loss1 = float(loss_fn(assemble(paths1, params0), eval_batch))
+    assert loss1 < float(loss_fn(params0, eval_batch))
+
+    # the whole server fleet 'crashes'; replacements resume per shard
+    paths2 = phase(resume=True, tag="p2")
+    for path in paths2:
+        z = np.load(path, allow_pickle=False)
+        assert int(z["applied_total"]) == 2 * n_workers * steps
+    loss2 = float(loss_fn(assemble(paths2, params0), eval_batch))
+    assert loss2 < loss1, (loss1, loss2)
+
+
 def test_sharded_ps_converges_with_per_shard_versions(tmp_path):
     """2 shard-server processes x 3 worker processes, sign-codec wire,
     one deliberately SLOW shard: training converges, every push is
