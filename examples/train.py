@@ -136,7 +136,7 @@ def main(argv=None):
                          "device update; ~one params+state copy less HBM)")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize transformer layers in backward "
-                         "(BERT/GPT/Switch configs)")
+                         "(bert_mlm / gpt_lm configs)")
     ap.add_argument("--scan-chunk", type=int, default=1,
                     help=">1 fuses N steps per XLA program")
     ap.add_argument("--checkpoint-dir", default=None)

@@ -112,11 +112,14 @@ class ThresholdCodec(Codec):
         # static-size compaction: indices of the first `cap` survivors in
         # index order; slots past min(kept, cap) hold garbage by design
         # (see module doc) — decode masks them by `length` either way.
-        if self.compaction == "sort":
+        if self.compaction == "sort" and 2 * n < 2**31:
             # survivors keep their index as the sort key, non-survivors
-            # get index+n: one ascending argsort puts survivor indices
+            # get index+n: one ascending sort puts survivor indices
             # first IN INDEX ORDER. The sort is bitonic — vectorized on
-            # TPU, unlike nonzero's serial n-sized scatter.
+            # TPU, unlike nonzero's serial n-sized scatter. The 2n < 2^31
+            # guard keeps the biased keys inside int32 (beyond it, pos+n
+            # would wrap negative and sort garbage BEFORE survivors —
+            # silently wrong decode); such tensors take the scatter path.
             pos = jnp.arange(n, dtype=jnp.int32)
             keys = jnp.where(mask, pos, pos + n)
             idx = jax.lax.sort(keys)[:cap]

@@ -189,23 +189,45 @@ def _restore_ps_checkpoint(ckpt, params, state, checkpoint_every: int):
     return restored["params"], restored["opt_state"], applied_before, version
 
 
-def _save_ps_checkpoint(ckpt, params, state, server, applied_total: int,
-                        checkpoint_every: int) -> None:
-    if getattr(ckpt, "_last_ps_step", None) == applied_total:
-        return  # final save coinciding with a periodic one
-    import jax
+class _PSCheckpointCadence:
+    """The save half of PS checkpointing, shared by the single-server
+    serve loop and the sharded shard-server loop so the crash-window
+    guarantees can never diverge between them: save when the APPLIED
+    COUNT has advanced by ``checkpoint_every`` since the last save (not
+    on divisibility — sync_barrier mode advances ``applied`` by
+    n_workers per round and would hit an exact multiple only every lcm),
+    plus one unconditional final save at loop exit."""
 
-    ckpt.save(applied_total, {
-        "params": jax.tree.map(np.asarray, params),
-        "opt_state": jax.tree.map(np.asarray, state),
-        "version": server.version,
-        "applied_total": applied_total,
-        # the SAVING run's cadence bounds how far past this snapshot the
-        # server can have published before a crash — the resume jump
-        # must use it, not the restarting run's (possibly smaller) one
-        "checkpoint_every": int(checkpoint_every),
-    })
-    ckpt._last_ps_step = applied_total
+    def __init__(self, ckpt, checkpoint_every: int, applied_before: int):
+        self.ckpt = ckpt
+        self.every = int(checkpoint_every)
+        self.last_saved = int(applied_before)
+
+    def _save(self, params, state, server, applied_total: int) -> None:
+        if getattr(self.ckpt, "_last_ps_step", None) == applied_total:
+            return  # final save coinciding with a periodic one
+        import jax
+
+        self.ckpt.save(applied_total, {
+            "params": jax.tree.map(np.asarray, params),
+            "opt_state": jax.tree.map(np.asarray, state),
+            "version": server.version,
+            "applied_total": applied_total,
+            # the SAVING run's cadence bounds how far past this snapshot
+            # the server can have published before a crash — the resume
+            # jump must use it, not the restarting run's (possibly
+            # smaller) one
+            "checkpoint_every": self.every,
+        })
+        self.ckpt._last_ps_step = applied_total
+
+    def maybe_save(self, params, state, server, applied_total: int) -> None:
+        if self.every and applied_total - self.last_saved >= self.every:
+            self._save(params, state, server, applied_total)
+            self.last_saved = applied_total
+
+    def final_save(self, params, state, server, applied_total: int) -> None:
+        self._save(params, state, server, applied_total)
 
 
 def serve(
@@ -269,7 +291,8 @@ def serve(
     loss0 = float(eval_loss(params, eval_batch))
     server.publish(params)
     applied = 0
-    last_saved = applied_before
+    cadence = (_PSCheckpointCadence(ckpt, checkpoint_every, applied_before)
+               if ckpt else None)
     n_workers = server.num_workers
     # sync_barrier holds a FIFO per worker: the server pops mailboxes
     # eagerly (the single-slot mailbox never back-pressures a fast
@@ -308,19 +331,11 @@ def serve(
             params, state = update(params, grad, state)
             applied += 1
         server.publish(jax.tree.map(np.asarray, params))
-        if (ckpt and checkpoint_every
-                and applied_before + applied - last_saved >= checkpoint_every):
-            # cadence by APPLIED COUNT, not divisibility: sync_barrier
-            # mode advances `applied` by n_workers per round and would
-            # hit an exact multiple only every lcm — losing up to
-            # n_workers x checkpoint_every of progress on a crash
-            _save_ps_checkpoint(ckpt, params, state, server,
-                                applied_before + applied, checkpoint_every)
-            last_saved = applied_before + applied
+        if cadence:
+            cadence.maybe_save(params, state, server, applied_before + applied)
     wall = time.perf_counter() - t0
-    if ckpt:  # final state always captured, whatever the stop reason
-        _save_ps_checkpoint(ckpt, params, state, server,
-                            applied_before + applied, checkpoint_every)
+    if cadence:  # final state always captured, whatever the stop reason
+        cadence.final_save(params, state, server, applied_before + applied)
     m = dict(server.metrics())
     m.update(
         applied=float(applied),

@@ -127,7 +127,6 @@ def server_main(shard_id: int, n_shards: int, port: int,
     if cfg.get("checkpoint_dir"):
         from pytorch_ps_mpi_tpu.parallel.async_train import (
             _restore_ps_checkpoint,
-            _save_ps_checkpoint,
         )
         from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
 
@@ -144,7 +143,14 @@ def server_main(shard_id: int, n_shards: int, port: int,
     try:
         server.publish(params)
         applied = 0
-        last_saved = applied_before
+        cadence = None
+        if ckpt:
+            from pytorch_ps_mpi_tpu.parallel.async_train import (
+                _PSCheckpointCadence,
+            )
+
+            cadence = _PSCheckpointCadence(ckpt, checkpoint_every,
+                                           applied_before)
         deadline = time.time() + float(cfg.get("server_timeout", 300.0))
         while server.grads_received < expected and time.time() < deadline:
             item = server.poll_grad()
@@ -157,16 +163,12 @@ def server_main(shard_id: int, n_shards: int, port: int,
             if slow_ms:
                 time.sleep(slow_ms / 1e3)
             server.publish(jax.tree.map(np.asarray, params))
-            if (ckpt and checkpoint_every
-                    and applied_before + applied - last_saved
-                    >= checkpoint_every):
-                _save_ps_checkpoint(ckpt, params, state, server,
-                                    applied_before + applied,
-                                    checkpoint_every)
-                last_saved = applied_before + applied
-        if ckpt:
-            _save_ps_checkpoint(ckpt, params, state, server,
-                                applied_before + applied, checkpoint_every)
+            if cadence:
+                cadence.maybe_save(params, state, server,
+                                   applied_before + applied)
+        if cadence:
+            cadence.final_save(params, state, server,
+                               applied_before + applied)
         m = server.metrics()
         np.savez(
             out_path,
