@@ -419,6 +419,84 @@ def main():
             baseline="same model with f32 compute (line 2) on this device",
         )
 
+        # Line 4 (accelerator only): BASELINE config #5 — BERT-base MLM
+        # (132M params, Adam), bf16 compute: the large-flat-gradient
+        # stress configuration, and this framework's best MFU. Skipped on
+        # the CPU fallback (a 132M fwd+bwd on one host core would take
+        # minutes per rep for no information).
+        bert_line(live)
+
+
+def bert_line(live: bool, batch: int = 16, seq: int = 128,
+              scan_k: int = 8) -> None:
+    from pytorch_ps_mpi_tpu.models import BertConfig, BertMLM
+    from pytorch_ps_mpi_tpu.models.bert import mlm_loss
+    from pytorch_ps_mpi_tpu.optim import AdamHyper, adam_update, init_adam_state
+
+    cfg = BertConfig(dtype=jnp.bfloat16, max_position=max(512, seq))
+    model = BertMLM(cfg)
+    h = AdamHyper(lr=1e-4)
+
+    def loss_fn(params, b):
+        tokens, targets, mask = b
+        return mlm_loss(model.apply(params, tokens), targets, mask)
+
+    def train_step(params, state, b):
+        loss, grads = jax.value_and_grad(loss_fn)(params, b)
+        p2, s2 = adam_update(params, grads, state, h)
+        return p2, s2, loss
+
+    key = jax.random.key(1)
+    b = (
+        jax.random.randint(key, (batch, seq), 0, cfg.vocab_size),
+        jax.random.randint(jax.random.fold_in(key, 1), (batch, seq), 0,
+                           cfg.vocab_size),
+        jax.random.bernoulli(jax.random.fold_in(key, 2), 0.15, (batch, seq)),
+    )
+    params = jax.jit(model.init)(jax.random.key(0), b[0][:1])
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    state = init_adam_state(params)
+    fn = jax.jit(train_step)
+    flops = 0.0
+    try:
+        cost = fn.lower(params, state, b).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+    except Exception:
+        pass
+
+    @jax.jit
+    def scanned(params, state, b):
+        def body(c, _):
+            p, s, _ = train_step(c[0], c[1], b)
+            return (p, s), None
+
+        (p, s), _ = jax.lax.scan(body, (params, state), None, length=scan_k)
+        return p, s
+
+    wall_s, dev_s = timed(
+        lambda: fn(params, state, b),
+        lambda: scanned(params, state, b),
+        scan_k, reps=5,
+    )
+    peak = peak_flops_for(device_kind())
+    emit(
+        f"bert_base_{n_params//10**6}M_mlm_train_step_b{batch}_s{seq}"
+        "_bf16_steps_per_sec",
+        safe_ratio(1.0, dev_s),
+        "steps/sec",
+        round(safe_ratio(flops, dev_s * peak), 4) if peak else 0.0,
+        live,
+        step_ms_device=round(dev_s * 1e3, 3),
+        wall_ms_per_call=round(wall_s * 1e3, 3),
+        flops_per_step=flops,
+        mfu=round(safe_ratio(flops, dev_s * peak), 4) if peak else 0.0,
+        baseline="vs_baseline = MFU vs the chip's published bf16 peak "
+                 "(BASELINE config #5, the large-flat-gradient stress "
+                 "model; full codec wire table in benchmarks/bert_bench.py)",
+    )
+
 
 if __name__ == "__main__":
     main()
