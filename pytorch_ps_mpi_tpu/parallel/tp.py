@@ -75,20 +75,29 @@ def tp_self_attention(
     *,
     seq_axis: Optional[str] = None,
     causal: bool = False,
+    sp: str = "ring",
 ):
     """Self-attention with heads split over ``tp_axis``: the QKV
     projection is column-parallel (each worker computes its local heads),
-    attention runs on local heads (ring attention over ``seq_axis`` when
-    given — SP x TP composition), and the output projection is
-    row-parallel. One psum total.
+    attention runs on local heads (sequence-parallel over ``seq_axis``
+    when given — SP x TP composition, ``sp`` selecting ring or ulysses),
+    and the output projection is row-parallel. One psum total.
 
     ``params`` (host-side): ``wqkv [tp, d, 3, h/tp, hd]``,
-    ``wo [tp, (h/tp)*hd, d]``, ``bo [d]``.
+    ``wo [tp, (h/tp)*hd, d]``, ``bo [d]``. With ``sp='ulysses'`` the
+    LOCAL head count (h/tp) must divide by the seq-axis size — the two
+    parallelism axes both slice heads in that composition.
     """
+    if sp not in ("ring", "ulysses"):
+        raise ValueError(f"sp must be 'ring' or 'ulysses', got {sp!r}")
     wqkv = _sq(params["wqkv"])                     # [d, 3, h_loc, hd]
     qkv = jnp.einsum("bld,dche->blche", x, wqkv)   # [b, l, 3, h_loc, hd]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    if seq_axis is not None:
+    if seq_axis is not None and sp == "ulysses":
+        from pytorch_ps_mpi_tpu.parallel.ulysses import ulysses_attention
+
+        out = ulysses_attention(q, k, v, seq_axis, causal=causal)
+    elif seq_axis is not None:
         from pytorch_ps_mpi_tpu.parallel.ring import ring_attention
 
         out = ring_attention(q, k, v, seq_axis, causal=causal)

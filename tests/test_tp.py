@@ -148,6 +148,47 @@ def test_dp_tp_train_step_matches_single_device(mesh_dp_tp):
                                rtol=1e-4, atol=1e-6)
 
 
+def test_tp_attention_composes_with_ulysses(mesh_dp_tp):
+    """SP x TP with the all-to-all SP design: heads split over 'model'
+    (TP) AND the local heads re-split over the sequence axis by the
+    Ulysses exchange — both slicings at once, vs dense full attention.
+    heads=16, tp=4 -> 4 local heads; seq axis size 2 divides them."""
+    d, heads = 32, 16
+    params = tp.init_tp_attention(jax.random.key(0), d, heads, tp=4)
+    seq = 8
+    x = jax.random.normal(jax.random.key(1), (2, seq, d))
+
+    def spmd(p, xs):
+        return tp.tp_self_attention(
+            xs, p, "model", seq_axis="data", causal=False, sp="ulysses"
+        )
+
+    spec = tp.tp_param_spec(params, "model")
+    fn = jax.jit(
+        jax.shard_map(
+            spmd,
+            mesh=mesh_dp_tp,
+            in_specs=(spec, P(None, "data")),
+            out_specs=P(None, "data"),
+            check_vma=False,
+        )
+    )
+    out = fn(params, x)
+
+    expected = _dense_attention_oracle(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_tp_attention_sp_mode_validated():
+    import pytest
+
+    with pytest.raises(ValueError, match="sp must be"):
+        tp.tp_self_attention(
+            jnp.zeros((1, 4, 8)), {}, "model", seq_axis="data", sp="bogus"
+        )
+
+
 def test_tp_attention_composes_with_ring(mesh_dp_tp):
     """SP x TP: ring attention over 'data'-as-seq is covered elsewhere;
     here heads split over 'model' while the sequence is sharded over
