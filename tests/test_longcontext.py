@@ -1,0 +1,111 @@
+"""Long-context path at real length: ring-attention GPT with per-layer
+remat at seq 2048 over 8 sequence shards — the configuration the
+long-context design exists for (each device holds 256 tokens; ring hops
+exchange K/V blocks; remat keeps activation memory O(1) layers), checked
+against the dense causal oracle and trained for a step.
+
+The unit tests elsewhere prove the pieces at seq 32; this proves the
+composition does not fall apart at three orders of magnitude more
+positions than the reference ever ran (its MNIST-era models had no
+sequence axis at all)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_ps_mpi_tpu.mesh import make_mesh
+from pytorch_ps_mpi_tpu.models import GPTLM, causal_lm_loss, gpt_tiny
+
+SEQ = 2048
+SHARDS = 8
+
+
+def _cfgs():
+    kw = dict(vocab_size=256, hidden_size=32, num_layers=2, num_heads=4,
+              intermediate_size=64, max_position=SEQ)
+    return (gpt_tiny(**kw),
+            gpt_tiny(attention="ring", remat=True, **kw))
+
+
+def test_ring_remat_gpt_matches_dense_at_seq2048():
+    cfg_full, cfg_ring = _cfgs()
+    tokens = jax.random.randint(jax.random.key(1), (1, SEQ), 0,
+                                cfg_full.vocab_size)
+    params = GPTLM(cfg_full).init(jax.random.key(0), tokens)
+    ref = GPTLM(cfg_full).apply(params, tokens)
+
+    mesh = make_mesh(axis_names=("seq",))
+    l_local = SEQ // SHARDS
+
+    def spmd(params, tokens):
+        from jax import lax
+
+        offset = lax.axis_index("seq") * l_local
+        return GPTLM(cfg_ring).apply(params, tokens, position_offset=offset)
+
+    out = jax.jit(
+        jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_ring_remat_gpt_trains_at_seq2048():
+    """One full distributed training step (grads through the ring hops
+    AND the remat rewind) at seq 2048: finite loss, finite nonzero
+    gradients, parameters actually move."""
+    _, cfg_ring = _cfgs()
+    tokens = jax.random.randint(jax.random.key(1), (1, SEQ), 0,
+                                cfg_ring.vocab_size)
+    # init with the full-attention twin (ring needs the bound axis)
+    cfg_full, _ = _cfgs()
+    params = GPTLM(cfg_full).init(jax.random.key(0), tokens)
+
+    mesh = make_mesh(axis_names=("seq",))
+    l_local = SEQ // SHARDS
+
+    def local_loss(params, tokens):
+        from jax import lax
+
+        offset = lax.axis_index("seq") * l_local
+        logits = GPTLM(cfg_ring).apply(params, tokens,
+                                       position_offset=offset)
+        # local shard's next-token loss (shard boundaries drop one
+        # target each — fine for a smoke)
+        return causal_lm_loss(logits, tokens)
+
+    def step(params, tokens):
+        from jax import lax
+
+        # the unambiguous SPMD pattern (parallel/dp.py): differentiate
+        # the LOCAL loss, aggregate grads explicitly
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens)
+        grads = jax.tree.map(lambda g: lax.pmean(g, "seq"), grads)
+        loss = lax.pmean(loss, "seq")
+        new_params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+        return loss, grads, new_params
+
+    loss, grads, new_params = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(P(), P(None, "seq")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )(params, tokens)
+    assert np.isfinite(float(loss))
+    gnorm = float(
+        jnp.sqrt(sum(jnp.sum(g ** 2) for g in jax.tree.leaves(grads)))
+    )
+    assert np.isfinite(gnorm) and gnorm > 0
+    moved = jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), params, new_params
+    )
+    assert max(jax.tree.leaves(moved)) > 0
