@@ -162,6 +162,32 @@ def test_schedule_with_codec_and_donation(mesh8):
     )
 
 
+def test_schedule_boundary_crossed_inside_fused_scan(mesh8):
+    """The reason schedules live in-program: run_steps fuses N steps into
+    ONE XLA program with the host out of the loop, and the schedule must
+    still change the rate at the right step INSIDE the scan. A step_decay
+    boundary at step 2 with unit gradients makes the per-step deltas read
+    the applied lr off the parameter trajectory."""
+    from pytorch_ps_mpi_tpu.optim import step_decay
+
+    sched = step_decay(base=0.1, boundaries=(2,), scale=0.1)
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.mean(batch @ p["w"])  # unit grad per element
+
+    opt = SGD(params, mesh=mesh8, lr=sched, average=True)
+    batches = jnp.ones((4, 8, 4), jnp.float32)  # 4 steps, one program
+    losses, data = opt.run_steps(loss_fn, batches)
+    assert data["n_steps"] == 4.0
+    # w after: -(0.1 + 0.1 + 0.01 + 0.01)
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]),
+        np.full(4, -(0.1 + 0.1 + 0.01 + 0.01), np.float32),
+        rtol=1e-5,
+    )
+
+
 def test_mpi_ps_trains_with_schedule(mesh8):
     """End-to-end: the fused distributed step accepts a schedule and the
     applied lr follows it. Unit-gradient loss makes the per-step delta
