@@ -12,6 +12,7 @@ outruns any host CPU compressor — SURVEY §2.4).
 
 from pytorch_ps_mpi_tpu.codecs.base import Codec, get_codec, register_codec
 from pytorch_ps_mpi_tpu.codecs.identity import IdentityCodec
+from pytorch_ps_mpi_tpu.codecs.cast import Bf16Codec, F16Codec
 from pytorch_ps_mpi_tpu.codecs.topk import TopKCodec
 from pytorch_ps_mpi_tpu.codecs.threshold import ThresholdCodec
 from pytorch_ps_mpi_tpu.codecs.randomk import RandomKCodec
@@ -26,6 +27,8 @@ __all__ = [
     "get_codec",
     "register_codec",
     "IdentityCodec",
+    "Bf16Codec",
+    "F16Codec",
     "TopKCodec",
     "ThresholdCodec",
     "RandomKCodec",

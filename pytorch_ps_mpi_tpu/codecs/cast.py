@@ -1,0 +1,53 @@
+"""Cast codec: gradients ride the wire in a narrower float dtype.
+
+The cheapest compression there is — one cast each way, 2x fewer wire
+bytes with bf16 (the TPU's native matmul width, so the information loss
+matches what the MXU already computes in) — and the natural DEFAULT for
+DCN wires where bandwidth is the bottleneck but sparsification is
+unwanted. Complements ``MPI_PS(comm_dtype=...)``, which narrows the
+in-XLA collective: this narrows the HOST wire of the async PS paths
+(``CodecWire`` payload bytes over shm/TCP/sharded), where the reference
+shipped full pickled float64/float32 buffers (``mpi_comms.py:74``).
+
+``supports_psum`` holds: summing bf16 payloads then casting up is the
+psum lowering's semantics (accumulation in f32 per XLA's psum on bf16
+inputs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+
+
+@register_codec("bf16")
+class Bf16Codec(Codec):
+    supports_psum = True
+
+    wire_dtype = jnp.bfloat16
+
+    def encode(self, grad, state=(), rng=None):
+        return grad.astype(self.wire_dtype), state
+
+    def decode(self, payload, shape, dtype):
+        return payload.astype(dtype).reshape(shape)
+
+    def decode_sum(self, payloads, shape, dtype):
+        # cast up BEFORE the sum: world-many bf16 addends would lose
+        # low bits pairwise; f32 accumulation matches psum's behavior
+        return payloads.astype(dtype).sum(axis=0).reshape(shape)
+
+    def payload_bits(self, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        return n * jnp.dtype(self.wire_dtype).itemsize * 8
+
+
+@register_codec("f16")
+class F16Codec(Bf16Codec):
+    """IEEE half: more mantissa, less range than bf16 — for wires whose
+    consumers prefer fp16 (e.g. non-TPU peers on the DCN)."""
+
+    wire_dtype = jnp.float16
