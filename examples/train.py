@@ -125,7 +125,7 @@ def main(argv=None):
     ap.add_argument("--momentum", type=float, default=0.9)
     ap.add_argument("--mode", choices=["allgather", "leader"], default="allgather")
     ap.add_argument("--codec", default=None,
-                    help="identity|topk|randomk|int8|qsgd|sign|terngrad|"
+                    help="identity|bf16|f16|topk|randomk|int8|qsgd|sign|terngrad|"
                          "powersgd|threshold|ef")
     ap.add_argument("--codec-arg", action="append", default=[],
                     help="k=v passed to the codec (repeatable)")
