@@ -38,6 +38,7 @@ from pytorch_ps_mpi_tpu.models import MLP
 CODECS = [  # (label, name, kwargs, lr) — lr tuned per codec family:
     # sign-style steps are magnitude-free and need a cooler rate
     ("identity", "identity", {}, 0.1),
+    ("bf16", "bf16", {}, 0.1),
     ("int8", "int8", {}, 0.1),
     ("qsgd16", "qsgd", {"levels": 16}, 0.1),
     ("terngrad", "terngrad", {}, 0.05),
