@@ -22,6 +22,7 @@ from pytorch_ps_mpi_tpu.utils.backend_guard import ensure_live_backend
 
 CODECS = [  # (label, registry name, kwargs)
     ("identity", "identity", {}),
+    ("bf16", "bf16", {}),
     ("int8", "int8", {}),
     ("qsgd", "qsgd", {"levels": 16}),
     ("sign", "sign", {}),
