@@ -41,19 +41,26 @@ from pytorch_ps_mpi_tpu.utils.backend_guard import enable_compilation_cache
 enable_compilation_cache()
 
 
-def run(cfg, n_workers: int, sync_barrier: bool, total: int):
+def run(cfg, n_workers: int, sync_barrier: bool, total: int, code=None,
+        max_staleness: int = 10**9):
+    """One complete async job: server (shm or tcp per ``cfg['transport']``)
+    + spawned jitted workers + serve loop + cleanup. The ONE server-
+    lifecycle harness every protocol bench uses (transport_bench imports
+    it) — fixes to worker-exit handling or cleanup land everywhere."""
     _, params0, _, _ = make_problem(cfg)
     if cfg.get("transport") == "tcp":
         from pytorch_ps_mpi_tpu.parallel import tcp
 
         server = tcp.TcpPSServer(
-            0, num_workers=n_workers, template=params0, max_staleness=10**9,
+            0, num_workers=n_workers, template=params0,
+            max_staleness=max_staleness, code=code,
         )
         name = f"127.0.0.1:{server.port}"
     else:
         name = f"/psq_bench_{os.getpid()}_{int(sync_barrier)}"
         server = dcn.ShmPSServer(
-            name, num_workers=n_workers, template=params0, max_staleness=10**9,
+            name, num_workers=n_workers, template=params0,
+            max_staleness=max_staleness, code=code,
         )
     try:
         procs = [spawn_worker(name, i, cfg) for i in range(n_workers)]

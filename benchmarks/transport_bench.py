@@ -29,12 +29,7 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")  # protocol bench: host only
 
-from pytorch_ps_mpi_tpu.parallel import dcn, tcp
-from pytorch_ps_mpi_tpu.parallel.async_train import (
-    make_problem,
-    serve,
-    spawn_worker,
-)
+from async_bench import run as run_job  # the one server-lifecycle harness
 from pytorch_ps_mpi_tpu.utils.backend_guard import enable_compilation_cache
 from pytorch_ps_mpi_tpu.utils.devtime import safe_ratio
 
@@ -43,28 +38,11 @@ enable_compilation_cache()
 
 def run(transport: str, cfg, n_workers: int, total: int, code):
     cfg = dict(cfg)
-    _, params0, _, _ = make_problem(cfg)
     if transport == "tcp":
         cfg["transport"] = "tcp"
-        server = tcp.TcpPSServer(0, num_workers=n_workers, template=params0,
-                                 max_staleness=10**9, code=code)
-        name = f"127.0.0.1:{server.port}"
     else:
-        name = f"/psq_tbench_{os.getpid()}"
-        server = dcn.ShmPSServer(name, num_workers=n_workers,
-                                 template=params0, max_staleness=10**9,
-                                 code=code)
-    try:
-        procs = [spawn_worker(name, i, cfg) for i in range(n_workers)]
-        _, m = serve(server, cfg, total_grads=0, total_received=total,
-                     timeout=1800.0)
-        for p in procs:
-            rc = p.wait(timeout=600)
-            if rc != 0:
-                raise RuntimeError(f"worker exited {rc}")
-    finally:
-        server.close()
-    return m
+        cfg.pop("transport", None)
+    return run_job(cfg, n_workers, sync_barrier=False, total=total, code=code)
 
 
 def main():

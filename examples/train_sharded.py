@@ -94,6 +94,26 @@ def main(argv=None):
     tmp = tempfile.mkdtemp(prefix="sharded_")
     servers, shard_paths, workers, worker_paths = [], [], [], []
     try:
+        return _run(args, cfg, tmp, servers, shard_paths, workers,
+                    worker_paths, params0, batch_fn, loss_fn)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)  # snapshots already read
+
+
+def _run(args, cfg, tmp, servers, shard_paths, workers, worker_paths,
+         params0, batch_fn, loss_fn):
+    import numpy as np
+
+    from pytorch_ps_mpi_tpu.parallel.sharded import (
+        assemble,
+        read_server_port,
+        spawn_shard_server,
+        spawn_sharded_worker,
+    )
+
+    try:
         for s in range(args.shards):
             out = f"{tmp}/shard{s}.npz"
             shard_paths.append(out)

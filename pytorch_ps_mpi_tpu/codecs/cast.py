@@ -9,9 +9,10 @@ in-XLA collective: this narrows the HOST wire of the async PS paths
 (``CodecWire`` payload bytes over shm/TCP/sharded), where the reference
 shipped full pickled float64/float32 buffers (``mpi_comms.py:74``).
 
-``supports_psum`` holds: summing bf16 payloads then casting up is the
-psum lowering's semantics (accumulation in f32 per XLA's psum on bf16
-inputs).
+``supports_psum`` holds via the codec's ``wire_dtype``: the fused psum
+path (``ps.aggregate``) narrows the collective to ``wire_dtype`` and
+casts back — the cast IS this codec's encode, so the fast path applies
+it to the wire rather than skipping it.
 """
 
 from __future__ import annotations

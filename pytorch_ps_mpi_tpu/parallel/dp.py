@@ -75,7 +75,8 @@ def make_sync_train_step(
         if mode == "leader":
             if code.supports_psum:
                 grad_shards = leader_scatter_shards(
-                    grads, axis_name, size, average=average
+                    grads, axis_name, size,
+                    getattr(code, "wire_dtype", None), average,
                 )
             else:
                 summed = aggregate(code, grads, payloads, axis_name, average, size)

@@ -262,11 +262,18 @@ def aggregate(
 
     ``comm_dtype`` (e.g. ``jnp.bfloat16``) narrows the psum path's wire
     dtype — halving ICI bytes, the cheap always-on compression every TPU
-    program should use — and casts back for the f32 update."""
+    program should use — and casts back for the f32 update. A psum-capable
+    codec that declares a ``wire_dtype`` (the bf16/f16 cast codecs) is
+    lowered the same way: the cast IS its encode, so the fused path must
+    narrow the collective or the codec would silently be an identity
+    no-op."""
     if code.supports_psum:
-        if comm_dtype is not None:
+        wire = comm_dtype if comm_dtype is not None else getattr(
+            code, "wire_dtype", None
+        )
+        if wire is not None:
             summed = jax.tree.map(
-                lambda g: lax.psum(g.astype(comm_dtype), axis_name).astype(g.dtype),
+                lambda g: lax.psum(g.astype(wire), axis_name).astype(g.dtype),
                 grads,
             )
         else:
@@ -431,8 +438,13 @@ class MPI_PS:
         replaced by per-leaf ``psum_scatter`` (each worker receives only
         its shard's sum), then shard-update + all_gather."""
         if self.mode == "leader" and self.code.supports_psum:
+            # a cast codec's wire_dtype narrows the scatter exactly as
+            # comm_dtype would (same rationale as aggregate())
+            wire = self.comm_dtype if self.comm_dtype is not None else (
+                getattr(self.code, "wire_dtype", None)
+            )
             grad_shards = leader_scatter_shards(
-                grads, self.axis_name, self.size, self.comm_dtype, self.average
+                grads, self.axis_name, self.size, wire, self.average
             )
             return leader_shard_update(
                 params, opt_state, grad_shards, self._update_fn, self.hyper,

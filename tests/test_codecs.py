@@ -415,6 +415,13 @@ def test_bf16_codec_through_distributed_step(mesh8):
         ),
         p_id, p_bf,
     )
+    # ...and the narrowing REALLY happened: bf16 rounding on the wire
+    # must leave a trace (bit-identical params would mean the fused path
+    # silently skipped the cast — the regression this guards against)
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(p_id), jax.tree.leaves(p_bf))
+    )
 
 
 def test_bf16_codec_halves_async_wire():
