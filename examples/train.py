@@ -123,6 +123,9 @@ def main(argv=None):
     ap.add_argument("--decay-boundaries", default="",
                     help="comma ints for step_decay, e.g. 100,200")
     ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--clip-norm", type=float, default=0.0,
+                    help="clip the aggregated gradient to this global "
+                         "L2 norm (0 = off)")
     ap.add_argument("--mode", choices=["allgather", "leader"], default="allgather")
     ap.add_argument("--codec", default=None,
                     help="identity|bf16|f16|topk|randomk|int8|qsgd|sign|terngrad|"
@@ -182,7 +185,7 @@ def main(argv=None):
         params, optim=args.optim, code=code, mode=args.mode,
         average=True, instrument=args.instrument,
         comm_dtype=jnp.bfloat16 if args.bf16_comm else None,
-        donate_buffers=args.donate, **hyper,
+        donate_buffers=args.donate, clip_norm=args.clip_norm, **hyper,
     )
     print(f"config={args.config} devices={jax.device_count()} "
           f"world={opt.size} codec={args.codec or 'identity'}")

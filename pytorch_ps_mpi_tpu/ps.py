@@ -142,6 +142,25 @@ def leader_slice_shards(summed: PyTree, axis_name: str, world: int) -> PyTree:
     )
 
 
+def clip_by_global_norm(grads: PyTree, clip_norm: float,
+                        axis_name: Optional[str] = None) -> PyTree:
+    """Scale ``grads`` so their global L2 norm is at most ``clip_norm``
+    (torch ``clip_grad_norm_`` semantics, applied to the AGGREGATED
+    gradient). With ``axis_name`` the leaves are device-local SHARDS of
+    the global gradient (the ZeRO-1 psum_scatter fast path) and the
+    norm is psum'd across the axis — shard-local norms would clip each
+    device differently and silently diverge from the dense path."""
+    sumsq = sum(
+        jnp.sum(jnp.square(g.astype(jnp.float32)))
+        for g in jax.tree.leaves(grads)
+    )
+    if axis_name is not None:
+        sumsq = lax.psum(sumsq, axis_name)
+    gnorm = jnp.sqrt(sumsq)
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+    return jax.tree.map(lambda g: (g * scale.astype(g.dtype)), grads)
+
+
 def leader_shard_update(
     params: PyTree, opt_state: LeaderState, grad_shards: PyTree,
     update_fn: Callable, hyper, axis_name: str,
@@ -314,6 +333,10 @@ class MPI_PS:
         with host-side timing to fill the full metrics schema; if False,
         one fused XLA program (fast path) and only end-to-end time.
       seed: base PRNG seed for stochastic codecs.
+      clip_norm: if > 0, clip the AGGREGATED gradient to this global L2
+        norm before the update (torch ``clip_grad_norm_`` semantics) —
+        in leader mode the norm is psum'd across shard sum-squares so
+        both topologies clip identically.
       donate_buffers: if True, the fused step donates the params /
         optimizer-state / codec-state buffers to XLA (in-place update on
         device: peak HBM drops by roughly one params+state copy — at
@@ -342,6 +365,7 @@ class MPI_PS:
         comm_dtype=None,
         seed: int = 0,
         donate_buffers: bool = False,
+        clip_norm: float = 0.0,
         **hyper,
     ):
         if optim not in OPTIMIZERS:
@@ -358,6 +382,7 @@ class MPI_PS:
         self.mode = mode
         self.average = average
         self.donate_buffers = donate_buffers
+        self.clip_norm = float(clip_norm)
         self.instrument = instrument
         self.comm_dtype = comm_dtype
         self.rank = jax.process_index()           # reference ps.py:71-72
@@ -421,6 +446,8 @@ class MPI_PS:
         )
 
     def _update(self, params, opt_state, summed):
+        if self.clip_norm:
+            summed = clip_by_global_norm(summed, self.clip_norm)
         if self.mode == "leader":
             # Every rank already holds the full summed gradient (non-psum
             # codec decode path, or the instrumented stages); slice out
@@ -446,6 +473,12 @@ class MPI_PS:
             grad_shards = leader_scatter_shards(
                 grads, self.axis_name, self.size, wire, self.average
             )
+            if self.clip_norm:
+                # shards partition the aggregated gradient: the global
+                # norm is the psum of shard sum-squares
+                grad_shards = clip_by_global_norm(
+                    grad_shards, self.clip_norm, self.axis_name
+                )
             return leader_shard_update(
                 params, opt_state, grad_shards, self._update_fn, self.hyper,
                 self.axis_name,

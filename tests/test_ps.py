@@ -430,3 +430,76 @@ def test_profile_step_accumulate(mesh8):
     assert np.isfinite(float(loss))
     assert data["comm_wait"] > 0.0
     assert data["profile_devices"] == 8
+
+
+def test_clip_norm_matches_manual_oracle(mesh8):
+    """clip_norm clips the AGGREGATED gradient (torch clip_grad_norm_
+    semantics): distributed step == local step on the manually clipped
+    summed gradient."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    clip = 0.5  # far below the actual norm: clipping is active
+    opt = SGD(params, mesh=mesh8, lr=0.05, clip_norm=clip)
+    opt.step(loss_fn=quad_loss, batch=batch)
+
+    grads = [
+        jax.grad(quad_loss)(params, (batch[0][i * 4:(i + 1) * 4],
+                                     batch[1][i * 4:(i + 1) * 4]))
+        for i in range(8)
+    ]
+    summed = jax.tree.map(lambda *g: sum(g), *grads)
+    gnorm = float(jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                               for g in jax.tree.leaves(summed))))
+    assert gnorm > clip  # the scenario is real
+    clipped = jax.tree.map(lambda g: g * (clip / gnorm), summed)
+    from pytorch_ps_mpi_tpu.optim import SGDHyper as _H
+
+    expected, _ = sgd_update(params, clipped, init_sgd_state(params),
+                             _H(lr=0.05))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        opt.params, expected,
+    )
+
+
+def test_clip_norm_leader_equals_allgather(mesh8):
+    """The ZeRO-1 fast path computes the clip norm from psum'd shard
+    sum-squares; both topologies must clip identically (a shard-local
+    norm would diverge silently)."""
+    params = make_params()
+    batch = batch_for(mesh8)
+
+    def run(mode):
+        opt = SGD(params, mesh=mesh8, lr=0.05, momentum=0.9,
+                  clip_norm=0.5, mode=mode)
+        for _ in range(3):
+            opt.step(loss_fn=quad_loss, batch=batch)
+        return opt.params
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        run("allgather"), run("leader"),
+    )
+
+
+def test_clip_norm_inactive_when_above_gradient_norm(mesh8):
+    """A clip threshold above the gradient norm is a no-op (scale
+    min(1, c/norm) == 1)."""
+    params = make_params()
+    batch = batch_for(mesh8)
+
+    def run(clip):
+        opt = SGD(params, mesh=mesh8, lr=0.05, clip_norm=clip)
+        opt.step(loss_fn=quad_loss, batch=batch)
+        return opt.params
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        ),
+        run(0.0), run(1e9),
+    )
