@@ -372,6 +372,10 @@ class MPI_PS:
             raise ValueError(f"optim must be one of {sorted(OPTIMIZERS)}")
         if mode not in ("allgather", "leader"):
             raise ValueError("mode must be 'allgather' or 'leader'")
+        if clip_norm < 0:
+            # a negative threshold would flip scale's sign and silently
+            # turn the update into gradient ASCENT
+            raise ValueError(f"clip_norm must be >= 0, got {clip_norm}")
         hyper_cls, init_state, update_fn = OPTIMIZERS[optim]
         self.hyper = hyper_cls(**hyper)
         self._update_fn = update_fn

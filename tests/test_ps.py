@@ -452,10 +452,8 @@ def test_clip_norm_matches_manual_oracle(mesh8):
                                for g in jax.tree.leaves(summed))))
     assert gnorm > clip  # the scenario is real
     clipped = jax.tree.map(lambda g: g * (clip / gnorm), summed)
-    from pytorch_ps_mpi_tpu.optim import SGDHyper as _H
-
     expected, _ = sgd_update(params, clipped, init_sgd_state(params),
-                             _H(lr=0.05))
+                             SGDHyper(lr=0.05))
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
@@ -503,3 +501,8 @@ def test_clip_norm_inactive_when_above_gradient_norm(mesh8):
         ),
         run(0.0), run(1e9),
     )
+
+
+def test_clip_norm_negative_rejected():
+    with pytest.raises(ValueError, match="clip_norm"):
+        SGD(make_params(), lr=0.05, clip_norm=-1.0)
