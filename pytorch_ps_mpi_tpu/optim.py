@@ -96,6 +96,11 @@ class AdamHyper(NamedTuple):
     eps: float = 1e-8
     weight_decay: float = 0.0
     amsgrad: bool = False
+    # False: torch.optim.Adam's coupled L2 (wd added to the gradient,
+    # the reference's semantics); True: AdamW (Loshchilov & Hutter
+    # 2019) — decay applied directly to params, outside the adaptive
+    # rescaling, the modern default for transformer training
+    decoupled_weight_decay: bool = False
 
 
 class SGDState(NamedTuple):
@@ -159,8 +164,8 @@ def adam_update(
     bias2 = 1.0 - h.b2 ** step.astype(jnp.float32)
 
     def leaf(p, g, m, v, vmax):
-        if h.weight_decay:
-            g = g + h.weight_decay * p
+        if h.weight_decay and not h.decoupled_weight_decay:
+            g = g + h.weight_decay * p  # coupled L2 (torch Adam)
         m_new = h.b1 * m + (1.0 - h.b1) * g
         v_new = h.b2 * v + (1.0 - h.b2) * (g * g)
         if h.amsgrad:
@@ -170,7 +175,10 @@ def adam_update(
             vmax_new = vmax
             denom = jnp.sqrt(v_new) + h.eps
         step_size = lr * jnp.sqrt(bias2) / bias1
-        return p - step_size * m_new / denom, m_new, v_new, vmax_new
+        p_new = p - step_size * m_new / denom
+        if h.weight_decay and h.decoupled_weight_decay:
+            p_new = p_new - lr * h.weight_decay * p  # AdamW
+        return p_new, m_new, v_new, vmax_new
 
     out = jax.tree.map(
         leaf, params, grads, state.exp_avg, state.exp_avg_sq, state.max_exp_avg_sq

@@ -119,3 +119,22 @@ def test_dampening():
     p2, s2 = sgd_update(p1, grads, s1, h)
     # second: buf = 0.5*g + 0.5*g = g → p2 = p1 - lr*g
     assert_trees_close(p2, jax.tree.map(lambda p, g: p - 0.1 * g, p1, grads), rtol=1e-6)
+
+
+def test_adamw_decoupled_matches_optax_adamw():
+    """decoupled_weight_decay=True is AdamW (Loshchilov & Hutter):
+    decay outside the adaptive rescaling, optax.adamw as the oracle."""
+    params, grads = params_and_grads()
+    h = AdamHyper(lr=1e-2, weight_decay=0.1, decoupled_weight_decay=True)
+    ours = run_ours(adam_update, init_adam_state, h, params, grads, 6)
+    ref = run_optax(optax.adamw(1e-2, weight_decay=0.1), params, grads, 6)
+    assert_trees_close(ours, ref, rtol=1e-5, atol=1e-7)
+    # and it genuinely differs from the coupled-L2 form
+    coupled = run_ours(
+        adam_update, init_adam_state,
+        AdamHyper(lr=1e-2, weight_decay=0.1), params, grads, 6,
+    )
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(ours), jax.tree.leaves(coupled))
+    )
