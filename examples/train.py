@@ -126,6 +126,10 @@ def main(argv=None):
     ap.add_argument("--clip-norm", type=float, default=0.0,
                     help="clip the aggregated gradient to this global "
                          "L2 norm (0 = off)")
+    ap.add_argument("--weight-decay", type=float, default=0.0)
+    ap.add_argument("--adamw", action="store_true",
+                    help="decoupled weight decay (AdamW) instead of "
+                         "torch-style coupled L2 (adam only)")
     ap.add_argument("--mode", choices=["allgather", "leader"], default="allgather")
     ap.add_argument("--codec", default=None,
                     help="identity|bf16|f16|topk|randomk|int8|qsgd|sign|terngrad|"
@@ -181,6 +185,12 @@ def main(argv=None):
     hyper = {"lr": lr}
     if args.optim == "sgd":
         hyper["momentum"] = args.momentum
+    if args.weight_decay:
+        hyper["weight_decay"] = args.weight_decay
+    if args.adamw:
+        if args.optim != "adam":
+            raise SystemExit("--adamw requires --optim adam")
+        hyper["decoupled_weight_decay"] = True
     opt = MPI_PS(
         params, optim=args.optim, code=code, mode=args.mode,
         average=True, instrument=args.instrument,
