@@ -151,6 +151,15 @@ def main(argv=None):
                     help="per-stage timing metrics")
     ap.add_argument("--log-every", type=int, default=5)
     args = ap.parse_args(argv)
+    if args.adamw:
+        if args.optim != "adam":
+            ap.error("--adamw requires --optim adam")
+        if not args.weight_decay:
+            # decoupled decay with wd=0 would be a silent no-op; pick
+            # the conventional AdamW default instead of surprising the
+            # user with unregularized plain Adam
+            args.weight_decay = 0.01
+            print("note: --adamw without --weight-decay: using 0.01")
 
     code = None
     if args.codec:
@@ -188,8 +197,6 @@ def main(argv=None):
     if args.weight_decay:
         hyper["weight_decay"] = args.weight_decay
     if args.adamw:
-        if args.optim != "adam":
-            raise SystemExit("--adamw requires --optim adam")
         hyper["decoupled_weight_decay"] = True
     opt = MPI_PS(
         params, optim=args.optim, code=code, mode=args.mode,
