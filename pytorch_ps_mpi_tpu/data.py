@@ -64,6 +64,7 @@ def synthetic_lm(
     vocab_size: int,
     seed: int = 0,
     order: int = 1,
+    table_seed: int | None = None,
 ) -> Iterator[Dict[str, jax.Array]]:
     """Endless iterator of causal-LM batches {'tokens'}: sequences from a
     fixed random Markov chain, so next-token loss has genuine signal
@@ -71,10 +72,16 @@ def synthetic_lm(
     convergence unobservable). ``order=1`` is a plain bigram chain — the
     state IS the previous token, learnable by a 1-layer model; higher
     orders hash the last tokens into the state (harder: the model must
-    recover the hash from context)."""
+    recover the hash from context).
+
+    ``table_seed`` fixes the CHAIN separately from the sampling stream:
+    distributed consumers drawing differently-seeded streams must still
+    sample the SAME language or there is nothing stable to learn."""
     rng = np.random.RandomState(seed)
+    table_rng = (np.random.RandomState(table_seed)
+                 if table_seed is not None else rng)
     n_ctx = min(64, vocab_size)  # contexts hash into this many states
-    table = rng.dirichlet(np.ones(vocab_size) * 0.05, size=n_ctx)
+    table = table_rng.dirichlet(np.ones(vocab_size) * 0.05, size=n_ctx)
     cum = np.cumsum(table, axis=-1)
     while True:
         toks = np.zeros((batch, seq_len), np.int64)

@@ -56,6 +56,17 @@ def _model_by_name(name: str, **kw):
 
         return ResNet50(num_classes=kw.get("num_classes", 10),
                         small_inputs=True)
+    if name == "gpt":
+        from pytorch_ps_mpi_tpu.models import GPTLM, gpt_tiny
+
+        return GPTLM(gpt_tiny(
+            vocab_size=kw.get("vocab_size", 256),
+            hidden_size=kw.get("hidden_size", 64),
+            num_layers=kw.get("num_layers", 2),
+            num_heads=kw.get("num_heads", 4),
+            intermediate_size=kw.get("intermediate_size", 128),
+            max_position=kw.get("max_position", 64),
+        ))
     raise ValueError(f"unknown model {name!r}")
 
 
@@ -72,13 +83,39 @@ def make_problem(cfg: Dict[str, Any]):
     batch = int(cfg.get("batch", 32))
     k = jax.random.key(int(cfg.get("seed", 0)))
     kp, kx, kw = jax.random.split(k, 3)
-    x0 = jnp.zeros((1,) + in_shape, jnp.float32)
-    params0 = model.init(kp, x0)
+    if cfg["model"] != "gpt":  # token models init on int inputs below
+        x0 = jnp.zeros((1,) + in_shape, jnp.float32)
+        params0 = model.init(kp, x0)
 
     n_out = int(cfg.get("model_kw", {}).get("num_classes", 0)) or (
         tuple(cfg.get("model_kw", {}).get("features", (32, 8)))[-1]
         if cfg["model"] == "mlp" else 10
     )
+
+    if cfg["model"] == "gpt":
+        # causal LM on a fixed bigram Markov stream (data.synthetic_lm's
+        # distribution, sampled per worker/step via fold_in for
+        # determinism across the fleet)
+        from pytorch_ps_mpi_tpu.models import causal_lm_loss
+
+        vocab = model.cfg.vocab_size
+        seq = int(cfg.get("seq_len", 32))
+        params0 = model.init(kp, jnp.zeros((1, seq), jnp.int32))
+
+        def batch_fn(step: int, worker: int):
+            from pytorch_ps_mpi_tpu.data import synthetic_lm
+
+            # stream varies per (worker, step); table_seed pins the
+            # CHAIN so every batch samples the same language
+            it = synthetic_lm(batch, seq, vocab,
+                              seed=1000 * worker + step + 1,
+                              table_seed=int(cfg.get("seed", 0)))
+            return next(it)["tokens"]
+
+        def loss_fn(params, tokens):
+            return causal_lm_loss(model.apply(params, tokens), tokens)
+
+        return model, params0, batch_fn, loss_fn
 
     if cfg["model"] == "mlp":
         # regression against a fixed random linear teacher: smooth convex-

@@ -285,3 +285,44 @@ def test_worker_crash_and_elastic_replacement():
         replacement.wait(timeout=30)
     finally:
         server.close()
+
+
+def test_gpt_causal_lm_over_async_wire():
+    """A decoder-only causal LM trains through the async PS: jitted GPT
+    value_and_grad in worker processes, bf16 wire, arrival-order server
+    updates — the model-family x topology cell (transformers x async)
+    the per-family unit tests don't cover."""
+    cfg = {
+        "model": "gpt",
+        "model_kw": {"vocab_size": 64, "hidden_size": 32, "num_layers": 1,
+                     "num_heads": 2, "intermediate_size": 64,
+                     "max_position": 32},
+        "seq_len": 16,
+        "batch": 16,
+        "seed": 2,
+        "codec": "bf16",
+        "optim": "adam",
+        "hyper": {"lr": 1e-2},
+        "steps": 40,
+    }
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.parallel.async_train import make_problem, serve, spawn_worker
+
+    _, params0, _, _ = make_problem(cfg)
+    name = f"/psq_gpt_{os.getpid()}"
+    server = dcn.ShmPSServer(
+        name, num_workers=2, template=params0, max_staleness=10**9,
+        code=get_codec("bf16"),
+    )
+    total = 2 * cfg["steps"]
+    try:
+        procs = [spawn_worker(name, i, cfg) for i in range(2)]
+        _, m = serve(server, cfg, total_grads=0, total_received=total,
+                     timeout=240.0)
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+    finally:
+        server.close()
+    assert m["grads_received"] == total
+    assert m["compression_ratio"] == pytest.approx(2.0)
+    assert m["loss_final"] < 0.85 * m["loss_initial"], m
