@@ -78,23 +78,40 @@ def synthetic_lm(
     distributed consumers drawing differently-seeded streams must still
     sample the SAME language or there is nothing stable to learn."""
     rng = np.random.RandomState(seed)
-    table_rng = (np.random.RandomState(table_seed)
-                 if table_seed is not None else rng)
-    n_ctx = min(64, vocab_size)  # contexts hash into this many states
-    table = table_rng.dirichlet(np.ones(vocab_size) * 0.05, size=n_ctx)
-    cum = np.cumsum(table, axis=-1)
+    cum = markov_table(
+        vocab_size, seed if table_seed is None else table_seed
+    )
     while True:
-        toks = np.zeros((batch, seq_len), np.int64)
-        toks[:, 0] = rng.randint(0, vocab_size, size=batch)
-        state = toks[:, 0] % n_ctx
-        for t in range(1, seq_len):
-            u = rng.rand(batch, 1)
-            toks[:, t] = (u < cum[state]).argmax(axis=-1)
-            if order == 1:
-                state = toks[:, t] % n_ctx
-            else:
-                state = (state * 31 + toks[:, t]) % n_ctx
-        yield {"tokens": jnp.asarray(toks)}
+        yield {"tokens": jnp.asarray(
+            sample_markov(cum, batch, seq_len, rng, order=order)
+        )}
+
+
+def markov_table(vocab_size: int, seed: int = 0) -> "np.ndarray":
+    """The fixed random chain behind :func:`synthetic_lm` as a cumulative
+    table ``[n_ctx, vocab]`` — build ONCE, sample many times (per-batch
+    rebuilds were a measurable hot-path cost for distributed workers)."""
+    rng = np.random.RandomState(seed)
+    n_ctx = min(64, vocab_size)  # contexts hash into this many states
+    table = rng.dirichlet(np.ones(vocab_size) * 0.05, size=n_ctx)
+    return np.cumsum(table, axis=-1)
+
+
+def sample_markov(cum: "np.ndarray", batch: int, seq_len: int,
+                  rng: "np.random.RandomState", order: int = 1) -> "np.ndarray":
+    """One ``[batch, seq_len]`` token batch from a :func:`markov_table`."""
+    n_ctx, vocab_size = cum.shape
+    toks = np.zeros((batch, seq_len), np.int64)
+    toks[:, 0] = rng.randint(0, vocab_size, size=batch)
+    state = toks[:, 0] % n_ctx
+    for t in range(1, seq_len):
+        u = rng.rand(batch, 1)
+        toks[:, t] = (u < cum[state]).argmax(axis=-1)
+        if order == 1:
+            state = toks[:, t] % n_ctx
+        else:
+            state = (state * 31 + toks[:, t]) % n_ctx
+    return toks
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:

@@ -59,14 +59,13 @@ def _model_by_name(name: str, **kw):
     if name == "gpt":
         from pytorch_ps_mpi_tpu.models import GPTLM, gpt_tiny
 
-        return GPTLM(gpt_tiny(
-            vocab_size=kw.get("vocab_size", 256),
-            hidden_size=kw.get("hidden_size", 64),
-            num_layers=kw.get("num_layers", 2),
-            num_heads=kw.get("num_heads", 4),
-            intermediate_size=kw.get("intermediate_size", 128),
-            max_position=kw.get("max_position", 64),
-        ))
+        # forward EVERY config knob (remat, attention, dtype, ...);
+        # only the sizing defaults are overridden for fleet-test scale
+        return GPTLM(gpt_tiny(**{
+            "vocab_size": 256, "hidden_size": 64, "num_layers": 2,
+            "num_heads": 4, "intermediate_size": 128, "max_position": 64,
+            **kw,
+        }))
     raise ValueError(f"unknown model {name!r}")
 
 
@@ -93,24 +92,30 @@ def make_problem(cfg: Dict[str, Any]):
     )
 
     if cfg["model"] == "gpt":
-        # causal LM on a fixed bigram Markov stream (data.synthetic_lm's
-        # distribution, sampled per worker/step via fold_in for
-        # determinism across the fleet)
+        # causal LM on a fixed bigram Markov chain: the TABLE is built
+        # once from cfg['seed'] (every process sees the same language);
+        # sampling streams derive per (worker, step) through a
+        # SeedSequence, which cannot collide the way linear seed
+        # arithmetic (1000*worker + step) did at step >= 1000
+        from pytorch_ps_mpi_tpu.data import markov_table, sample_markov
         from pytorch_ps_mpi_tpu.models import causal_lm_loss
 
         vocab = model.cfg.vocab_size
         seq = int(cfg.get("seq_len", 32))
+        if seq > model.cfg.max_position:
+            raise ValueError(
+                f"seq_len={seq} exceeds the model's max_position="
+                f"{model.cfg.max_position}: positions past it would be "
+                "silently clamped to one embedding"
+            )
+        base_seed = int(cfg.get("seed", 0))
+        cum = markov_table(vocab, base_seed)
         params0 = model.init(kp, jnp.zeros((1, seq), jnp.int32))
 
         def batch_fn(step: int, worker: int):
-            from pytorch_ps_mpi_tpu.data import synthetic_lm
-
-            # stream varies per (worker, step); table_seed pins the
-            # CHAIN so every batch samples the same language
-            it = synthetic_lm(batch, seq, vocab,
-                              seed=1000 * worker + step + 1,
-                              table_seed=int(cfg.get("seed", 0)))
-            return next(it)["tokens"]
+            ss = np.random.SeedSequence([base_seed, worker, step])
+            rng = np.random.RandomState(ss.generate_state(1)[0])
+            return jnp.asarray(sample_markov(cum, batch, seq, rng))
 
         def loss_fn(params, tokens):
             return causal_lm_loss(model.apply(params, tokens), tokens)
