@@ -1,0 +1,214 @@
+"""Round-record provenance recall (VERDICT r3 item 1).
+
+The bench-of-record must carry measured TPU numbers even when the tunnel
+is down at the moment the driver runs ``bench.py``. These tests cover the
+pure half (`utils/provenance.py`) against both synthetic artifact trees
+and the real repo's committed artifacts.
+"""
+
+import json
+import os
+from datetime import datetime
+
+from pytorch_ps_mpi_tpu.utils.provenance import (
+    fallback_record_lines,
+    load_tpu_records,
+    newest_per_metric,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(path, lines):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + "\n")
+
+
+def _mk_repo(tmp_path):
+    root = str(tmp_path)
+    _write(
+        os.path.join(root, "benchmarks", "results", "tpu_old.jsonl"),
+        [
+            {
+                "metric": "resnet18_11M_grad_aggregation_sgd_update_ms",
+                "value": 1.5,
+                "unit": "ms",
+                "vs_baseline": 400.0,
+                "backend": "tpu",
+                "captured_by": "tpu_watch sweep 2026-07-29T10:00:00",
+            },
+            # CPU record must never be recalled as TPU truth
+            {
+                "metric": "resnet18_train_step_b256_steps_per_sec",
+                "value": 0.08,
+                "backend": "cpu",
+                "mfu": 0.0,
+            },
+        ],
+    )
+    # Newer sweep supersedes the old aggregation number; adds an MFU line.
+    _write(
+        os.path.join(root, "benchmarks", "results", "tpu_new.jsonl"),
+        [
+            {
+                "metric": "resnet18_11M_grad_aggregation_sgd_update_ms",
+                "value": 0.779,
+                "unit": "ms",
+                "vs_baseline": 775.47,
+                "backend": "tpu",
+                "captured_by": "tpu_watch sweep 2026-07-30T06:02:46",
+            },
+            {
+                "metric": "resnet18_train_step_b256_bf16_steps_per_sec",
+                "value": 119.99,
+                "unit": "steps/sec",
+                "backend": "tpu",
+                "mfu": 0.4539,
+                "captured_by": "tpu_watch sweep 2026-07-30T06:02:46",
+            },
+        ],
+    )
+    # Watcher log with an uncurated, even newer bench stdout inside a
+    # stage record — must be unwrapped and win on recency.
+    _write(
+        os.path.join(root, "BENCH_TPU_WATCH.jsonl"),
+        [
+            {"stage": "probe", "status": "down", "ts": "2026-07-30T14:00:00"},
+            {
+                "stage": "bench",
+                "status": "ok",
+                "ts": "2026-07-30T18:00:00",
+                "stdout": json.dumps(
+                    {
+                        "metric": "resnet18_train_step_b256_steps_per_sec",
+                        "value": 97.0,
+                        "unit": "steps/sec",
+                        "backend": "tpu",
+                        "mfu": 0.37,
+                    }
+                )
+                + "\n",
+            },
+        ],
+    )
+    return root
+
+
+def test_load_filters_to_tpu_and_unwraps_watcher(tmp_path):
+    recs = load_tpu_records(_mk_repo(tmp_path))
+    assert all(r["backend"] == "tpu" for r in recs)
+    metrics = {r["metric"] for r in recs}
+    assert "resnet18_train_step_b256_steps_per_sec" in metrics  # from watcher
+    # the watcher-wrapped record inherits the stage timestamp
+    wrapped = [r for r in recs if r["metric"] == "resnet18_train_step_b256_steps_per_sec"]
+    assert any("2026-07-30T18:00:00" in r.get("captured_by", "") for r in wrapped)
+
+
+def test_newest_per_metric_prefers_latest_sweep(tmp_path):
+    newest = newest_per_metric(load_tpu_records(_mk_repo(tmp_path)))
+    agg = newest["resnet18_11M_grad_aggregation_sgd_update_ms"]
+    assert agg["value"] == 0.779  # 07-30 sweep beats 07-29
+
+
+def test_fallback_lines_end_with_tpu_summary(tmp_path):
+    now = datetime.fromisoformat("2026-07-30T20:00:00")
+    lines = fallback_record_lines(_mk_repo(tmp_path), now=now)
+    assert lines, "TPU artifacts exist; fallback lines must not be empty"
+    summary = lines[-1]
+    assert summary["metric"] == "tpu_record_summary"
+    assert summary["backend"] == "tpu"
+    assert summary["aggregation_ms"] == 0.779
+    assert summary["mfu"] == 0.4539
+    assert summary["provenance"].startswith("watcher 2026-07-30T")
+    # ages measured against the stamped capture times, oldest key line wins
+    assert summary["age_hours"] >= 13.9
+    for rec in lines[:-1]:
+        assert rec["provenance"].startswith("watcher")
+        assert "age_hours" in rec
+        assert rec["record_source"].startswith("committed TPU artifact")
+        assert rec["replayed"] is True  # live-vs-recalled rides on this key
+    # every line must survive a json round-trip (the driver parses stdout)
+    for rec in lines:
+        json.loads(json.dumps(rec))
+
+
+def test_implausible_mfu_records_never_recalled(tmp_path):
+    """mfu >= 1 is a measurement bug (pre-RTT-correction watcher stages);
+    it must not win the summary's best-MFU slot."""
+    root = _mk_repo(tmp_path)
+    _write(
+        os.path.join(root, "benchmarks", "results", "tpu_buggy.jsonl"),
+        [
+            {
+                "metric": "bert_base_132M_mlm_train_step_b16_s128",
+                "value": 347.6,
+                "backend": "tpu",
+                "mfu": 2.4182,
+                "captured_by": "tpu_watch sweep 2026-07-30T19:00:00",
+            }
+        ],
+    )
+    summary = fallback_record_lines(root)[-1]
+    assert summary["mfu"] < 1.0
+    metrics = {r.get("metric") for r in fallback_record_lines(root)}
+    assert "bert_base_132M_mlm_train_step_b16_s128" not in metrics
+
+
+def test_summary_value_unit_without_aggregation_record(tmp_path):
+    """No grad_aggregation survivor -> summary still honors the
+    value/unit contract, drawn from the best train-step line; a string
+    mfu must neither crash the max() nor win it."""
+    root = str(tmp_path / "nogg")
+    _write(
+        os.path.join(root, "benchmarks", "results", "tpu_only_steps.jsonl"),
+        [
+            {
+                "metric": "resnet18_train_step_b256_bf16_steps_per_sec",
+                "value": 119.99,
+                "unit": "steps/sec",
+                "backend": "tpu",
+                "mfu": 0.4539,
+                "captured_by": "tpu_watch sweep 2026-07-30T06:02:46",
+            },
+            {
+                "metric": "bert_base_132M_mlm_train_step_b16_s128",
+                "value": 65.5,
+                "unit": "steps/sec",
+                "backend": "tpu",
+                "mfu": "0.9999",  # string: must not TypeError in max()
+                "captured_by": "tpu_watch sweep 2026-07-30T07:00:00",
+            },
+        ],
+    )
+    summary = fallback_record_lines(root)[-1]
+    assert summary["metric"] == "tpu_record_summary"
+    assert summary["unit"] == "steps/sec"
+    assert summary["value"] == 65.5  # string mfu parses to 0.9999, wins
+    assert summary["mfu"] == 0.9999
+    json.loads(json.dumps(summary))
+
+
+def test_fallback_lines_empty_when_no_tpu_truth(tmp_path):
+    root = str(tmp_path / "bare")
+    os.makedirs(os.path.join(root, "benchmarks", "results"), exist_ok=True)
+    assert fallback_record_lines(root) == []
+
+
+def test_real_repo_artifacts_yield_a_summary():
+    """The actual committed artifacts must produce a TPU summary line —
+    the guarantee BENCH_r04.json relies on. Data-dependent by design
+    (it checks the working tree's artifacts, not synthetic ones), so it
+    skips rather than fails if the artifacts are ever pruned."""
+    import pytest
+
+    lines = fallback_record_lines(REPO)
+    if not lines:
+        pytest.skip("no committed TPU artifacts in this tree")
+    summary = lines[-1]
+    assert summary["metric"] == "tpu_record_summary"
+    assert summary["replayed"] is True
+    assert "value" in summary and "unit" in summary
+    assert summary.get("mfu", 0) > 0  # plausibility gate keeps it < 1.0
+    assert summary.get("mfu", 1) < 1.0
