@@ -212,10 +212,15 @@ def scan_timed(loop_call: Callable[[], Any], k: int, reps: int = 3) -> float:
 def codec_roundtrip_seconds(code, shape, dtype, k: Optional[int] = None) -> float:
     """Device seconds for one ``encode`` + ``decode`` of a codec at
     ``shape`` — a k-iteration fused scan whose iterations carry a
-    numerically-negligible data dependence (``+ decoded * 1e-30``) so XLA
-    cannot hoist the codec out of the loop. The one shared implementation
-    of the honest codec timing recipe (bench consumers must not re-roll
-    it).
+    numerically-negligible data dependence (``+ decoded * 1e-30``) AND
+    loop-carry the codec state, so XLA can neither hoist the codec out of
+    the loop nor dead-code the stateful half (PowerSGD's warm-started Q,
+    error-feedback residuals, adaptive thresholds). A loop-invariant
+    state once let the best-compressing codec measure 0.0 ms at 132M
+    (VERDICT r3 weak #3) — and steady-state cost with an evolving Q is
+    what a training step actually pays anyway. The one shared
+    implementation of the honest codec timing recipe (bench consumers
+    must not re-roll it).
 
     ``k=None`` picks the scan length ADAPTIVELY: a coarse k=8 estimate
     sizes the real run so the total signal is ≥ ~20 ms, far above the
@@ -234,14 +239,19 @@ def codec_roundtrip_seconds(code, shape, dtype, k: Optional[int] = None) -> floa
         @jax.jit
         def loop(g, st):
             def body(carry, _):
-                payload, _ = code.encode(carry, st, rng)
+                g_c, st_c = carry
+                payload, st_new = code.encode(g_c, st_c, rng)
                 d = code.decode(payload, shape, dtype)
-                return carry + d.astype(carry.dtype) * jnp.asarray(
-                    1e-30, carry.dtype
-                ), None
+                g_next = g_c + d.astype(g_c.dtype) * jnp.asarray(
+                    1e-30, g_c.dtype
+                )
+                return (g_next, st_new), None
 
-            out, _ = jax.lax.scan(body, g, None, length=length)
-            return out
+            (out, st_out), _ = jax.lax.scan(body, (g, st), None, length=length)
+            # return the state too: the fetch syncs on `out`, but keeping
+            # st_out live in the program output closes the last
+            # dead-code-elimination door for state-only compute
+            return out, st_out
 
         return loop
 
