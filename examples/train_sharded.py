@@ -18,10 +18,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import tempfile
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+# abspath, not __file__.rsplit: a relative invocation like
+# `python examples/train_sharded.py` must still find the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(argv=None):

@@ -417,6 +417,11 @@ void* tps_worker_connect(const char* host, uint16_t port, uint32_t worker_id,
 int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
                                uint64_t* version_out, int timeout_ms) {
   Worker* w = (Worker*)wv;
+  // one deadline for the whole call: header + payload reads share the
+  // caller's budget instead of each getting timeout_ms (which made the
+  // worst-case block 2x what the caller asked for)
+  struct timespec t0;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
   std::vector<uint8_t> tx;
   append_frame(tx, GET_PARAMS, w->id, 0, nullptr, 0);
   if (write_full(w->fd, tx.data(), tx.size()) != 0) return -1;
@@ -427,7 +432,13 @@ int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
   if (h.magic != kMagic || h.op != PARAMS) return -1;
   if (h.len > cap) return -3;
   if (h.len) {
-    rc = read_full(w->fd, buf, h.len, timeout_ms);
+    struct timespec now;
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    long elapsed = (now.tv_sec - t0.tv_sec) * 1000 +
+                   (now.tv_nsec - t0.tv_nsec) / 1000000;
+    long left = timeout_ms - elapsed;
+    if (left <= 0) return -2;
+    rc = read_full(w->fd, buf, h.len, (int)left);
     if (rc != 0) return rc;
   }
   if (version_out) *version_out = h.version;

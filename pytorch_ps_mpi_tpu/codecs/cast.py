@@ -49,6 +49,27 @@ class Bf16Codec(Codec):
 @register_codec("f16")
 class F16Codec(Bf16Codec):
     """IEEE half: more mantissa, less range than bf16 — for wires whose
-    consumers prefer fp16 (e.g. non-TPU peers on the DCN)."""
+    consumers prefer fp16 (e.g. non-TPU peers on the DCN).
 
+    Range handling: magnitudes above f16's max finite (65504) would
+    overflow to inf on the wire and corrupt the server-side update, so
+    ``encode`` clips to ±65504 first — in f32, because casting the bound
+    to a coarser grad dtype first (bf16 rounds 65504 → 65536) would
+    defeat it. bf16, sharing f32's exponent range, needs no such clip.
+    Exploding gradients large enough to hit the clip should be paired
+    with gradient clipping anyway (``clip_norm``).
+
+    ``supports_psum`` is disabled (unlike bf16): the fused psum fast path
+    narrows the collective with a bare ``astype`` and would bypass this
+    clip, overflowing on-chip exactly as the wire would. f16 is a host-
+    wire codec by purpose (DCN peers that prefer IEEE half); on-chip
+    collectives should narrow with bf16/``comm_dtype`` instead, so f16
+    takes the encode/decode all-gather path where the clip always runs."""
+
+    supports_psum = False
     wire_dtype = jnp.float16
+
+    def encode(self, grad, state=(), rng=None):
+        m = float(jnp.finfo(jnp.float16).max)
+        clipped = jnp.clip(grad.astype(jnp.float32), -m, m)
+        return clipped.astype(self.wire_dtype), state

@@ -151,6 +151,14 @@ def server_main(shard_id: int, n_shards: int, port: int,
 
             cadence = _PSCheckpointCadence(ckpt, checkpoint_every,
                                            applied_before)
+        # Resume contract: a replacement server expects the FULL job push
+        # count, because workers restart from step 0 alongside it (the
+        # parameter snapshot carries the training progress; worker step
+        # indices are only push bookkeeping — see
+        # test_sharded_checkpoint_resume_continues_independently, where
+        # phase-2 applied_total accumulates on top of applied_before).
+        # Workers that instead survive a server crash and push only their
+        # remaining steps exit via the bounded server_timeout, not a hang.
         deadline = time.time() + float(cfg.get("server_timeout", 300.0))
         while server.grads_received < expected and time.time() < deadline:
             item = server.poll_grad()

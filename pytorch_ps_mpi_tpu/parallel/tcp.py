@@ -170,6 +170,7 @@ class TcpPSServer:
         worker = ctypes.c_uint32()
         version = ctypes.c_uint64()
         self._lib.tps_server_pump(self._h)
+        expected = self.wire.wire_bytes if self.wire else _flat_size(self.template) * 4
         while True:
             n = self._lib.tps_server_pop_grad(
                 self._h, _u8(self._grad_buf.view(np.uint8)),
@@ -181,6 +182,16 @@ class TcpPSServer:
             if n < 0:
                 raise RuntimeError(
                     "tps_server_pop_grad: payload exceeds wire spec — worker "
+                    "and server codec configs disagree"
+                )
+            if int(n) != expected:
+                # same one-time wire agreement the shm path enforces — and
+                # checked for EVERY popped frame, stale-dropped ones
+                # included: a codec-config mismatch on a straggling worker
+                # must raise loudly, not be silently absorbed by the
+                # staleness drop
+                raise RuntimeError(
+                    f"payload size {n} != wire spec {expected} bytes: worker "
                     "and server codec configs disagree"
                 )
             # clamp at 0: a version from the future (e.g. a worker that
@@ -196,15 +207,6 @@ class TcpPSServer:
             if staleness <= self.max_staleness:
                 break
             self.stale_drops += 1
-        expected = self.wire.wire_bytes if self.wire else _flat_size(self.template) * 4
-        if int(n) != expected:
-            # same one-time wire agreement the shm path enforces: a short
-            # payload would crash the decode, a same-size different layout
-            # would silently corrupt gradients
-            raise RuntimeError(
-                f"payload size {n} != wire spec {expected} bytes: worker "
-                "and server codec configs disagree"
-            )
         if self.wire:
             grad = self.wire.decode_from_bytes(self._grad_buf[:n].tobytes())
         else:
