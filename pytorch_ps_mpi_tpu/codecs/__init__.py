@@ -14,6 +14,7 @@ from pytorch_ps_mpi_tpu.codecs.base import Codec, get_codec, register_codec
 from pytorch_ps_mpi_tpu.codecs.identity import IdentityCodec
 from pytorch_ps_mpi_tpu.codecs.cast import Bf16Codec, F16Codec
 from pytorch_ps_mpi_tpu.codecs.topk import TopKCodec
+from pytorch_ps_mpi_tpu.codecs.blocktopk import BlockTopKCodec
 from pytorch_ps_mpi_tpu.codecs.threshold import ThresholdCodec
 from pytorch_ps_mpi_tpu.codecs.randomk import RandomKCodec
 from pytorch_ps_mpi_tpu.codecs.quant import Int8Codec, QSGDCodec
@@ -30,6 +31,7 @@ __all__ = [
     "Bf16Codec",
     "F16Codec",
     "TopKCodec",
+    "BlockTopKCodec",
     "ThresholdCodec",
     "RandomKCodec",
     "Int8Codec",

@@ -1,0 +1,97 @@
+"""Blockwise top-k sparsification: selection tiled for the TPU.
+
+Global top-k over a 132M-element flat gradient is the sparse-codec cost
+problem (VERDICT r3 item 2: ``lax.approx_max_k`` measured 107 ms at 132M
+on v5e — 7x the whole BERT train step it was meant to accelerate). The
+global selection is the expensive part, not the gather: it sorts/scans
+the full vector with cross-chip-of-the-array data movement.
+
+Blockwise selection removes it. The flat gradient is viewed as
+``[n_blocks, block_size]`` (lane-aligned ``block_size``, default 1024)
+and each block keeps its own top ``round(block_size * fraction)``
+entries — an embarrassingly parallel batched ``lax.top_k`` over rows,
+mapping onto the VPU with zero cross-block traffic. The wire format is
+identical to :class:`~.topk.TopKCodec` (values[k] + int32 global
+indices[k]), so transports, EF wrapping and ``decode_sum`` fusion are
+unchanged.
+
+Selection quality: block-local top-k equals global top-k when large
+entries are spread across blocks (the common case for gradient noise;
+dense layers' gradients have no privileged memory order), and degrades
+gracefully when they cluster — every block still ships its local
+maxima, which is exactly the "each worker's own largest coordinates"
+error-feedback literature tolerates (PAPERS.md: Stich et al. 2018 — EF
+absorbs ANY contraction-factor selection, block-local included; pair
+with ``ef`` for convergence-critical runs). The reference's external
+``codings`` hook (SURVEY §2.2) put no constraint on selection semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.topk import TopKCodec
+
+
+@register_codec("blocktopk")
+class BlockTopKCodec(TopKCodec):
+    def __init__(self, fraction: float = 0.01, block_size: int = 1024,
+                 approx: bool = False):
+        """``fraction`` of each block survives (>= 1 entry per block).
+        ``block_size`` should stay a multiple of the 128-lane register
+        width; 1024 = one row of 8 sublanes. ``approx=True`` uses the
+        TPU's hardware ``approx_max_k`` per block instead of exact
+        ``top_k`` (only worth it for large per-block k)."""
+        super().__init__(fraction=fraction, approx=approx)
+        if block_size <= 0 or block_size % 128:
+            raise ValueError("block_size must be a positive multiple of 128")
+        self.block_size = int(block_size)
+
+    def _block_k(self) -> int:
+        return max(1, int(round(self.block_size * self.fraction)))
+
+    def _k_for(self, shape) -> int:
+        """Total payload length: per-block k x number of blocks (the
+        wire-size contract ``payload_bits`` inherits). Tensors no larger
+        than one block take plain top-k's fraction-of-n (matching the
+        ``encode`` fallback)."""
+        n = int(np.prod(shape)) if shape else 1
+        if n <= self.block_size:
+            return super()._k_for(shape)
+        nb = -(-n // self.block_size)
+        # NOT capped at n: a ragged tail block still emits block_k pairs
+        # (pad-slot picks carry out-of-range indices, dropped at scatter),
+        # and the wire carries every one of them
+        return nb * self._block_k()
+
+    def encode(self, grad, state=(), rng=None):
+        flat = grad.reshape(-1)
+        n = flat.shape[0]
+        if n <= self.block_size:
+            return super().encode(grad, state, rng)  # one block: plain top-k
+        nb = -(-n // self.block_size)
+        pad = nb * self.block_size - n
+        # padding must never win selection, and if a short final block
+        # still selects a padded slot its global index lands >= n and is
+        # dropped at scatter time (mode='drop' in decode/decode_sum)
+        blocks = jnp.concatenate(
+            [flat, jnp.zeros((pad,), flat.dtype)]
+        ).reshape(nb, self.block_size)
+        kb = self._block_k()
+        if self.approx:
+            _, local = jax.lax.approx_max_k(jnp.abs(blocks), kb)
+        else:
+            _, local = jax.lax.top_k(jnp.abs(blocks), kb)
+        glob = (jnp.arange(nb, dtype=jnp.int32)[:, None] * self.block_size
+                + local.astype(jnp.int32))
+        values = jnp.take_along_axis(blocks, local, axis=1)
+        return {
+            "values": values.reshape(-1),
+            "indices": glob.reshape(-1),
+        }, state
+    # decode/decode_sum are inherited: TopKCodec scatters with
+    # mode='drop', which discards this codec's >= n pad-slot indices and
+    # is a no-op for plain top-k's always-in-range ones

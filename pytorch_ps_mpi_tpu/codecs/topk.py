@@ -54,9 +54,14 @@ class TopKCodec(Codec):
         return payload, state
 
     def decode(self, payload, shape, dtype):
+        # mode='drop': a no-op for this codec's always-in-range indices,
+        # load-bearing for BlockTopKCodec's >= n pad-slot indices (the
+        # default would CLAMP them onto element n-1 and corrupt it)
         n = int(np.prod(shape)) if shape else 1
         flat = jnp.zeros((n,), dtype)
-        flat = flat.at[payload["indices"]].set(payload["values"].astype(dtype))
+        flat = flat.at[payload["indices"]].set(
+            payload["values"].astype(dtype), mode="drop"
+        )
         return flat.reshape(shape)
 
     def decode_sum(self, payloads, shape, dtype):
@@ -66,7 +71,7 @@ class TopKCodec(Codec):
         flat = jnp.zeros((n,), dtype)
         idx = payloads["indices"].reshape(-1)
         val = payloads["values"].reshape(-1).astype(dtype)
-        return flat.at[idx].add(val).reshape(shape)
+        return flat.at[idx].add(val, mode="drop").reshape(shape)
 
     def payload_bits(self, shape, dtype):
         k = self._k_for(shape)

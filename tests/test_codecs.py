@@ -450,3 +450,107 @@ def test_bf16_codec_halves_async_wire():
 def test_qsgd_levels_bounded():
     with pytest.raises(ValueError):
         QSGDCodec(levels=200)  # would overflow the int8 payload
+
+
+# -- blocktopk (VERDICT r3 item 2: selection without a global sort) -----
+
+def test_blocktopk_keeps_each_blocks_largest():
+    from pytorch_ps_mpi_tpu.codecs import BlockTopKCodec
+
+    code = BlockTopKCodec(fraction=1 / 128, block_size=128)
+    g = grad((512,), seed=3)
+    out = roundtrip(code, g)
+    # per 128-block, exactly the largest-|.| entry survives
+    gb = np.asarray(g).reshape(4, 128)
+    ob = np.asarray(out).reshape(4, 128)
+    for b in range(4):
+        j = np.abs(gb[b]).argmax()
+        assert ob[b][j] == gb[b][j]
+        assert (ob[b] != 0).sum() == 1
+
+
+def test_blocktopk_wire_matches_topk_format_and_bits():
+    from pytorch_ps_mpi_tpu.codecs import BlockTopKCodec, TopKCodec
+
+    n = 4096
+    bt = BlockTopKCodec(fraction=0.01, block_size=1024)
+    tk = TopKCodec(fraction=0.01)
+    g = grad((n,), seed=4)
+    pb, _ = bt.encode(g, bt.init_state(g.shape, g.dtype))
+    pt, _ = tk.encode(g, tk.init_state(g.shape, g.dtype))
+    # same payload keys/dtypes; blockwise k = nb * round(B*f) ≈ global k
+    assert set(pb) == set(pt) == {"values", "indices"}
+    assert pb["indices"].dtype == jnp.int32
+    assert pb["values"].shape == (4 * 10,)
+    assert bt.payload_bits(g.shape, g.dtype) == 40 * (32 + 32)
+
+
+def test_blocktopk_selects_most_of_global_topk_mass():
+    """Gradient noise spreads large entries across blocks: block-local
+    selection must recover most of the global top-k L2 mass."""
+    from pytorch_ps_mpi_tpu.codecs import BlockTopKCodec, TopKCodec
+
+    n = 1 << 16
+    g = grad((n,), seed=5)
+    f = 0.01
+    bt = roundtrip(BlockTopKCodec(fraction=f, block_size=1024), g)
+    tk = roundtrip(TopKCodec(fraction=f), g)
+    mass = lambda x: float(jnp.sum(x * x))
+    assert mass(bt) > 0.75 * mass(tk)
+
+
+def test_blocktopk_ragged_tail_pads_and_drops():
+    """n not a multiple of block_size: the padded tail must neither be
+    selected over real entries nor corrupt the scatter (mode='drop')."""
+    from pytorch_ps_mpi_tpu.codecs import BlockTopKCodec
+
+    code = BlockTopKCodec(fraction=2 / 128, block_size=128)
+    n = 300  # blocks of 128,128,44(+84 pad)
+    g = jnp.ones((n,)) * 0.01
+    g = g.at[290].set(5.0).at[299].set(-4.0)  # tail block's largest
+    out = roundtrip(code, g)
+    assert float(out[290]) == 5.0
+    assert float(out[299]) == -4.0
+    assert out.shape == (n,)
+    # decode_sum over 2 stacked workers: same drop discipline
+    st = code.init_state(g.shape, g.dtype)
+    p, _ = code.encode(g, st)
+    stacked = jax.tree.map(lambda x: jnp.stack([x, x]), p)
+    s = code.decode_sum(stacked, g.shape, g.dtype)
+    assert float(s[290]) == 10.0
+
+
+def test_blocktopk_single_block_falls_back_to_topk():
+    from pytorch_ps_mpi_tpu.codecs import BlockTopKCodec, TopKCodec
+
+    g = grad((128,), seed=6)
+    bt = roundtrip(BlockTopKCodec(fraction=0.1, block_size=1024), g)
+    tk = roundtrip(TopKCodec(fraction=0.1), g)
+    np.testing.assert_array_equal(np.asarray(bt), np.asarray(tk))
+
+
+def test_blocktopk_validation():
+    from pytorch_ps_mpi_tpu.codecs import BlockTopKCodec
+
+    with pytest.raises(ValueError):
+        BlockTopKCodec(fraction=0.01, block_size=100)  # not lane-aligned
+    with pytest.raises(ValueError):
+        BlockTopKCodec(fraction=0.0)
+
+
+def test_blocktopk_payload_bits_counts_emitted_pairs():
+    """Ragged tail + high fraction: encode emits nb*block_k pairs (pad
+    picks included, dropped at scatter) and payload_bits must count ALL
+    of them — under-reporting would skew every wire-size metric."""
+    from pytorch_ps_mpi_tpu.codecs import BlockTopKCodec
+
+    code = BlockTopKCodec(fraction=0.9, block_size=128)
+    g = grad((300,), seed=7)
+    p, _ = code.encode(g, code.init_state(g.shape, g.dtype))
+    emitted = int(p["values"].shape[0])
+    assert emitted == 3 * round(128 * 0.9)  # > n=300
+    assert code._k_for(g.shape) == emitted
+    assert code.payload_bits(g.shape, g.dtype) == emitted * 64
+    # and the decode still reconstructs only real coordinates
+    out = code.decode(p, g.shape, g.dtype)
+    assert out.shape == g.shape
