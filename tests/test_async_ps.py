@@ -90,3 +90,60 @@ def test_staleness_validation():
     with pytest.raises(ValueError):
         AsyncPS(params, quad_loss, num_workers=4, max_staleness=1,
                 staleness=[0, 0, 2, 0])
+
+
+# -- arrival-driven staleness (VERDICT r3 item 7) -----------------------
+
+def test_sampled_staleness_matches_given_distribution():
+    """Default mode samples lags per round; over many rounds the used-lag
+    histogram must track the requested distribution (not a schedule)."""
+    from pytorch_ps_mpi_tpu.parallel.async_ps import (
+        staleness_probs_from_histogram,
+    )
+
+    params, batches, _ = make_setup()
+    probs = staleness_probs_from_histogram({0: 60, 1: 30, 2: 10}, 2)
+    np.testing.assert_allclose(probs, [0.6, 0.3, 0.1])
+    ps = AsyncPS(params, quad_loss, num_workers=4, max_staleness=2,
+                 staleness_probs=probs, lr=0.01, seed=7)
+    rounds = 150
+    for _ in range(rounds):
+        ps.step(batches)
+    total = sum(ps.staleness_hist.values())
+    assert total == rounds * 4
+    emp = np.array([ps.staleness_hist.get(i, 0) / total for i in range(3)])
+    # total-variation distance small (600 samples; 3 bins)
+    assert 0.5 * np.abs(emp - probs).sum() < 0.08, (emp, probs)
+    # and it is genuinely stochastic: both of the non-fresh lags occur
+    assert ps.staleness_hist.get(1, 0) > 0 and ps.staleness_hist.get(2, 0) > 0
+
+
+def test_fixed_schedule_still_available_and_recorded():
+    params, batches, _ = make_setup()
+    ps = AsyncPS(params, quad_loss, num_workers=4, max_staleness=2,
+                 staleness=[0, 1, 2, 2], lr=0.01)
+    for _ in range(5):
+        ps.step(batches)
+    assert ps.staleness_hist == {0: 5, 1: 5, 2: 10}
+
+
+def test_staleness_probs_validation():
+    params, batches, _ = make_setup()
+    with pytest.raises(ValueError):
+        AsyncPS(params, quad_loss, num_workers=4, max_staleness=2,
+                staleness=[0, 1, 2, 0], staleness_probs=[1, 1, 1], lr=0.01)
+    with pytest.raises(ValueError):
+        AsyncPS(params, quad_loss, num_workers=4, max_staleness=2,
+                staleness_probs=[1.0, 1.0], lr=0.01)  # wrong length
+    from pytorch_ps_mpi_tpu.parallel.async_ps import (
+        staleness_probs_from_histogram,
+    )
+    with pytest.raises(ValueError):
+        staleness_probs_from_histogram({7: 10}, 2)  # all mass was dropped
+
+
+def test_negative_fixed_staleness_rejected():
+    params, batches, _ = make_setup()
+    with pytest.raises(ValueError):
+        AsyncPS(params, quad_loss, num_workers=4, max_staleness=2,
+                staleness=[-1, 0, 0, 0], lr=0.01)
