@@ -140,7 +140,20 @@ def pallas_mosaic_smoke() -> str:
         err = float(jnp.max(jnp.abs(deq - x)))
         if err > float(scale) * 0.51:
             return f"fail: int8 round-trip err {err}"
-        return "ok (mosaic-compiled)"
+        # flash attention: Mosaic lowering + numerics vs the dense oracle
+        from pytorch_ps_mpi_tpu.ops.attention_pallas import (
+            _attention_jnp,
+            flash_attention,
+        )
+
+        qa = jax.random.normal(jax.random.key(1), (1, 128, 2, 64),
+                               jnp.float32)
+        fo = flash_attention(qa, qa, qa, causal=True)
+        ro, _ = _attention_jnp(qa, qa, qa, 0, 0, True, 64 ** -0.5)
+        ferr = float(jnp.max(jnp.abs(fo - ro)))
+        if ferr > 2e-4:
+            return f"fail: flash-attention err {ferr}"
+        return "ok (mosaic-compiled: quant, sign, flash-attention)"
     except Exception as e:  # lowering errors are exactly what we're probing
         return f"fail: {type(e).__name__}: {str(e)[:200]}"
 
@@ -432,8 +445,19 @@ def main():
         # (132M params, Adam), bf16 compute: the large-flat-gradient
         # stress configuration, and this framework's best MFU. Skipped on
         # the CPU fallback (a 132M fwd+bwd on one host core would take
-        # minutes per rep for no information).
-        bert_line(live)
+        # minutes per rep for no information). Guarded: a BERT-path
+        # failure (e.g. an attention-kernel lowering regression) must
+        # not cost the ResNet lines already emitted.
+        try:
+            bert_line(live)
+        except Exception as e:
+            # same naming scheme as the success record (param count
+            # unknown here) so metric-joins see an errored row, not a
+            # silently missing series
+            emit(f"bert_base_mlm_train_step_b{BERT_BATCH}_s{BERT_SEQ}"
+                 "_bf16_steps_per_sec",
+                 0.0, "steps/sec", 0.0, live,
+                 error=f"{type(e).__name__}: {str(e)[:300]}")
     else:
         # CPU fallback: the tunnel was down at this exact moment, but the
         # measured TPU truth may sit committed in benchmarks/results/ (or
@@ -449,7 +473,10 @@ def main():
             print(json.dumps(rec), flush=True)
 
 
-def bert_line(live: bool, batch: int = 16, seq: int = 128,
+BERT_BATCH, BERT_SEQ = 16, 128
+
+
+def bert_line(live: bool, batch: int = BERT_BATCH, seq: int = BERT_SEQ,
               scan_k: int = 8) -> None:
     from pytorch_ps_mpi_tpu.models import BertConfig, BertMLM
     from pytorch_ps_mpi_tpu.models.bert import mlm_loss

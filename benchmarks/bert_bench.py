@@ -68,8 +68,10 @@ def make_batch(key, batch, seq, vocab):
     return tokens, targets, mask
 
 
-def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10):
-    cfg = BertConfig(dtype=jnp.bfloat16, max_position=max(512, seq))
+def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10,
+                        attention: str = "full"):
+    cfg = BertConfig(dtype=jnp.bfloat16, max_position=max(512, seq),
+                     attention=attention)
     model = BertMLM(cfg)
     h = AdamHyper(lr=1e-4)
 
@@ -117,6 +119,7 @@ def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10):
     peak = peak_flops_for()
     emit(
         metric=f"bert_base_{n_params//10**6}M_mlm_train_step_b{batch}_s{seq}",
+        attention=attention,
         value=round(safe_ratio(1.0, dev_s), 3), unit="steps/sec",
         step_ms_device=round(dev_s * 1e3, 2),
         wall_ms_per_call=round(wall_s * 1e3, 2),
@@ -229,7 +232,23 @@ def main():
     # measuring 110M-elem encodes on the host CPU takes minutes; analytic
     # table only when the accelerator is down
     codec_table(n_params, measure=on_tpu)
-    single_device_bench(args.batch if on_tpu else 4, args.seq if on_tpu else 64)
+    if on_tpu:
+        # flash-vs-einsum A/B at the headline shape, plus the long-seq
+        # line the dense path collapses on (VERDICT r3 item 5). Each line
+        # fails independently: a kernel lowering error must not cost the
+        # einsum baseline (or vice versa) in a rare TPU window.
+        for b, s, attn in [
+            (args.batch, args.seq, "flash"),
+            (args.batch, args.seq, "einsum"),
+            (max(args.batch // 4, 1), 512, "flash"),
+        ]:
+            try:
+                single_device_bench(b, s, attention=attn)
+            except Exception as e:
+                emit(metric=f"bert_train_step_b{b}_s{s}", attention=attn,
+                     error=f"{type(e).__name__}: {str(e)[:300]}")
+    else:
+        single_device_bench(4, 64)
     if not args.skip_distributed:
         distributed_bench(args.seq)
 

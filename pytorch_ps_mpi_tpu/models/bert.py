@@ -69,20 +69,49 @@ class SelfAttention(nn.Module):
             out = ring_attention(q, k, v, c.seq_axis, causal=c.causal)
         elif c.attention == "ulysses":
             out = ulysses_attention(q, k, v, c.seq_axis, causal=c.causal)
-        elif c.attention == "full":
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / head_dim ** 0.5
-            if c.causal:
-                l = s.shape[-1]
-                mask = jnp.tril(jnp.ones((l, l), bool))
-                s = jnp.where(mask[None, None], s, jnp.asarray(-1e30, s.dtype))
-            p = jax.nn.softmax(s, axis=-1)
-            out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        elif c.attention in ("full", "flash", "einsum"):
+            # 'flash': always the Pallas kernel (interpret mode off-TPU —
+            # for tests). 'full': the kernel on TPU when the sequence
+            # tiles, einsum otherwise — so the O(L^2)-HBM dense path is
+            # never taken on hardware where the kernel can run.
+            # 'einsum': force the dense path (the flash-vs-einsum A/B in
+            # benchmarks/bert_bench.py).
+            from pytorch_ps_mpi_tpu.ops.attention_pallas import (
+                flash_attention,
+                flash_supported,
+                mosaic_lowering_ok,
+            )
+
+            l = q.shape[1]
+            if c.attention == "flash" and not flash_supported(l, l):
+                # the explicit mode must fail loudly, not silently hand
+                # an f32 dense fallback to a 'flash'-labeled A/B
+                raise ValueError(
+                    f"attention='flash' cannot tile seq={l} (needs a "
+                    "power-of-two block >= 8 dividing it); use 'full' "
+                    "for automatic fallback"
+                )
+            use_kernel = c.attention == "flash" or (
+                c.attention == "full"
+                and flash_supported(l, l)
+                and mosaic_lowering_ok(head_dim, c.dtype, l)
+            )
+            if use_kernel:
+                out = flash_attention(q, k, v, causal=c.causal)
+            else:
+                s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / head_dim ** 0.5
+                if c.causal:
+                    mask = jnp.tril(jnp.ones((l, l), bool))
+                    s = jnp.where(mask[None, None], s,
+                                  jnp.asarray(-1e30, s.dtype))
+                p = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
         else:
             # a typo'd mode must not silently run shard-local dense
             # attention (valid shapes, quietly wrong model under SP)
             raise ValueError(
-                f"unknown attention={c.attention!r}: "
-                "expected 'full', 'ring', or 'ulysses'"
+                f"unknown attention={c.attention!r}: expected 'full', "
+                "'flash', 'einsum', 'ring', or 'ulysses'"
             )
         return nn.DenseGeneral(
             c.hidden_size, axis=(-2, -1), dtype=c.dtype, name="out"

@@ -8,5 +8,14 @@ used automatically off-TPU (interpret mode on CPU test meshes).
 """
 
 from pytorch_ps_mpi_tpu.ops.quant_pallas import quantize_int8, dequantize_int8
+from pytorch_ps_mpi_tpu.ops.attention_pallas import (
+    flash_attention,
+    flash_supported,
+)
 
-__all__ = ["quantize_int8", "dequantize_int8"]
+__all__ = [
+    "quantize_int8",
+    "dequantize_int8",
+    "flash_attention",
+    "flash_supported",
+]

@@ -1,0 +1,428 @@
+"""Flash attention as a Pallas TPU kernel (forward AND backward).
+
+The dense-attention path this replaces (``models/bert.py``: plain einsum
+softmax) materializes the [l, l] score matrix in HBM per head — the
+classic O(L²) memory wall, and the reason BERT MFU collapses past s128
+(VERDICT r3 weak #4). This kernel is the standard online-softmax tiling
+(Dao et al.; Milakov & Gimelshein max-shift streaming): q tiles stay
+resident in VMEM while k/v tiles stream past; the score block, running
+row-max, exp-sum and output accumulator never leave VMEM; HBM traffic
+drops from O(L²) to O(L·d).
+
+Design choices:
+
+- **Grid** ``(batch*heads, q_tiles, k_tiles)`` — TPU grids execute
+  sequentially per core with the last dimension innermost, so the VMEM
+  scratch accumulators (acc, running max m, running sum l) persist
+  across the k sweep of one q tile; initialized at ``k==0``, finalized
+  (normalize + logsumexp write) at ``k==nk-1``.
+- **Dynamic position offsets** (SMEM scalars): the causal mask is
+  evaluated in GLOBAL coordinates ``k_off + col <= q_off + row``, so the
+  same compiled kernel serves dense attention (offsets 0) and ring
+  attention's rotating blocks (``parallel/ring.py`` passes the block's
+  traced global offset; a fully-future block masks itself to nothing).
+  Fully-masked k tiles are skipped with a predicated ``pl.when`` — the
+  causal dense case does half the work, ring's future blocks cost ~0.
+- **Backward is two Pallas kernels** (dq over k tiles; dk/dv over q
+  tiles) recomputing p from the saved logsumexp — no O(L²) residual.
+  The custom VJP also accepts a cotangent for the returned logsumexp
+  (folded into ``Dm = D - g_lse``), which is what lets ring attention
+  combine per-block normalized outputs differentiably.
+- **MXU precision**: scores and accumulators are f32
+  (``preferred_element_type``); the p@v contraction runs in the input
+  dtype (bf16 on TPU) like standard flash implementations.
+
+Off-TPU the kernel runs in Pallas interpret mode (CPU test meshes);
+``flash_attention`` falls back to a jnp oracle for shapes the tiling
+cannot serve (sequence not a multiple of the minimal sublane tile).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_ps_mpi_tpu.ops._common import LANE as _LANE
+from pytorch_ps_mpi_tpu.ops._common import interpret as _interpret
+
+_MASKED = -1e30        # additive mask value
+_MASK_THRESH = -1e29   # "this score was masked" test (real scores are tiny)
+
+
+def _pick_block(length: int, target: int) -> Optional[int]:
+    """Largest power-of-two block <= target that divides ``length``
+    (>= 8, the f32 sublane); None if the length cannot tile."""
+    b = 1
+    while b * 2 <= min(target, length) and length % (b * 2) == 0:
+        b *= 2
+    return b if b >= 8 and length % b == 0 else None
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_sc, l_sc, *, causal, scale, bq, bk, nk):
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_sc[:] = jnp.full_like(m_sc, _MASKED)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    q_start = qo_ref[0] + j * bq
+    k_start = ko_ref[0] + kk * bk
+    # causal: skip tiles that lie entirely in the masked future
+    live = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, _MASKED)
+        m_prev = m_sc[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # a row with no visible key keeps m == _MASKED; exp(s - m) would
+        # be exp(0) = 1 there — mask p explicitly, never through the exp
+        p = jnp.where(s > _MASK_THRESH, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[:, :1] = l_sc[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0]
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[:, :1] = m_new
+
+    @pl.when(kk == nk - 1)
+    def _():
+        l_safe = jnp.maximum(l_sc[:, :1], 1e-30)
+        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_sc[:, :1] + jnp.log(l_safe))[:, 0]
+
+
+def _fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    nq, nk = lq // bq, lk // bk
+    kern = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale, bq=bq, bk=bk, nk=nk
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_off, k_off, q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q, k, lse_tile, q_start, k_start, causal, scale, bq, bk):
+    """p = exp(s - lse) with masked entries exactly zero."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(cols <= rows, s, _MASKED)
+    return jnp.where(s > _MASK_THRESH, jnp.exp(s - lse_tile[:, None]), 0.0)
+
+
+def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   dm_ref, dq_ref, dq_acc, *, causal, scale, bq, bk, nk):
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qo_ref[0] + j * bq
+    k_start = ko_ref[0] + kk * bk
+    live = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p = _recompute_p(q, k, lse_ref[0], q_start, k_start, causal, scale,
+                         bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dm_ref[0][:, None])
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(kk == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    dm_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, causal, scale, bq, bk, nq):
+    jk = pl.program_id(1)
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qo_ref[0] + jq * bq
+    k_start = ko_ref[0] + jk * bk
+    live = (k_start <= q_start + bq - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        p = _recompute_p(q, k, lse_ref[0], q_start, k_start, causal, scale,
+                         bq, bk)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dm_ref[0][:, None])
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(jq == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, q_off, k_off, out, lse, g_out, g_lse,
+         causal, scale, bq, bk):
+    bh, lq, d = q3.shape
+    lk = k3.shape[1]
+    nq, nk = lq // bq, lk // bk
+    # D folds the out-cotangent; the lse-cotangent enters with opposite
+    # sign in ds = p * (dp - (D - g_lse))
+    dm = (jnp.sum(g_out.astype(jnp.float32) * out.astype(jnp.float32),
+                  axis=-1) - g_lse)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q_off, k_off, q3, k3, v3, g_out, lse, dm)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda i, jk, jq: (i, jq, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, jk, jq: (i, jk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, jk, jq: (i, jk, 0)),
+            pl.BlockSpec((1, bq, d), lambda i, jk, jq: (i, jq, 0)),
+            pl.BlockSpec((1, bq), lambda i, jk, jq: (i, jq)),
+            pl.BlockSpec((1, bq), lambda i, jk, jq: (i, jq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, jk, jq: (i, jk, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, jk, jq: (i, jk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q_off, k_off, q3, k3, v3, g_out, lse, dm)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp core on [bh, l, d] arrays
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
+    out, lse = _fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk)
+    return out, lse
+
+
+def _flash_fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
+    out, lse = _fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk)
+    return (out, lse), (q3, k3, v3, q_off, k_off, out, lse)
+
+
+def _flash_bwd(causal, scale, bq, bk, res, g):
+    q3, k3, v3, q_off, k_off, out, lse = res
+    g_out, g_lse = g
+    dq, dk, dv = _bwd(q3, k3, v3, q_off, k_off, out, lse, g_out, g_lse,
+                      causal, scale, bq, bk)
+    zero_off = np.zeros((1,), jax.dtypes.float0)  # int inputs: no tangent
+    return dq, dk, dv, zero_off, zero_off
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API on [b, l, h, d] arrays (the models' layout)
+# ---------------------------------------------------------------------------
+
+def _attention_jnp(q, k, v, q_offset, k_offset, causal, scale):
+    """Dense oracle with identical semantics (global-coordinate causal
+    mask, masked-row-safe, returns lse). Differentiable; used as the
+    fallback for untileable shapes and as the test oracle."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        rows = q_offset + jnp.arange(q.shape[1])
+        cols = k_offset + jnp.arange(k.shape[1])
+        s = jnp.where(cols[None, :] <= rows[:, None], s, _MASKED)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(s > _MASK_THRESH, jnp.exp(s - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = jnp.einsum("bhqk,bkhd->bqhd", (p / l_safe),
+                     v.astype(jnp.float32)).astype(q.dtype)
+    lse = (m + jnp.log(l_safe))[..., 0]               # [b, h, q]
+    return out, lse
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    q_offset=None, k_offset=None,
+    block_q: int = 128, block_k: int = 128,
+    return_lse: bool = False,
+):
+    """Tiled attention over ``[batch, seq, heads, head_dim]`` tensors.
+
+    ``q_offset``/``k_offset`` (int scalars, may be traced) place the q/k
+    blocks in global sequence coordinates for the causal mask — ring
+    attention passes its rotating block offsets here. With
+    ``return_lse=True`` also returns the per-row logsumexp ``[b, h, q]``
+    (differentiable), which is what block-combining needs.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    q_offset = jnp.zeros((), jnp.int32) if q_offset is None else q_offset
+    k_offset = jnp.zeros((), jnp.int32) if k_offset is None else k_offset
+
+    bq = _pick_block(lq, block_q)
+    bk = _pick_block(lk, block_k)
+    if bq is None or bk is None:
+        out, lse = _attention_jnp(q, k, v, q_offset, k_offset, causal, scale)
+        return (out, lse) if return_lse else out
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    q_off = jnp.broadcast_to(q_offset, (1,)).astype(jnp.int32)
+    k_off = jnp.broadcast_to(k_offset, (1,)).astype(jnp.int32)
+    out3, lse3 = _flash(to3(q), to3(k), to3(v), q_off, k_off,
+                        causal, float(scale), bq, bk)
+    out = out3.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    if not return_lse:
+        return out
+    return out, lse3.reshape(b, h, lq)
+
+
+def flash_supported(lq: int, lk: int, block_q: int = 128,
+                    block_k: int = 128) -> bool:
+    """Can the tiled kernel serve these sequence lengths?"""
+    return (_pick_block(lq, block_q) is not None
+            and _pick_block(lk, block_k) is not None)
+
+
+def mosaic_lowering_ok(head_dim: int = 64, dtype=jnp.bfloat16,
+                       seq: int = 128) -> bool:
+    """Cached compile probe: does this backend's Mosaic lower the kernel
+    for THIS head_dim/dtype (the parameters tiling actually depends on)?
+    Gates the AUTO dispatches ('full' attention, ring's default) so a
+    lowering regression degrades to the dense path instead of breaking
+    every TPU bench/model; the explicit 'flash' mode stays ungated and
+    fails loudly. The probe sequence is clamped small — lowering failures
+    are shape-class properties (dtype tiling, lane-dim head size), not
+    length properties."""
+    bq = _pick_block(seq, 128)
+    return _lowering_probe(int(head_dim), jnp.dtype(dtype).name,
+                           2 * (bq or 64))
+
+
+@functools.lru_cache(maxsize=16)
+def _lowering_probe(head_dim: int, dtype_name: str, seq: int) -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        q = jnp.zeros((1, min(seq, 256), 1, head_dim), dtype_name)
+        jax.jit(lambda x: flash_attention(x, x, x)).lower(q).compile()
+        return True
+    except Exception:
+        return False

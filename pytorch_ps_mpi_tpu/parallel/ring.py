@@ -33,6 +33,7 @@ def ring_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Ring attention over sequence shards.
 
@@ -42,6 +43,11 @@ def ring_attention(
       axis_name: mesh axis the sequence is sharded over.
       causal: apply a causal mask in *global* sequence coordinates.
       scale: logit scale; default ``head_dim ** -0.5``.
+      use_flash: compute each rotating block with the Pallas flash
+        kernel (``ops/attention_pallas.py``) instead of a dense jnp
+        block — per-block outputs combine via their logsumexp (the lse
+        cotangent path keeps it differentiable). Default: auto (kernel
+        on TPU when the local shard tiles; dense jnp otherwise).
 
     Returns ``[batch, seq_local, heads, head_dim]``: this shard's rows of
     full-sequence attention.
@@ -49,8 +55,18 @@ def ring_attention(
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, l_q, h, d = q.shape
+    l_k = k.shape[1]
     if scale is None:
         scale = d ** -0.5
+    if use_flash is None:
+        from pytorch_ps_mpi_tpu.ops.attention_pallas import (
+            flash_supported,
+            mosaic_lowering_ok,
+        )
+
+        use_flash = (jax.default_backend() == "tpu"
+                     and flash_supported(l_q, l_k)
+                     and mosaic_lowering_ok(d, q.dtype, l_q))
 
     q_pos = my_idx * l_q + jnp.arange(l_q)            # global query positions
 
@@ -65,13 +81,40 @@ def ring_attention(
 
     def step(carry, _):
         k_cur, v_cur, src_idx, num, den, mx = carry
-        s = block(q, k_cur, v_cur, src_idx)            # [b, h, q, k]
-        blk_max = s.max(axis=-1)                       # [b, h, q]
-        new_mx = jnp.maximum(mx, blk_max)
-        corr = jnp.exp(mx - new_mx)
-        p = jnp.exp(s - new_mx[..., None])             # [b, h, q, k]
-        num = num * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur)
-        den = den * corr + p.sum(axis=-1)
+        if use_flash:
+            # block attention in VMEM; combine normalized block outputs
+            # by their logsumexp (max-shift weights — same streaming
+            # softmax, one level up)
+            from pytorch_ps_mpi_tpu.ops.attention_pallas import (
+                flash_attention,
+            )
+
+            o_blk, lse_blk = flash_attention(
+                q, k_cur, v_cur, causal=causal, scale=scale,
+                q_offset=(my_idx * l_q).astype(jnp.int32),
+                k_offset=(src_idx * l_k).astype(jnp.int32),
+                return_lse=True,
+            )
+            o_blk = o_blk.transpose(0, 2, 1, 3)        # [b, h, q, d]
+            new_mx = jnp.maximum(mx, lse_blk)
+            corr = jnp.exp(mx - new_mx)
+            # explicit guard: a fully-masked block's lse is ~-1e30; if mx
+            # is ALSO still at its init floor, exp(lse-new_mx)=exp(0)=1
+            # would smuggle the masked block in
+            w = jnp.where(lse_blk > -1e29,
+                          jnp.exp(lse_blk - new_mx), 0.0)
+            num = num * corr[..., None] + o_blk * w[..., None]
+            den = den * corr + w
+        else:
+            s = block(q, k_cur, v_cur, src_idx)        # [b, h, q, k]
+            blk_max = s.max(axis=-1)                   # [b, h, q]
+            new_mx = jnp.maximum(mx, blk_max)
+            corr = jnp.exp(mx - new_mx)
+            p = jnp.exp(s - new_mx[..., None])         # [b, h, q, k]
+            num = num * corr[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v_cur
+            )
+            den = den * corr + p.sum(axis=-1)
         # rotate K/V to the next rank; we now hold the previous rank's block
         perm = [(i, (i + 1) % n) for i in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
