@@ -67,6 +67,8 @@ class MetricsAccumulator:
         counts: Dict[str, int] = defaultdict(int)
         for row in self._rows:
             for k, val in row.items():
+                if isinstance(val, str):  # e.g. wire_lowering label
+                    continue
                 sums[k] += val
                 counts[k] += 1
         return {k: sums[k] / counts[k] for k in sums}

@@ -576,3 +576,144 @@ def test_clip_norm_inactive_when_above_gradient_norm(mesh8):
 def test_clip_norm_negative_rejected():
     with pytest.raises(ValueError, match="clip_norm"):
         SGD(make_params(), lr=0.05, clip_norm=-1.0)
+
+
+# -- leader-mode wire lowering + accounting (VERDICT r3 item 9) ---------
+
+def test_leader_dense_scatter_matches_allgather_numerics(mesh8):
+    """int8 (wire ratio 4 < world 8) takes the dense_scatter lowering in
+    leader mode: decode-own-payload + reduce_scatter. Numerics must
+    equal the allgather topology (psum(decode(own)) == decode_sum of
+    the gathered payloads, by decode_sum's definition)."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    a = SGD(params, mesh=mesh8, lr=0.05, code=get_codec("int8"))
+    b = SGD(params, mesh=mesh8, lr=0.05, mode="leader",
+            code=get_codec("int8"))
+    la, da = a.step(loss_fn=quad_loss, batch=batch)
+    lb, db = b.step(loss_fn=quad_loss, batch=batch)
+    assert db["wire_lowering"] == "dense_scatter"
+    assert da["wire_lowering"] == "allgather"
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6
+        ),
+        a.params, b.params,
+    )
+
+
+def test_leader_payload_gather_for_sparse_and_accounting(mesh8):
+    """Strongly-compressing topk (ratio >= world) stays on
+    payload_gather; the accounting makes the PS-topology trade visible:
+    leader pays the param gather on top of the payload exchange
+    (documented in _leader_lowering), while a weakly-compressing codec's
+    dense_scatter receives less than its own payload_gather would.
+    Params must be big enough that topk-1% actually compresses past 8x
+    (on the 15-element make_params() the k>=1 floor makes topk WEAK and
+    dense_scatter correctly wins — that regime is the int8 test)."""
+    params = {"w": jax.random.normal(jax.random.key(0), (16, 8))}
+    k1, k2 = jax.random.split(jax.random.key(1))
+    batch = (jax.random.normal(k1, (64, 16)), jax.random.normal(k2, (64, 8)))
+
+    def loss(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    a = SGD(params, mesh=mesh8, lr=0.05, code=get_codec("topk", fraction=0.01))
+    b = SGD(params, mesh=mesh8, lr=0.05, mode="leader",
+            code=get_codec("topk", fraction=0.01))
+    la, da = a.step(loss_fn=loss, batch=batch)
+    lb, db = b.step(loss_fn=loss, batch=batch)
+    assert db["wire_lowering"] == "payload_gather"
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6
+        ),
+        a.params, b.params,
+    )
+    # analytic accounting: W=8, n = msg_bytes, p = packaged_bytes
+    w, n, p = 8, da["msg_bytes"], da["packaged_bytes"]
+    assert da["wire_bytes_per_worker"] == pytest.approx((w - 1) * p)
+    assert db["wire_bytes_per_worker"] == pytest.approx(
+        (w - 1) * p + (w - 1) / w * n
+    )
+    # the documented conclusion: for sparse codecs the leader topology
+    # moves MORE than allgather (params must come back); the ZeRO-1 win
+    # is update FLOPs + optimizer-state HBM, not wire
+    assert db["wire_bytes_per_worker"] > da["wire_bytes_per_worker"]
+    # weakly-compressing codec: dense_scatter receives less than its
+    # payload_gather form would have
+    c = SGD(params, mesh=mesh8, lr=0.05, mode="leader",
+            code=get_codec("int8"))
+    _, dc = c.step(loss_fn=loss, batch=batch)
+    pg_equiv = (w - 1) * dc["packaged_bytes"] + (w - 1) / w * dc["msg_bytes"]
+    assert dc["wire_bytes_per_worker"] < pg_equiv
+
+
+def test_wire_accounting_psum_paths(mesh8):
+    params = make_params()
+    batch = batch_for(mesh8)
+    w = 8
+    a = SGD(params, mesh=mesh8, lr=0.05)  # identity: fused psum
+    _, da = a.step(loss_fn=quad_loss, batch=batch)
+    assert da["wire_lowering"] == "psum"
+    assert da["wire_bytes_per_worker"] == pytest.approx(
+        2 * (w - 1) / w * da["msg_bytes"]
+    )
+    b = SGD(params, mesh=mesh8, lr=0.05, mode="leader")
+    _, db = b.step(loss_fn=quad_loss, batch=batch)
+    assert db["wire_lowering"] == "psum_scatter"
+    assert db["wire_bytes_per_worker"] == pytest.approx(
+        (w - 1) / w * 2 * db["msg_bytes"]
+    )
+    # comm_dtype halves the collective's share of the bytes
+    c = SGD(params, mesh=mesh8, lr=0.05, comm_dtype=jnp.bfloat16)
+    _, dc = c.step(loss_fn=quad_loss, batch=batch)
+    assert dc["wire_bytes_per_worker"] == pytest.approx(
+        2 * (w - 1) / w * dc["msg_bytes"] / 2
+    )
+
+
+def test_wire_accounting_dtype_rules(mesh8):
+    """The accounting must mirror the COMPILED collective's wire dtype
+    rules: a non-psum codec's wire_dtype (f16) is excluded from on-chip
+    collectives, so leader+f16 dense_scatter moves (and reports) full
+    f32; comm_dtype=bf16 both narrows the dense scatter AND can flip the
+    lowering decision in the ratio band where f32-dense loses to
+    payloads but bf16-dense wins."""
+    w = 8
+    params = {"w": jax.random.normal(jax.random.key(0), (16, 8))}
+    k1, k2 = jax.random.split(jax.random.key(1))
+    batch = (jax.random.normal(k1, (64, 16)), jax.random.normal(k2, (64, 8)))
+
+    def loss(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    # f16 codec (non-psum): scatter runs f32 (comm_dtype None) and the
+    # report must say so — frac * (n + n), not frac * (n/2 + n)
+    a = SGD(params, mesh=mesh8, lr=0.05, mode="leader",
+            code=get_codec("f16"))
+    _, da = a.step(loss_fn=loss, batch=batch)
+    n = da["msg_bytes"]
+    assert da["wire_lowering"] == "dense_scatter"
+    assert da["wire_bytes_per_worker"] == pytest.approx((w - 1) / w * 2 * n)
+
+    # topk with k=6 of 128 (p=48B, n=512B): f32 dense recv 448 == ...
+    # payload recv 336 < 448 -> payload_gather without comm_dtype...
+    b = SGD(params, mesh=mesh8, lr=0.05, mode="leader",
+            code=get_codec("topk", k=6))
+    _, db = b.step(loss_fn=loss, batch=batch)
+    assert db["wire_lowering"] == "payload_gather"
+    # ...but with a bf16 wire the dense scatter receives 224 < 336 and
+    # the selector must flip
+    c = SGD(params, mesh=mesh8, lr=0.05, mode="leader",
+            code=get_codec("topk", k=6), comm_dtype=jnp.bfloat16)
+    lc, dc = c.step(loss_fn=loss, batch=batch)
+    assert dc["wire_lowering"] == "dense_scatter"
+    assert dc["wire_bytes_per_worker"] == pytest.approx(
+        (w - 1) / w * (n / 2 + n)
+    )
+    assert np.isfinite(float(lc))
