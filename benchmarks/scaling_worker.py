@@ -1,0 +1,57 @@
+"""SPMD worker for ``scaling_bench.py``'s cross-process (DCN) point —
+NOT a pytest file. Launched 2x via ``pytorch_ps_mpi_tpu.launch`` with 4
+local CPU devices each: the global 8-device mesh spans a real process
+boundary, so the gradient psum crosses the distributed runtime the way
+a multi-host pod's DCN hop would (loopback here; same code path).
+
+Rank 0 prints one JSON row compatible with the in-process sweep's rows.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_ps_mpi_tpu import SGD
+    from pytorch_ps_mpi_tpu.mesh import make_mesh
+    from pytorch_ps_mpi_tpu.models import ResNet18
+
+    per_worker_batch = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+
+    world = len(jax.devices())
+    mesh = make_mesh()
+    model = ResNet18(num_classes=10, small_inputs=True)
+    batch = per_worker_batch * world
+    x = jax.random.normal(jax.random.key(1), (batch, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(2), (batch,), 0, 10)
+    params = jax.jit(model.init)(jax.random.key(0), x[:1])
+
+    def loss_fn(p, b):
+        xb, yb = b
+        logp = jax.nn.log_softmax(model.apply(p, xb))
+        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+
+    opt = SGD(params, mesh=mesh, lr=0.05, average=True)
+    opt.step(loss_fn=loss_fn, batch=(x, y))  # compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        opt.step(loss_fn=loss_fn, batch=(x, y))
+    wall = time.perf_counter() - t0
+    if jax.process_index() == 0:
+        print("SCALING_ROW " + json.dumps({
+            "workers": world,
+            "processes": jax.process_count(),
+            "per_worker_batch": per_worker_batch,
+            "steps_per_sec": round(steps / wall, 4),
+            "step_ms": round(1e3 * wall / steps, 2),
+        }), flush=True)
+    print(f"SCALING_WORKER_OK rank={jax.process_index()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
