@@ -78,10 +78,11 @@ def make_problem(world: int):
     y = jax.random.randint(jax.random.key(2), (batch,), 0, 10)
     params = jax.jit(model.init)(jax.random.key(0), x[:1])
 
+    from pytorch_ps_mpi_tpu.data import cross_entropy_loss
+
     def loss_fn(p, b):
         xb, yb = b
-        logp = jax.nn.log_softmax(model.apply(p, xb))
-        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+        return cross_entropy_loss(model.apply(p, xb), yb)
 
     opt = SGD(params, mesh=mesh, lr=0.05, average=True)
     return opt, loss_fn, (x, y)
@@ -187,7 +188,12 @@ def extrapolate(ici_gbytes: float) -> dict:
         newest_per_metric,
     )
 
-    newest = newest_per_metric(load_tpu_records(REPO))
+    # same physical-plausibility gate provenance's recall applies: a
+    # pre-RTT-correction watcher bug row (mfu >= 1) must never become
+    # the anchor of the committed efficiency prediction
+    records = [r for r in load_tpu_records(REPO)
+               if 0.0 < float(r.get("mfu", 0) or 0) < 1.0]
+    newest = newest_per_metric(records)
     anchor = newest.get("resnet18_train_step_b256_bf16_steps_per_sec")
     t_comp_ms = anchor.get("step_ms_device") if anchor else None
     wire_bytes = resnet18_param_count() * 2  # bf16 wire (comm_dtype)
@@ -226,7 +232,6 @@ def main():
                          "measurement)")
     args = ap.parse_args()
 
-    rows = []
     base = None
     for world in (1, 2, 4, 8):
         row = run_world(world, args.steps)
@@ -239,7 +244,6 @@ def main():
             "transferable column"
         ) if world > 1 else "baseline"
         print(json.dumps(row), flush=True)
-        rows.append(row)
 
     if not args.skip_dcn:
         dcn = run_dcn_point(args.steps)
@@ -250,7 +254,6 @@ def main():
                     dcn["steps_per_sec"] / base, 4
                 )
             print(json.dumps(dcn), flush=True)
-            rows.append(dcn)
 
     print(json.dumps(extrapolate(args.ici_gbytes)), flush=True)
 

@@ -31,10 +31,11 @@ def main():
     y = jax.random.randint(jax.random.key(2), (batch,), 0, 10)
     params = jax.jit(model.init)(jax.random.key(0), x[:1])
 
+    from pytorch_ps_mpi_tpu.data import cross_entropy_loss
+
     def loss_fn(p, b):
         xb, yb = b
-        logp = jax.nn.log_softmax(model.apply(p, xb))
-        return -jnp.mean(jnp.take_along_axis(logp, yb[:, None], axis=1))
+        return cross_entropy_loss(model.apply(p, xb), yb)
 
     opt = SGD(params, mesh=mesh, lr=0.05, average=True)
     opt.step(loss_fn=loss_fn, batch=(x, y))  # compile
