@@ -83,7 +83,7 @@ class SelfAttention(nn.Module):
             )
 
             l = q.shape[1]
-            if c.attention == "flash" and not flash_supported(l, l):
+            if c.attention == "flash" and not flash_supported(l, l, dtype=c.dtype):
                 # the explicit mode must fail loudly, not silently hand
                 # an f32 dense fallback to a 'flash'-labeled A/B
                 raise ValueError(
@@ -93,7 +93,7 @@ class SelfAttention(nn.Module):
                 )
             use_kernel = c.attention == "flash" or (
                 c.attention == "full"
-                and flash_supported(l, l)
+                and flash_supported(l, l, dtype=c.dtype)
                 and mosaic_lowering_ok(head_dim, c.dtype, l)
             )
             if use_kernel:

@@ -55,13 +55,19 @@ _MASKED = -1e30        # additive mask value
 _MASK_THRESH = -1e29   # "this score was masked" test (real scores are tiny)
 
 
-def _pick_block(length: int, target: int) -> Optional[int]:
+def _pick_block(length: int, target: int, min_block: int = 8) -> Optional[int]:
     """Largest power-of-two block <= target that divides ``length``
-    (>= 8, the f32 sublane); None if the length cannot tile."""
+    (>= ``min_block``: 8 = the f32 sublane; bf16 tiles need 16);
+    None if the length cannot tile."""
     b = 1
     while b * 2 <= min(target, length) and length % (b * 2) == 0:
         b *= 2
-    return b if b >= 8 and length % b == 0 else None
+    return b if b >= min_block and length % b == 0 else None
+
+
+def _min_block_for(dtype) -> int:
+    """Minimal sublane tile for the dtype (f32: 8, bf16/f16: 16)."""
+    return 16 if jnp.dtype(dtype).itemsize < 4 else 8
 
 
 # ---------------------------------------------------------------------------
@@ -375,8 +381,9 @@ def flash_attention(
     q_offset = jnp.zeros((), jnp.int32) if q_offset is None else q_offset
     k_offset = jnp.zeros((), jnp.int32) if k_offset is None else k_offset
 
-    bq = _pick_block(lq, block_q)
-    bk = _pick_block(lk, block_k)
+    mb = _min_block_for(q.dtype)
+    bq = _pick_block(lq, block_q, mb)
+    bk = _pick_block(lk, block_k, mb)
     if bq is None or bk is None:
         out, lse = _attention_jnp(q, k, v, q_offset, k_offset, causal, scale)
         return (out, lse) if return_lse else out
@@ -395,10 +402,12 @@ def flash_attention(
 
 
 def flash_supported(lq: int, lk: int, block_q: int = 128,
-                    block_k: int = 128) -> bool:
-    """Can the tiled kernel serve these sequence lengths?"""
-    return (_pick_block(lq, block_q) is not None
-            and _pick_block(lk, block_k) is not None)
+                    block_k: int = 128, dtype=jnp.float32) -> bool:
+    """Can the tiled kernel serve these sequence lengths (at this
+    dtype's minimal sublane tile)?"""
+    mb = _min_block_for(dtype)
+    return (_pick_block(lq, block_q, mb) is not None
+            and _pick_block(lk, block_k, mb) is not None)
 
 
 def mosaic_lowering_ok(head_dim: int = 64, dtype=jnp.bfloat16,

@@ -65,7 +65,7 @@ def ring_attention(
         )
 
         use_flash = (jax.default_backend() == "tpu"
-                     and flash_supported(l_q, l_k)
+                     and flash_supported(l_q, l_k, dtype=q.dtype)
                      and mosaic_lowering_ok(d, q.dtype, l_q))
 
     q_pos = my_idx * l_q + jnp.arange(l_q)            # global query positions
