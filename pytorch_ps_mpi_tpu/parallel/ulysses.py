@@ -67,6 +67,7 @@ def ulysses_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
 ) -> jax.Array:
     """Full-sequence attention under Ulysses sequence parallelism.
 
@@ -77,6 +78,10 @@ def ulysses_attention(
       causal: standard causal mask (global coordinates are naturally
         correct here — every device sees the full sequence).
       scale: logit scale; default ``head_dim ** -0.5``.
+      use_flash: run the post-exchange local attention through the
+        Pallas flash kernel (this is exactly Ulysses' selling point —
+        "the plain fused attention kernel unchanged"). Default: auto
+        (kernel on TPU when the full sequence tiles).
 
     Returns ``[batch, seq_local, heads, head_dim]``.
     """
@@ -96,11 +101,25 @@ def ulysses_attention(
     )                                                   # [3b, L, h_loc, d]
     b = q.shape[0]
     qh, kh, vh = qkv[:b], qkv[b:2 * b], qkv[2 * b:]
-    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
-    if causal:
-        l_full = qh.shape[1]
-        mask = jnp.tril(jnp.ones((l_full, l_full), bool))
-        s = jnp.where(mask[None, None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)         # [b, L, h_loc, d]
+    l_full = qh.shape[1]
+    if use_flash is None:
+        from pytorch_ps_mpi_tpu.ops.attention_pallas import (
+            flash_supported,
+            mosaic_lowering_ok,
+        )
+
+        use_flash = (jax.default_backend() == "tpu"
+                     and flash_supported(l_full, l_full, dtype=qh.dtype)
+                     and mosaic_lowering_ok(d, qh.dtype, l_full))
+    if use_flash:
+        from pytorch_ps_mpi_tpu.ops.attention_pallas import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+        if causal:
+            mask = jnp.tril(jnp.ones((l_full, l_full), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)     # [b, L, h_loc, d]
     return _heads_to_seq(out, axis_name)

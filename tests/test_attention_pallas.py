@@ -159,3 +159,42 @@ def test_bert_flash_mode_matches_full(mesh8):
     b = BertMLM(cfg_flash).apply(params, tokens)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=3e-5, atol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_local_attention_matches_dense(mesh8, causal):
+    """Ulysses with the flash kernel as its post-exchange local
+    attention == dense attention over the gathered sequence, gradients
+    included (Ulysses' whole pitch is reusing the fused kernel)."""
+    from pytorch_ps_mpi_tpu.parallel.ulysses import ulysses_attention
+
+    b, l, h, d = 2, 64, 8, 8  # heads divide the 8-way axis
+    ks = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (jax.random.normal(kk, (b, l, h, d)) for kk in ks)
+    ref, _ = _attention_jnp(q, k, v, 0, 0, causal, d ** -0.5)
+
+    def spmd(q, k, v):
+        return ulysses_attention(q, k, v, "data", causal=causal,
+                                 use_flash=True)
+
+    mapped = jax.jit(
+        jax.shard_map(
+            spmd, mesh=mesh8,
+            in_specs=(P(None, "data"),) * 3, out_specs=P(None, "data"),
+            check_vma=False,
+        )
+    )
+    out = mapped(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
+    # gradients through the kernel + both all_to_alls
+    gf = jax.grad(lambda *a: jnp.sum(mapped(*a) ** 2), (0, 1, 2))(q, k, v)
+    gj = jax.grad(
+        lambda q, k, v: jnp.sum(
+            _attention_jnp(q, k, v, 0, 0, causal, d ** -0.5)[0] ** 2
+        ),
+        (0, 1, 2),
+    )(q, k, v)
+    for a, bb in zip(gf, gj):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   rtol=1e-4, atol=1e-5)
