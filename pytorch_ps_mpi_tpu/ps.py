@@ -1153,13 +1153,52 @@ class MPI_PS:
             "rng_data": jax.random.key_data(self._rng),
         }
 
+    def _decommit_restored(self, tree: PyTree) -> PyTree:
+        """Make a restored checkpoint tree steppable on this mesh.
+
+        A restore can hand back arrays committed to the WRONG device set
+        (e.g. a single device from the numpy fallback, or a stale
+        sharding), which the compiled shard_map step rejects. Leaves
+        already committed to exactly this mesh's devices (the common
+        orbax case — StandardRestore with a correctly-sharded template,
+        incl. ZeRO-1's sharded opt_state) are kept as-is, zero copies;
+        everything else is gathered to host numpy in ONE batched
+        ``jax.device_get`` (uncommitted, so the next step reshards it)."""
+        mesh_devs = set(self.mesh.devices.flat)
+        leaves, treedef = jax.tree.flatten(tree)
+
+        def keeps(x):
+            if not hasattr(x, "ndim"):
+                return True  # python scalar
+            devs = getattr(x, "devices", None)
+            if devs is None:
+                return True  # host numpy already
+            try:
+                return set(devs()) == mesh_devs
+            except Exception:
+                return False
+
+        flags = [keeps(l) for l in leaves]
+        fetched = iter(jax.device_get(
+            [l for l, k in zip(leaves, flags) if not k]
+        ))
+        out = [l if k else next(fetched) for l, k in zip(leaves, flags)]
+        return jax.tree.unflatten(treedef, out)
+
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
-        self.params = sd["params"]
-        self.opt_state = type(self.opt_state)(*sd["opt_state"])
-        self.codec_state = sd["codec_state"]
-        self.aux_state = sd.get("aux_state")
+        self.params = self._decommit_restored(sd["params"])
+        self.opt_state = type(self.opt_state)(
+            *self._decommit_restored(tuple(sd["opt_state"]))
+        )
+        self.codec_state = self._decommit_restored(sd["codec_state"])
+        self.aux_state = self._decommit_restored(sd.get("aux_state"))
         self._step_count = int(sd["step_count"])
-        self._rng = jax.random.wrap_key_data(jnp.asarray(sd["rng_data"]))
+        # rng too: a restored key committed to the restore sharding would
+        # commit every subsequent step's rng arg and poison jit's device
+        # resolution against uncommitted batches
+        self._rng = jax.random.wrap_key_data(
+            jnp.asarray(np.asarray(sd["rng_data"]))
+        )
 
     def run_steps(
         self, loss_fn: Callable, batches: PyTree, *, unroll: int = 1

@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pytorch_ps_mpi_tpu.ps import MPI_PS
 from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
@@ -92,12 +93,10 @@ class Trainer:
                 file=sys.stderr,
             )
             return False
-        # restored arrays may come back committed to a single device;
-        # rehost to numpy so the jitted step re-shards them over the mesh
-        import numpy as np
-
-        state = jax.tree.map(np.asarray, state)
-        self.step_count = int(state.pop("trainer_step"))
+        # device placement of restored leaves is load_state_dict's job
+        # (MPI_PS._decommit_restored keeps correctly-sharded restores,
+        # rehosts the rest)
+        self.step_count = int(np.asarray(state.pop("trainer_step")))
         state.setdefault("aux_state", None)
         self.opt.load_state_dict(state)
         return True
