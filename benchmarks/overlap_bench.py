@@ -119,10 +119,20 @@ def main() -> None:
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + base_flags +
                             " " + flag).strip()
         env["OVERLAP_FORCE_CPU"] = force_cpu
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child", label],
-            env=env, capture_output=True, text=True, timeout=1800, cwd=REPO,
-        )
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", label],
+                env=env, capture_output=True, text=True, timeout=1800,
+                cwd=REPO,
+            )
+        except subprocess.TimeoutExpired as e:
+            line = {"metric": "resnet18_dp_step_comm_compute_overlap",
+                    "scheduler_flag": label,
+                    "error": f"timeout after 1800s: "
+                             f"{str(e.stdout or '')[-200:]}"}
+            print(json.dumps(line), flush=True)
+            rows.append(line)
+            continue
         line = None
         for ln in out.stdout.splitlines():
             try:

@@ -188,11 +188,14 @@ def extrapolate(ici_gbytes: float) -> dict:
         newest_per_metric,
     )
 
-    # same physical-plausibility gate provenance's recall applies: a
-    # pre-RTT-correction watcher bug row (mfu >= 1) must never become
-    # the anchor of the committed efficiency prediction
+    # drop errored rows and physically-impossible mfu (>= 1, the
+    # pre-RTT-correction watcher bug) — but KEEP mfu == 0.0, which just
+    # means the device's peak FLOPs table had no entry; the anchor needs
+    # step_ms_device, not mfu
     records = [r for r in load_tpu_records(REPO)
-               if 0.0 < float(r.get("mfu", 0) or 0) < 1.0]
+               if "error" not in r
+               and float(r.get("mfu", 0) or 0) < 1.0
+               and r.get("step_ms_device")]
     newest = newest_per_metric(records)
     anchor = newest.get("resnet18_train_step_b256_bf16_steps_per_sec")
     t_comp_ms = anchor.get("step_ms_device") if anchor else None

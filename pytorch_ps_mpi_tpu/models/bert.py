@@ -78,8 +78,8 @@ class SelfAttention(nn.Module):
             # benchmarks/bert_bench.py).
             from pytorch_ps_mpi_tpu.ops.attention_pallas import (
                 flash_attention,
+                flash_auto_ok,
                 flash_supported,
-                mosaic_lowering_ok,
             )
 
             l = q.shape[1]
@@ -92,9 +92,7 @@ class SelfAttention(nn.Module):
                     "for automatic fallback"
                 )
             use_kernel = c.attention == "flash" or (
-                c.attention == "full"
-                and flash_supported(l, l, dtype=c.dtype)
-                and mosaic_lowering_ok(head_dim, c.dtype, l)
+                c.attention == "full" and flash_auto_ok(l, l, head_dim, c.dtype)
             )
             if use_kernel:
                 out = flash_attention(q, k, v, causal=c.causal)

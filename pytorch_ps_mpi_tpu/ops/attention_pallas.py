@@ -413,14 +413,17 @@ def flash_supported(lq: int, lk: int, block_q: int = 128,
 def mosaic_lowering_ok(head_dim: int = 64, dtype=jnp.bfloat16,
                        seq: int = 128) -> bool:
     """Cached compile probe: does this backend's Mosaic lower the kernel
-    for THIS head_dim/dtype (the parameters tiling actually depends on)?
-    Gates the AUTO dispatches ('full' attention, ring's default) so a
-    lowering regression degrades to the dense path instead of breaking
-    every TPU bench/model; the explicit 'flash' mode stays ungated and
-    fails loudly. The probe sequence is clamped small — lowering failures
-    are shape-class properties (dtype tiling, lane-dim head size), not
-    length properties."""
-    bq = _pick_block(seq, 128)
+    family for THIS head_dim/dtype (the parameters tiling actually
+    depends on)? Probes the CAUSAL forward AND the backward pass (grad
+    compiles all three kernels — dq and dk/dv lower independently and
+    can regress independently). Gates the AUTO dispatches ('full'
+    attention, ring/ulysses defaults) so a lowering regression degrades
+    to the dense path instead of breaking every TPU bench/model; the
+    explicit 'flash' mode stays ungated and fails loudly. The probe
+    sequence is clamped small — lowering failures are shape-class
+    properties (dtype tiling, lane-dim head size), not length
+    properties."""
+    bq = _pick_block(seq, 128, _min_block_for(dtype))
     return _lowering_probe(int(head_dim), jnp.dtype(dtype).name,
                            2 * (bq or 64))
 
@@ -431,7 +434,22 @@ def _lowering_probe(head_dim: int, dtype_name: str, seq: int) -> bool:
         return False
     try:
         q = jnp.zeros((1, min(seq, 256), 1, head_dim), dtype_name)
-        jax.jit(lambda x: flash_attention(x, x, x)).lower(q).compile()
+
+        def loss(x):
+            return jnp.sum(
+                flash_attention(x, x, x, causal=True).astype(jnp.float32)
+            )
+
+        jax.jit(jax.grad(loss)).lower(q).compile()
         return True
     except Exception:
         return False
+
+
+def flash_auto_ok(lq: int, lk: int, head_dim: int, dtype) -> bool:
+    """The ONE auto-dispatch gate every attention entry point (BERT
+    'full', ring, ulysses) consults: shapes tile at this dtype AND the
+    Mosaic probe (fwd+bwd, causal) compiles. Off-TPU the probe is False,
+    so no separate backend check is needed."""
+    return (flash_supported(lq, lk, dtype=dtype)
+            and mosaic_lowering_ok(head_dim, dtype, lq))

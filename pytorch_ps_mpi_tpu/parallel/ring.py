@@ -59,14 +59,9 @@ def ring_attention(
     if scale is None:
         scale = d ** -0.5
     if use_flash is None:
-        from pytorch_ps_mpi_tpu.ops.attention_pallas import (
-            flash_supported,
-            mosaic_lowering_ok,
-        )
+        from pytorch_ps_mpi_tpu.ops.attention_pallas import flash_auto_ok
 
-        use_flash = (jax.default_backend() == "tpu"
-                     and flash_supported(l_q, l_k, dtype=q.dtype)
-                     and mosaic_lowering_ok(d, q.dtype, l_q))
+        use_flash = flash_auto_ok(l_q, l_k, d, q.dtype)
 
     q_pos = my_idx * l_q + jnp.arange(l_q)            # global query positions
 

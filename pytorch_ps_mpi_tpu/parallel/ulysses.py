@@ -103,14 +103,9 @@ def ulysses_attention(
     qh, kh, vh = qkv[:b], qkv[b:2 * b], qkv[2 * b:]
     l_full = qh.shape[1]
     if use_flash is None:
-        from pytorch_ps_mpi_tpu.ops.attention_pallas import (
-            flash_supported,
-            mosaic_lowering_ok,
-        )
+        from pytorch_ps_mpi_tpu.ops.attention_pallas import flash_auto_ok
 
-        use_flash = (jax.default_backend() == "tpu"
-                     and flash_supported(l_full, l_full, dtype=qh.dtype)
-                     and mosaic_lowering_ok(d, qh.dtype, l_full))
+        use_flash = flash_auto_ok(l_full, l_full, d, qh.dtype)
     if use_flash:
         from pytorch_ps_mpi_tpu.ops.attention_pallas import flash_attention
 
