@@ -772,6 +772,27 @@ class MPI_PS:
         timer = time.perf_counter
         loss = None
 
+        # the staged pipeline's collective topology differs from the
+        # fused lowering _schema_dict describes (it always full-psums or
+        # payload-gathers; never the dense/psum scatter): relabel so the
+        # reported bytes match the comm_wait actually measured
+        w, frac = self.size, (self.size - 1) / self.size
+        n = float(_tree_bytes(self.params))
+        if self.code.supports_psum:
+            wire_dt = self.comm_dtype if self.comm_dtype is not None else (
+                getattr(self.code, "wire_dtype", None)
+            )
+            data["wire_lowering"] = "psum_staged"
+            data["wire_bytes_per_worker"] = 2 * frac * self._tree_wire_bytes(
+                wire_dt
+            )
+        else:
+            data["wire_lowering"] = "payload_gather_staged"
+            data["wire_bytes_per_worker"] = (w - 1) * self._payload_bytes
+        if self.mode == "leader":
+            # the staged update stage all_gathers the sharded params back
+            data["wire_bytes_per_worker"] += frac * n
+
         if accum_steps:
             t0 = timer()
             loss, grads = stages["grad"](self.params, microbatches)

@@ -762,3 +762,25 @@ def test_state_dict_checkpoint_resume_bit_exact(mesh8, tmp_path):
         ),
         a.codec_state, b.codec_state,
     )
+
+
+def test_instrumented_wire_labels_match_staged_topology(mesh8):
+    """instrument=True runs a staged pipeline whose collective topology
+    differs from the fused lowering; the reported wire fields must
+    describe what was MEASURED (a reader pairs them with comm_wait)."""
+    params = make_params()
+    batch = batch_for(mesh8)
+    a = SGD(params, mesh=mesh8, lr=0.05, instrument=True,
+            code=get_codec("int8"), mode="leader")
+    _, da = a.step(loss_fn=quad_loss, batch=batch)
+    w, n, p = 8, da["msg_bytes"], da["packaged_bytes"]
+    assert da["wire_lowering"] == "payload_gather_staged"
+    assert da["wire_bytes_per_worker"] == pytest.approx(
+        (w - 1) * p + (w - 1) / w * n
+    )
+    b = SGD(params, mesh=mesh8, lr=0.05, instrument=True)
+    _, db = b.step(loss_fn=quad_loss, batch=batch)
+    assert db["wire_lowering"] == "psum_staged"
+    assert db["wire_bytes_per_worker"] == pytest.approx(
+        2 * (w - 1) / w * db["msg_bytes"]
+    )
