@@ -60,14 +60,20 @@ from pytorch_ps_mpi_tpu import Adam
 REPS = 10
 
 
-def bench_mode(mode: str, params, grads) -> tuple[float, int]:
-    opt = Adam(params, lr=1e-3, mode=mode)
+def bench_mode(mode: str, params, grads, code=None):
+    """Returns (min step seconds, per-device state bytes, lowering)."""
+    opt = Adam(params, lr=1e-3, mode=mode, code=code)
     opt.step(grads=grads)  # compile
     times = []
     for _ in range(REPS):
         t0 = time.perf_counter()
-        opt.step(grads=grads)
+        _, data = opt.step(grads=grads)
         times.append(time.perf_counter() - t0)
+    if code is not None:
+        print(f"  [{mode}+{type(code).__name__}] lowering="
+              f"{data['wire_lowering']} "
+              f"wire_bytes/worker={data['wire_bytes_per_worker']/1e6:.1f}MB",
+              flush=True)
     # per-device optimizer-state bytes: leader's moments are sharded over
     # the mesh, allgather's replicated on every device
     state_elems = sum(
@@ -75,7 +81,7 @@ def bench_mode(mode: str, params, grads) -> tuple[float, int]:
     )
     world = opt.size
     per_device_state = state_elems * 4 // (world if mode == "leader" else 1)
-    return min(times), per_device_state
+    return min(times), per_device_state, data["wire_lowering"]
 
 
 def main():
@@ -90,8 +96,20 @@ def main():
         for i, (name, p) in enumerate(params.items())
     }
 
-    t_all, mem_all = bench_mode("allgather", params, grads)
-    t_lead, mem_lead = bench_mode("leader", params, grads)
+    t_all, mem_all, _ = bench_mode("allgather", params, grads)
+    t_lead, mem_lead, _ = bench_mode("leader", params, grads)
+
+    # the round-4 lowering choice, measured: leader + a weakly-compressing
+    # codec (int8, ratio 4 < world 8) takes dense_scatter — decode own
+    # payload locally + reduce_scatter — instead of payload all-gather
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+
+    # the lowering is world-size dependent (dense_scatter needs ratio <
+    # world): key the JSON field by what actually compiled
+    t_ds, _, ds_lowering = bench_mode("leader", params, grads,
+                                      code=get_codec("int8"))
+    t_ag_codec, _, _ = bench_mode("allgather", params, grads,
+                                  code=get_codec("int8"))
 
     print(f"backend={jax.default_backend()} world={world} n={n}")
     print("| mode | step ms | adam state bytes/device |")
@@ -109,6 +127,8 @@ def main():
                 "leader_step_ms": round(t_lead * 1e3, 3),
                 "allgather_step_ms": round(t_all * 1e3, 3),
                 "state_bytes_per_device_ratio": mem_all / mem_lead,
+                f"leader_int8_{ds_lowering}_step_ms": round(t_ds * 1e3, 3),
+                "allgather_int8_step_ms": round(t_ag_codec * 1e3, 3),
             }
         )
     )
