@@ -192,6 +192,7 @@ def codec_table(n_params: int, measure: bool):
         ("blocktopk-1%", "blocktopk", {"fraction": 0.01}),
         ("blocktopk-1%-4k", "blocktopk", {"fraction": 0.01,
                                           "block_size": 4096}),
+        ("blocktopk8-1%", "blocktopk8", {"fraction": 0.01}),
         ("randomk-1%", "randomk", {"fraction": 0.01}),
         ("threshold", "threshold", {"tau": 2.0, "max_fraction": 0.05}),
         ("powersgd-r4", "powersgd", {"rank": 4}),

@@ -32,6 +32,7 @@ CODECS = [  # (label, registry name, kwargs)
     # the VERDICT r3 item-2 answer: per-block selection, no global sort
     ("blocktopk", "blocktopk", {"fraction": 0.01}),
     ("blocktopk-4k", "blocktopk", {"fraction": 0.01, "block_size": 4096}),
+    ("blocktopk8", "blocktopk8", {"fraction": 0.01}),
     ("randomk", "randomk", {"fraction": 0.01}),
     ("powersgd", "powersgd", {"rank": 4}),
     ("threshold", "threshold", {"tau": 2.0, "max_fraction": 0.05}),

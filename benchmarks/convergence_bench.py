@@ -45,6 +45,7 @@ CODECS = [  # (label, name, kwargs, lr) — lr tuned per codec family:
     ("sign", "sign", {"use_pallas": False}, 0.02),
     ("topk-25%", "topk", {"fraction": 0.25}, 0.1),
     ("blocktopk-25%", "blocktopk", {"fraction": 0.25, "block_size": 128}, 0.1),
+    ("blocktopk8-25%", "blocktopk8", {"fraction": 0.25, "block_size": 128}, 0.1),
     ("randomk-25%", "randomk", {"fraction": 0.25}, 0.1),
     ("powersgd-r4", "powersgd", {"rank": 4}, 0.1),
     ("threshold", "threshold", {"tau": 1.0, "max_fraction": 0.5}, 0.1),

@@ -14,7 +14,7 @@ from pytorch_ps_mpi_tpu.codecs.base import Codec, get_codec, register_codec
 from pytorch_ps_mpi_tpu.codecs.identity import IdentityCodec
 from pytorch_ps_mpi_tpu.codecs.cast import Bf16Codec, F16Codec
 from pytorch_ps_mpi_tpu.codecs.topk import TopKCodec
-from pytorch_ps_mpi_tpu.codecs.blocktopk import BlockTopKCodec
+from pytorch_ps_mpi_tpu.codecs.blocktopk import BlockTopK8Codec, BlockTopKCodec
 from pytorch_ps_mpi_tpu.codecs.threshold import ThresholdCodec
 from pytorch_ps_mpi_tpu.codecs.randomk import RandomKCodec
 from pytorch_ps_mpi_tpu.codecs.quant import Int8Codec, QSGDCodec
@@ -32,6 +32,7 @@ __all__ = [
     "F16Codec",
     "TopKCodec",
     "BlockTopKCodec",
+    "BlockTopK8Codec",
     "ThresholdCodec",
     "RandomKCodec",
     "Int8Codec",

@@ -53,15 +53,21 @@ class BlockTopKCodec(TopKCodec):
     def _block_k(self) -> int:
         return max(1, int(round(self.block_size * self.fraction)))
 
+    def _n_blocks(self, n: int) -> int:
+        """Block count for an n-element gradient; 1 == the single-block
+        plain-top-k fallback regime. The ONE place the fallback
+        threshold and ceil-div rule live (four call sites)."""
+        return 1 if n <= self.block_size else -(-n // self.block_size)
+
     def _k_for(self, shape) -> int:
         """Total payload length: per-block k x number of blocks (the
         wire-size contract ``payload_bits`` inherits). Tensors no larger
         than one block take plain top-k's fraction-of-n (matching the
         ``encode`` fallback)."""
         n = int(np.prod(shape)) if shape else 1
-        if n <= self.block_size:
+        nb = self._n_blocks(n)
+        if nb == 1:
             return super()._k_for(shape)
-        nb = -(-n // self.block_size)
         # NOT capped at n: a ragged tail block still emits block_k pairs
         # (pad-slot picks carry out-of-range indices, dropped at scatter),
         # and the wire carries every one of them
@@ -70,9 +76,9 @@ class BlockTopKCodec(TopKCodec):
     def encode(self, grad, state=(), rng=None):
         flat = grad.reshape(-1)
         n = flat.shape[0]
-        if n <= self.block_size:
-            return super().encode(grad, state, rng)  # one block: plain top-k
-        nb = -(-n // self.block_size)
+        nb = self._n_blocks(n)
+        if nb == 1:
+            return super().encode(grad, state, rng)  # plain top-k
         pad = nb * self.block_size - n
         # padding must never win selection, and if a short final block
         # still selects a padded slot its global index lands >= n and is
@@ -95,3 +101,60 @@ class BlockTopKCodec(TopKCodec):
     # decode/decode_sum are inherited: TopKCodec scatters with
     # mode='drop', which discards this codec's >= n pad-slot indices and
     # is a no-op for plain top-k's always-in-range ones
+
+
+@register_codec("blocktopk8")
+class BlockTopK8Codec(BlockTopKCodec):
+    """Compressed-sparse: blockwise top-k survivors with int8-quantized
+    values (per-block symmetric scale). The two compression axes the
+    reference's codings research explored separately — sparsification
+    and quantization — composed: at fraction 1% the wire drops from
+    top-k's 64 bits/survivor (f32 value + int32 index) to 40
+    (int8 value + int32 index), ~1.6x less wire for one extra
+    VPU-elementwise pass; selection cost is unchanged (same per-block
+    ``top_k``). Survivors within a block share magnitude order (they ARE
+    the block's largest), so a per-block scale loses little precision.
+    Pair with ``ef`` to absorb the combined bias, as with any lossy
+    codec."""
+
+    def encode(self, grad, state=(), rng=None):
+        payload, state = super().encode(grad, state, rng)
+        v = payload["values"]  # [k_total] f32 (single-block: plain top-k)
+        kb = v.shape[0] if self._n_blocks(grad.size) == 1 else self._block_k()
+        blocks = v.reshape(-1, kb)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0, 1e-12
+        )
+        q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+        return {
+            "values": q.reshape(-1),
+            "scale": scale.astype(jnp.float32),
+            "indices": payload["indices"],
+        }, state
+
+    @staticmethod
+    def _dequant(payload, dtype):
+        """int8 [.., k_total] x scale [.., nb, 1] -> float [.., k_total]
+        (leading worker axis preserved for decode_sum's stacked form)."""
+        q = payload["values"]
+        nb = payload["scale"].shape[-2]
+        blocks = q.reshape(q.shape[:-1] + (nb, -1)).astype(jnp.float32)
+        return (blocks * payload["scale"]).reshape(q.shape).astype(dtype)
+
+    def decode(self, payload, shape, dtype):
+        return super().decode(
+            {"values": self._dequant(payload, dtype),
+             "indices": payload["indices"]},
+            shape, dtype,
+        )
+
+    def decode_sum(self, payloads, shape, dtype):
+        return super().decode_sum(
+            {"values": self._dequant(payloads, dtype),
+             "indices": payloads["indices"]},
+            shape, dtype,
+        )
+
+    def payload_bits(self, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        return self._k_for(shape) * (8 + 32) + self._n_blocks(n) * 32

@@ -554,3 +554,46 @@ def test_blocktopk_payload_bits_counts_emitted_pairs():
     # and the decode still reconstructs only real coordinates
     out = code.decode(p, g.shape, g.dtype)
     assert out.shape == g.shape
+
+
+def test_blocktopk8_quantized_sparse_roundtrip_and_wire():
+    """Compressed-sparse: survivors match blocktopk's selection with
+    int8 precision (error <= scale/2 per block), at 40 bits/survivor."""
+    from pytorch_ps_mpi_tpu.codecs import BlockTopK8Codec, BlockTopKCodec
+
+    n = 4096
+    g = grad((n,), seed=8)
+    c8 = BlockTopK8Codec(fraction=0.01, block_size=1024)
+    cf = BlockTopKCodec(fraction=0.01, block_size=1024)
+    out8 = roundtrip(c8, g)
+    outf = roundtrip(cf, g)
+    # same support
+    np.testing.assert_array_equal(np.asarray(out8 != 0), np.asarray(outf != 0))
+    # values within the per-block quantization step
+    p, _ = c8.encode(g, c8.init_state(g.shape, g.dtype))
+    max_step = float(p["scale"].max())
+    err = np.abs(np.asarray(out8) - np.asarray(outf)).max()
+    assert err <= max_step / 2 + 1e-7
+    # wire: 4 blocks x 10 survivors x 40 bits + 4 scales
+    assert c8.payload_bits(g.shape, g.dtype) == 40 * 40 + 4 * 32
+    assert c8.payload_bits(g.shape, g.dtype) < cf.payload_bits(g.shape, g.dtype)
+
+
+def test_blocktopk8_decode_sum_and_single_block():
+    from pytorch_ps_mpi_tpu.codecs import BlockTopK8Codec
+
+    c8 = BlockTopK8Codec(fraction=0.1, block_size=128)
+    # single block (n <= block_size): quantized plain top-k
+    g = grad((96,), seed=9)
+    out = roundtrip(c8, g)
+    assert int(np.count_nonzero(np.asarray(out))) == round(96 * 0.1)
+    assert c8.payload_bits(g.shape, g.dtype) == round(96 * 0.1) * 40 + 32
+    # stacked decode_sum == sum of decodes
+    g2 = grad((512,), seed=10)
+    st = c8.init_state(g2.shape, g2.dtype)
+    p1, _ = c8.encode(g2, st)
+    p2, _ = c8.encode(-g2, st)
+    stacked = jax.tree.map(lambda a, b: jnp.stack([a, b]), p1, p2)
+    s = c8.decode_sum(stacked, g2.shape, g2.dtype)
+    ref = c8.decode(p1, g2.shape, g2.dtype) + c8.decode(p2, g2.shape, g2.dtype)
+    np.testing.assert_allclose(np.asarray(s), np.asarray(ref), rtol=1e-6)

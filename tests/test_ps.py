@@ -115,6 +115,7 @@ def test_average_flag(mesh8):
 @pytest.mark.parametrize("codec_name,kw", [
     ("topk", {"fraction": 0.5}),
     ("blocktopk", {"fraction": 0.5, "block_size": 128}),
+    ("blocktopk8", {"fraction": 0.5, "block_size": 128}),
     ("int8", {"use_pallas": False}),
     ("sign", {}),
     ("randomk", {"fraction": 0.5}),
