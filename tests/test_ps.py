@@ -785,3 +785,41 @@ def test_instrumented_wire_labels_match_staged_topology(mesh8):
     assert db["wire_bytes_per_worker"] == pytest.approx(
         2 * (w - 1) / w * db["msg_bytes"]
     )
+
+
+@pytest.mark.parametrize("mode,codec,kw,expect_lowering", [
+    ("leader", "int8", {}, "dense_scatter"),
+    ("leader", "blocktopk8", {"fraction": 0.05, "block_size": 128},
+     "payload_gather"),
+    ("allgather", "blocktopk8", {"fraction": 0.05, "block_size": 128},
+     "allgather"),
+])
+def test_run_steps_composes_with_lowerings(mesh8, mode, codec, kw,
+                                           expect_lowering):
+    """The fused multi-step scan must equal the step loop under every
+    aggregation lowering and the compressed-sparse codec."""
+    params = {"w": jax.random.normal(jax.random.key(0), (16, 8))}
+
+    def loss(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    k1, k2 = jax.random.split(jax.random.key(1))
+    batch = (jax.random.normal(k1, (64, 16)), jax.random.normal(k2, (64, 8)))
+    n = 4
+    batches = (
+        jnp.broadcast_to(batch[0][None], (n,) + batch[0].shape),
+        jnp.broadcast_to(batch[1][None], (n,) + batch[1].shape),
+    )
+    a = SGD(params, mesh=mesh8, lr=0.05, mode=mode, code=get_codec(codec, **kw))
+    a.run_steps(loss, batches)
+    assert a._wire_accounting[0] == expect_lowering
+    b = SGD(params, mesh=mesh8, lr=0.05, mode=mode, code=get_codec(codec, **kw))
+    for _ in range(n):
+        b.step(loss_fn=loss, batch=batch)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6
+        ),
+        a.params, b.params,
+    )
