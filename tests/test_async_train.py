@@ -303,7 +303,10 @@ def test_gpt_causal_lm_over_async_wire():
         "codec": "bf16",
         "optim": "adam",
         "hyper": {"lr": 1e-2},
-        "steps": 40,
+        # 60 pushes/worker: enough Adam progress that arrival-order
+        # nondeterminism (the point of the async path) cannot flake the
+        # 0.85 convergence margin on a loaded host
+        "steps": 60,
     }
     from pytorch_ps_mpi_tpu.codecs import get_codec
     from pytorch_ps_mpi_tpu.parallel.async_train import make_problem, serve, spawn_worker
@@ -318,9 +321,9 @@ def test_gpt_causal_lm_over_async_wire():
     try:
         procs = [spawn_worker(name, i, cfg) for i in range(2)]
         _, m = serve(server, cfg, total_grads=0, total_received=total,
-                     timeout=240.0)
+                     timeout=420.0)
         for p in procs:
-            assert p.wait(timeout=120) == 0
+            assert p.wait(timeout=240) == 0
     finally:
         server.close()
     assert m["grads_received"] == total
