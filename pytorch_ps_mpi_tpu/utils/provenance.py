@@ -57,6 +57,12 @@ def _records_from_jsonl_line(line: str, default_ts: str | None) -> Iterable[dict
         for inner in str(rec["stdout"]).splitlines():
             yield from _records_from_jsonl_line(inner, rec.get("ts", default_ts))
         return
+    if rec.get("replayed"):
+        # a replayed line is a COPY of an older measurement: if a
+        # CPU-fallback bench run's stdout gets wrapped into the watcher
+        # log, re-ingesting the copy with the wrapper's fresh timestamp
+        # would let a stale number masquerade as the newest (echo loop)
+        return
     if rec.get("backend") == "tpu":
         if "captured_by" not in rec and default_ts:
             rec["captured_by"] = f"watcher {default_ts}"

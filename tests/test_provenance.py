@@ -212,3 +212,30 @@ def test_real_repo_artifacts_yield_a_summary():
     assert "value" in summary and "unit" in summary
     assert summary.get("mfu", 0) > 0  # plausibility gate keeps it < 1.0
     assert summary.get("mfu", 1) < 1.0
+
+
+def test_replayed_lines_never_reingested(tmp_path):
+    """Echo-loop guard: a CPU-fallback bench's stdout (replayed TPU
+    copies) wrapped into the watcher log must NOT come back as fresh
+    records — the wrapper's new timestamp would crown a stale value
+    newest."""
+    root = _mk_repo(tmp_path)
+    stale_copy = {
+        "metric": "resnet18_11M_grad_aggregation_sgd_update_ms",
+        "value": 1.5,  # the OLD 07-29 number
+        "backend": "tpu",
+        "replayed": True,
+        "provenance": "watcher 2026-07-29T10:00:00",
+    }
+    _write(
+        os.path.join(root, "BENCH_TPU_WATCH.jsonl"),
+        [
+            {"stage": "bench", "status": "ok",
+             "ts": "2026-07-31T09:00:00",  # newest wrapper timestamp
+             "stdout": json.dumps(stale_copy) + "\n"},
+        ],
+    )
+    newest = newest_per_metric(load_tpu_records(root))
+    agg = newest["resnet18_11M_grad_aggregation_sgd_update_ms"]
+    assert agg["value"] == 0.779  # the genuine 07-30 sweep still wins
+    assert not agg.get("replayed")
