@@ -140,18 +140,26 @@ def pallas_mosaic_smoke() -> str:
         err = float(jnp.max(jnp.abs(deq - x)))
         if err > float(scale) * 0.51:
             return f"fail: int8 round-trip err {err}"
-        # flash attention: Mosaic lowering + numerics vs the dense oracle
+        # flash attention: Mosaic lowering + numerics vs the dense oracle,
+        # in bf16 — the configuration the models actually run. Tolerance
+        # is bf16-scale: BOTH programs' matmuls ride the MXU at its native
+        # width, so they agree only to bf16 rounding (strict f32
+        # equivalence is covered by the CPU interpret-mode tests). >= 2
+        # heads so the flattened batch*heads dim exercises the real tile
+        # rule (bh == 1 made every block spec trivially legal and let a
+        # lowering regression through this very smoke once).
         from pytorch_ps_mpi_tpu.ops.attention_pallas import (
             _attention_jnp,
             flash_attention,
         )
 
         qa = jax.random.normal(jax.random.key(1), (1, 128, 2, 64),
-                               jnp.float32)
+                               jnp.bfloat16)
         fo = flash_attention(qa, qa, qa, causal=True)
         ro, _ = _attention_jnp(qa, qa, qa, 0, 0, True, 64 ** -0.5)
-        ferr = float(jnp.max(jnp.abs(fo - ro)))
-        if ferr > 2e-4:
+        ferr = float(jnp.max(jnp.abs(fo.astype(jnp.float32)
+                                     - ro.astype(jnp.float32))))
+        if ferr > 2e-2:
             return f"fail: flash-attention err {ferr}"
         return "ok (mosaic-compiled: quant, sign, flash-attention)"
     except Exception as e:  # lowering errors are exactly what we're probing

@@ -120,7 +120,13 @@ def _fwd_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _():
         l_safe = jnp.maximum(l_sc[:, :1], 1e-30)
         o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_sc[:, :1] + jnp.log(l_safe))[:, 0]
+        # lane-replicated write: lse rides as [bh, lq, LANE] so its block
+        # (1, bq, LANE) satisfies Mosaic's (8, 128) tile rule for ANY bh —
+        # a (1, bq) block over [bh, lq] only lowers when bh == 1, which is
+        # exactly the shape the old probe tested (see _lowering_probe)
+        lse_ref[0] = jnp.broadcast_to(
+            m_sc[:, :1] + jnp.log(l_safe), (lse_ref.shape[1], _LANE)
+        )
 
 
 def _fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
@@ -142,11 +148,11 @@ def _fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bq, _LANE), lambda i, j, kk: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, lq, _LANE), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -162,7 +168,8 @@ def _fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
 # ---------------------------------------------------------------------------
 
 def _recompute_p(q, k, lse_tile, q_start, k_start, causal, scale, bq, bk):
-    """p = exp(s - lse) with masked entries exactly zero."""
+    """p = exp(s - lse) with masked entries exactly zero.
+    ``lse_tile`` is a [bq, 1] column (lane 0 of the replicated ride)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -170,7 +177,7 @@ def _recompute_p(q, k, lse_tile, q_start, k_start, causal, scale, bq, bk):
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(cols <= rows, s, _MASKED)
-    return jnp.where(s > _MASK_THRESH, jnp.exp(s - lse_tile[:, None]), 0.0)
+    return jnp.where(s > _MASK_THRESH, jnp.exp(s - lse_tile), 0.0)
 
 
 def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -189,13 +196,13 @@ def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(live)
     def _():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p = _recompute_p(q, k, lse_ref[0], q_start, k_start, causal, scale,
-                         bq, bk)
+        p = _recompute_p(q, k, lse_ref[0][:, :1], q_start, k_start, causal,
+                         scale, bq, bk)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - dm_ref[0][:, None])
+        ds = p * (dp - dm_ref[0][:, :1])
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -224,8 +231,8 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     @pl.when(live)
     def _():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-        p = _recompute_p(q, k, lse_ref[0], q_start, k_start, causal, scale,
-                         bq, bk)
+        p = _recompute_p(q, k, lse_ref[0][:, :1], q_start, k_start, causal,
+                         scale, bq, bk)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -234,7 +241,7 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - dm_ref[0][:, None])
+        ds = p * (dp - dm_ref[0][:, :1])
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -252,9 +259,13 @@ def _bwd(q3, k3, v3, q_off, k_off, out, lse, g_out, g_lse,
     lk = k3.shape[1]
     nq, nk = lq // bq, lk // bk
     # D folds the out-cotangent; the lse-cotangent enters with opposite
-    # sign in ds = p * (dp - (D - g_lse))
+    # sign in ds = p * (dp - (D - g_lse)). lse arrives lane-replicated
+    # [bh, lq, LANE] (see _fwd); dm rides the same layout so both block
+    # as tile-aligned (1, bq, LANE)
     dm = (jnp.sum(g_out.astype(jnp.float32) * out.astype(jnp.float32),
                   axis=-1) - g_lse)
+    dm = jnp.broadcast_to(dm[..., None], (bh, lq, _LANE))
+    lse = jnp.broadcast_to(lse[..., None], (bh, lq, _LANE))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
@@ -267,8 +278,8 @@ def _bwd(q3, k3, v3, q_off, k_off, out, lse, g_out, g_lse,
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, kk: (i, kk, 0)),
             pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
-            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
-            pl.BlockSpec((1, bq), lambda i, j, kk: (i, j)),
+            pl.BlockSpec((1, bq, _LANE), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda i, j, kk: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kk: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q3.dtype),
@@ -287,8 +298,8 @@ def _bwd(q3, k3, v3, q_off, k_off, out, lse, g_out, g_lse,
             pl.BlockSpec((1, bk, d), lambda i, jk, jq: (i, jk, 0)),
             pl.BlockSpec((1, bk, d), lambda i, jk, jq: (i, jk, 0)),
             pl.BlockSpec((1, bq, d), lambda i, jk, jq: (i, jq, 0)),
-            pl.BlockSpec((1, bq), lambda i, jk, jq: (i, jq)),
-            pl.BlockSpec((1, bq), lambda i, jk, jq: (i, jq)),
+            pl.BlockSpec((1, bq, _LANE), lambda i, jk, jq: (i, jq, 0)),
+            pl.BlockSpec((1, bq, _LANE), lambda i, jk, jq: (i, jq, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda i, jk, jq: (i, jk, 0)),
@@ -319,12 +330,19 @@ def _flash(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
 
 def _flash_fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk):
     out, lse = _fwd(q3, k3, v3, q_off, k_off, causal, scale, bq, bk)
-    return (out, lse), (q3, k3, v3, q_off, k_off, out, lse)
+    # residual keeps lane 0 only — every lane is identical, and holding
+    # the [bh, lq, LANE] ride through the whole model backward would cost
+    # 128x the memory; _bwd re-broadcasts (same pattern as dm)
+    return (out, lse), (q3, k3, v3, q_off, k_off, out, lse[..., 0])
 
 
 def _flash_bwd(causal, scale, bq, bk, res, g):
     q3, k3, v3, q_off, k_off, out, lse = res
     g_out, g_lse = g
+    # lse is returned lane-replicated [bh, lq, LANE]; the adjoint of that
+    # replication is the lane-sum of the cotangent (the API slices lane 0,
+    # so in practice only that column is nonzero)
+    g_lse = g_lse.sum(axis=-1)
     dq, dk, dv = _bwd(q3, k3, v3, q_off, k_off, out, lse, g_out, g_lse,
                       causal, scale, bq, bk)
     zero_off = np.zeros((1,), jax.dtypes.float0)  # int inputs: no tangent
@@ -398,7 +416,7 @@ def flash_attention(
     out = out3.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
     if not return_lse:
         return out
-    return out, lse3.reshape(b, h, lq)
+    return out, lse3[..., 0].reshape(b, h, lq)
 
 
 def flash_supported(lq: int, lk: int, block_q: int = 128,
@@ -433,7 +451,12 @@ def _lowering_probe(head_dim: int, dtype_name: str, seq: int) -> bool:
     if jax.default_backend() != "tpu":
         return False
     try:
-        q = jnp.zeros((1, min(seq, 256), 1, head_dim), dtype_name)
+        # 2 heads, NOT 1: with a single head the flattened batch*heads dim
+        # is 1, and a block dim of 1 trivially "equals the array dim" —
+        # Mosaic's tile rule then passes shapes it rejects for every real
+        # model (this exact coincidence let a (1, bq) lse block through
+        # the probe and then broke BERT on the first live TPU window)
+        q = jnp.zeros((1, min(seq, 256), 2, head_dim), dtype_name)
 
         def loss(x):
             return jnp.sum(
