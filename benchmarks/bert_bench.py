@@ -246,10 +246,15 @@ def main():
         # headline = 'full' (auto -> flash on TPU, bare metric name so the
         # series stays continuous across rounds and provenance recall
         # never keys the einsum baseline over it); einsum row suffixed.
+        # s512/s2048 pairs chart where the O(L^2) dense path falls off
+        # the flash curve; token budget is held ~constant per line
         for b, s, attn in [
             (args.batch, args.seq, "full"),
             (args.batch, args.seq, "einsum"),
             (max(args.batch // 4, 1), 512, "full"),
+            (max(args.batch // 4, 1), 512, "einsum"),
+            (1, 2048, "full"),
+            (1, 2048, "einsum"),
         ]:
             try:
                 single_device_bench(b, s, attention=attn)

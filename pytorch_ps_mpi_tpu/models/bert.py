@@ -71,11 +71,11 @@ class SelfAttention(nn.Module):
             out = ulysses_attention(q, k, v, c.seq_axis, causal=c.causal)
         elif c.attention in ("full", "flash", "einsum"):
             # 'flash': always the Pallas kernel (interpret mode off-TPU —
-            # for tests). 'full': the kernel on TPU when the sequence
-            # tiles, einsum otherwise — so the O(L^2)-HBM dense path is
-            # never taken on hardware where the kernel can run.
-            # 'einsum': force the dense path (the flash-vs-einsum A/B in
-            # benchmarks/bert_bench.py).
+            # for tests). 'full': whichever path measured faster on TPU —
+            # the kernel for long sequences (when shapes tile and Mosaic
+            # lowers it), the dense einsum below FLASH_MIN_SEQ where
+            # XLA's batched MXU matmuls win. 'einsum': force the dense
+            # path (the flash-vs-einsum A/B in benchmarks/bert_bench.py).
             from pytorch_ps_mpi_tpu.ops.attention_pallas import (
                 flash_attention,
                 flash_auto_ok,
@@ -91,6 +91,11 @@ class SelfAttention(nn.Module):
                     "power-of-two block >= 8 dividing it); use 'full' "
                     "for automatic fallback"
                 )
+            # 'full' prefers the path that measured faster: the gate
+            # includes a FLASH_MIN_SEQ floor because XLA's fused dense
+            # attention wins short sequences on the MXU (TPU v5e,
+            # BERT-base b16 s128: dense 14.5 ms/step vs flash 18.6;
+            # benchmarks/flash_tune.py measures the crossover)
             use_kernel = c.attention == "flash" or (
                 c.attention == "full" and flash_auto_ok(l, l, head_dim, c.dtype)
             )

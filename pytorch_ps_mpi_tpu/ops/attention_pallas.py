@@ -54,6 +54,17 @@ from pytorch_ps_mpi_tpu.ops._common import interpret as _interpret
 _MASKED = -1e30        # additive mask value
 _MASK_THRESH = -1e29   # "this score was masked" test (real scores are tiny)
 
+# Minimum sequence length at which 'full' attention auto-dispatches to
+# the kernel. Measured on TPU v5e (tpu_v5e_2026-07-31 sweep +
+# benchmarks/flash_tune.py): XLA's fused dense attention wins short
+# sequences — its matmuls batch across heads on the MXU while the kernel
+# pays a sequential batch*heads grid — and the kernel takes over where
+# O(L^2) score materialization dominates. Overridable for re-measurement
+# on other chip generations (FLASH_MIN_SEQ env var).
+import os as _os
+
+FLASH_MIN_SEQ = int(_os.environ.get("FLASH_MIN_SEQ", "512"))
+
 
 def _pick_block(length: int, target: int, min_block: int = 8) -> Optional[int]:
     """Largest power-of-two block <= target that divides ``length``
@@ -471,8 +482,12 @@ def _lowering_probe(head_dim: int, dtype_name: str, seq: int) -> bool:
 
 def flash_auto_ok(lq: int, lk: int, head_dim: int, dtype) -> bool:
     """The ONE auto-dispatch gate every attention entry point (BERT
-    'full', ring, ulysses) consults: shapes tile at this dtype AND the
-    Mosaic probe (fwd+bwd, causal) compiles. Off-TPU the probe is False,
-    so no separate backend check is needed."""
-    return (flash_supported(lq, lk, dtype=dtype)
+    'full', ring, ulysses) consults: the sequence is long enough that
+    the kernel measured FASTER than XLA's fused dense attention
+    (``FLASH_MIN_SEQ``), shapes tile at this dtype, AND the Mosaic probe
+    (fwd+bwd, causal) compiles. Off-TPU the probe is False, so no
+    separate backend check is needed. The explicit ``attention='flash'``
+    mode bypasses this gate entirely."""
+    return (max(lq, lk) >= FLASH_MIN_SEQ
+            and flash_supported(lq, lk, dtype=dtype)
             and mosaic_lowering_ok(head_dim, dtype, lq))

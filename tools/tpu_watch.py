@@ -45,7 +45,10 @@ STAGES = [
     ("codec_bench", [sys.executable, "benchmarks/codec_bench.py"], 1800),
     ("leader_bench", [sys.executable, "benchmarks/leader_bench.py"], 600),
     ("bert_bench",
-     [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed"], 900),
+     [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed"],
+     1800),  # 6 train lines (flash/einsum A/B at s128/s512/s2048) + table
+    # flash-vs-dense crossover sweep behind the FLASH_MIN_SEQ dispatch
+    ("flash_tune", [sys.executable, "benchmarks/flash_tune.py"], 1800),
     ("async_bench",
      [sys.executable, "benchmarks/async_bench.py", "--model", "resnet18",
       "--workers", "2", "--fast-steps", "6", "--slow-steps", "2",
