@@ -209,6 +209,22 @@ def codec_table(n_params: int, measure: bool):
                 )
             except Exception as e:  # one codec OOMing must not kill the table
                 row["enc_dec_ms_device"] = f"error: {type(e).__name__}"
+            if name in ("topk", "blocktopk", "blocktopk8", "randomk",
+                        "threshold"):
+                # encode/decode split for the sparse family: the
+                # doctrine's claim that REASSEMBLY (gather/scatter),
+                # not selection, is what loses on ICI must be a
+                # measurement, not an inference (CODEC_ECONOMICS.md).
+                # Own try: an encode-phase failure must not clobber a
+                # roundtrip number that already succeeded.
+                try:
+                    row["enc_ms_device"] = round(
+                        codec_roundtrip_seconds(
+                            code, shape, jnp.float32, phase="encode")
+                        * 1e3, 2,
+                    )
+                except Exception as e:
+                    row["enc_ms_device"] = f"error: {type(e).__name__}"
         rows.append(row)
     emit(metric="bert_base_flat_grad_codec_wire_table", n_elems=n, rows=rows)
 

@@ -209,7 +209,8 @@ def scan_timed(loop_call: Callable[[], Any], k: int, reps: int = 3) -> float:
     return max(0.0, wall - rtt) / k
 
 
-def codec_roundtrip_seconds(code, shape, dtype, k: Optional[int] = None) -> float:
+def codec_roundtrip_seconds(code, shape, dtype, k: Optional[int] = None,
+                            phase: str = "roundtrip") -> float:
     """Device seconds for one ``encode`` + ``decode`` of a codec at
     ``shape`` — a k-iteration fused scan whose iterations carry a
     numerically-negligible data dependence (``+ decoded * 1e-30``) AND
@@ -228,9 +229,18 @@ def codec_roundtrip_seconds(code, shape, dtype, k: Optional[int] = None) -> floa
     anywhere between 0.05 ms and 1.3 ms run-to-run (a 3 ms signal under
     ±2 ms jitter), flipping which of two implementations looked faster.
     k is snapped to {8, 64, 512} so the compilation cache holds across
-    runs."""
+    runs.
+
+    ``phase='encode'`` times the encode half alone (decode cost is then
+    the roundtrip minus this). The carry dependence switches to a full
+    reduction over every payload leaf — a first-element dependence would
+    let XLA slice-fuse away most of the encode, while a jnp.sum forces
+    full payload materialization at the cost of one extra payload read
+    per iteration (negligible: the encode itself writes those bytes)."""
     import jax.numpy as jnp
 
+    if phase not in ("roundtrip", "encode"):
+        raise ValueError(f"phase={phase!r}: expected 'roundtrip' or 'encode'")
     g = jax.random.normal(jax.random.key(0), shape, dtype)
     st = code.init_state(shape, dtype)
     rng = jax.random.key(1) if code.needs_rng else None
@@ -241,10 +251,14 @@ def codec_roundtrip_seconds(code, shape, dtype, k: Optional[int] = None) -> floa
             def body(carry, _):
                 g_c, st_c = carry
                 payload, st_new = code.encode(g_c, st_c, rng)
-                d = code.decode(payload, shape, dtype)
-                g_next = g_c + d.astype(g_c.dtype) * jnp.asarray(
-                    1e-30, g_c.dtype
-                )
+                if phase == "encode":
+                    dep = sum(
+                        jnp.sum(leaf).astype(g_c.dtype)
+                        for leaf in jax.tree.leaves(payload)
+                    )
+                else:
+                    dep = code.decode(payload, shape, dtype).astype(g_c.dtype)
+                g_next = g_c + dep * jnp.asarray(1e-30, g_c.dtype)
                 return (g_next, st_new), None
 
             (out, st_out), _ = jax.lax.scan(body, (g, st), None, length=length)

@@ -198,3 +198,26 @@ def test_ulysses_flash_local_attention_matches_dense(mesh8, causal):
     for a, bb in zip(gf, gj):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_flash_auto_gate_requires_min_seq(monkeypatch):
+    """'full'-attention auto-dispatch floor: below FLASH_MIN_SEQ the gate
+    refuses even where the kernel lowers (dense measured faster on TPU
+    v5e at short seq — tpu_v5e_2026-07-31 sweep); above it the gate
+    passes iff shapes tile AND Mosaic compiles."""
+    from pytorch_ps_mpi_tpu.ops import attention_pallas as ap
+
+    monkeypatch.setattr(ap, "mosaic_lowering_ok", lambda *a, **k: True)
+    # pin the floor: the env knob (FLASH_MIN_SEQ) may hold an untileable
+    # value in a tuning run, which would break the tiling asserts below
+    monkeypatch.setattr(ap, "FLASH_MIN_SEQ", 512)
+    floor = ap.FLASH_MIN_SEQ
+    assert not ap.flash_auto_ok(floor // 2, floor // 2, 64, jnp.bfloat16)
+    assert ap.flash_auto_ok(floor, floor, 64, jnp.bfloat16)
+    # the floor tests the LONGER side (ring blocks can be asymmetric)
+    assert ap.flash_auto_ok(floor, floor // 4, 64, jnp.bfloat16)
+    # an untileable length is still refused above the floor
+    assert not ap.flash_auto_ok(floor + 1, floor + 1, 64, jnp.bfloat16)
+    # a failing Mosaic probe vetoes regardless of length
+    monkeypatch.setattr(ap, "mosaic_lowering_ok", lambda *a, **k: False)
+    assert not ap.flash_auto_ok(4 * floor, 4 * floor, 64, jnp.bfloat16)

@@ -168,3 +168,26 @@ def test_data_prefetch():
     import itertools
     vals = list(itertools.islice(prefetch(iter(int, 1), depth=2), 3))
     assert vals == [0, 0, 0]
+
+
+def test_codec_timing_encode_phase_is_partial_cost():
+    """phase='encode' times the encode half alone: positive, and not
+    more than the full roundtrip by more than measurement noise (CPU
+    backend: both are exact single-call walls)."""
+    import jax.numpy as jnp
+
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.utils.devtime import codec_roundtrip_seconds
+
+    code = get_codec("blocktopk", fraction=0.05)
+    shape = (256, 1024)
+    enc = codec_roundtrip_seconds(code, shape, jnp.float32, k=8,
+                                  phase="encode")
+    both = codec_roundtrip_seconds(code, shape, jnp.float32, k=8)
+    assert enc > 0.0
+    assert enc < both * 2.0  # same order; roundtrip adds decode on top
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        codec_roundtrip_seconds(code, shape, jnp.float32, k=8, phase="dec")
