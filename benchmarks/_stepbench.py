@@ -1,0 +1,65 @@
+"""Shared train-step timing recipe for the model-family benches.
+
+One implementation of the honest step measurement (bert_bench,
+gpt_bench, and the bench.py model lines all need the same thing):
+jit the step, pull measured FLOPs from XLA cost analysis, time one call
+(wall, includes the tunnel fetch RTT) and a K-step fused ``lax.scan``
+(device time per step, RTT-subtracted — ``utils/devtime.timed``), and
+return the common emit fields. ``devtime``'s docstring forbids bench
+consumers from re-rolling the timing recipe; this module is the one
+place the *step-bench* variant of it lives.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from pytorch_ps_mpi_tpu.utils.devtime import (
+    peak_flops_for,
+    rtt_floor,
+    rtt_subtracted_ms,
+    safe_ratio,
+    timed,
+)
+
+
+def step_timing_fields(train_step, params, state, batch, scan_k: int = 8,
+                       reps: int = 5) -> dict:
+    """Measure ``train_step(params, state, batch) -> (params, state, loss)``
+    and return the shared metric fields (steps/sec in ``value``)."""
+    fn = jax.jit(train_step)
+    flops = 0.0
+    try:
+        cost = fn.lower(params, state, batch).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+    except Exception:
+        pass
+
+    @jax.jit
+    def scanned(params, state, batch):
+        def body(c, _):
+            p, s, _ = train_step(c[0], c[1], batch)
+            return (p, s), None
+
+        (p, s), _ = jax.lax.scan(body, (params, state), None, length=scan_k)
+        return p, s
+
+    wall_s, dev_s = timed(
+        lambda: fn(params, state, batch),
+        lambda: scanned(params, state, batch),
+        scan_k, reps=reps,
+    )
+    peak = peak_flops_for()
+    return {
+        "value": round(safe_ratio(1.0, dev_s), 3),
+        "unit": "steps/sec",
+        "step_ms_device": round(dev_s * 1e3, 2),
+        "wall_ms_per_call": round(wall_s * 1e3, 2),
+        "rtt_probe_ms": round(rtt_floor() * 1e3, 2),
+        "rtt_subtracted_ms": rtt_subtracted_ms(),
+        "flops_per_step": flops,
+        "mfu": round(safe_ratio(flops, dev_s * peak), 4) if peak else 0.0,
+        "device_kind": jax.devices()[0].device_kind,
+    }

@@ -46,14 +46,7 @@ enable_compilation_cache()
 from pytorch_ps_mpi_tpu.mesh import make_mesh
 from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM, mlm_loss
 from pytorch_ps_mpi_tpu.optim import AdamHyper, adam_update, init_adam_state
-from pytorch_ps_mpi_tpu.utils.devtime import (
-    codec_roundtrip_seconds,
-    peak_flops_for,
-    rtt_floor,
-    rtt_subtracted_ms,
-    safe_ratio,
-    timed,
-)
+from pytorch_ps_mpi_tpu.utils.devtime import codec_roundtrip_seconds
 
 
 def emit(**rec):
@@ -89,47 +82,17 @@ def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10,
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     state = init_adam_state(params)
 
-    fn = jax.jit(train_step)
-    flops = 0.0
-    try:
-        cost = fn.lower(params, state, b).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-    except Exception:
-        pass
+    # shared honest step-timing recipe (benchmarks/_stepbench.py)
+    from benchmarks._stepbench import step_timing_fields
 
-    # RTT-corrected device timing (utils/devtime.py): the tunneled
-    # backend's block_until_ready is a no-op, so K fused steps + one
-    # scalar fetch, minus the fetch RTT floor, is the honest device time
-    @jax.jit
-    def scanned(params, state, b):
-        def body(c, _):
-            p, s, _ = train_step(c[0], c[1], b)
-            return (p, s), None
-        (p, s), _ = jax.lax.scan(body, (params, state), None, length=scan_k)
-        return p, s
-
-    wall_s, dev_s = timed(
-        lambda: fn(params, state, b),
-        lambda: scanned(params, state, b),
-        scan_k, reps=reps,
-    )
-
-    peak = peak_flops_for()
+    fields = step_timing_fields(train_step, params, state, b,
+                                scan_k=scan_k, reps=reps)
     suffix = "" if attention == "full" else f"_attn-{attention}"
     emit(
         metric=(f"bert_base_{n_params//10**6}M_mlm_train_step"
                 f"_b{batch}_s{seq}{suffix}"),
         attention=attention,
-        value=round(safe_ratio(1.0, dev_s), 3), unit="steps/sec",
-        step_ms_device=round(dev_s * 1e3, 2),
-        wall_ms_per_call=round(wall_s * 1e3, 2),
-        rtt_probe_ms=round(rtt_floor() * 1e3, 2),
-        rtt_subtracted_ms=rtt_subtracted_ms(),
-        flops_per_step=flops,
-        mfu=round(safe_ratio(flops, dev_s * peak), 4) if peak else 0.0,
-        device_kind=jax.devices()[0].device_kind,
+        **fields,
     )
     return n_params
 

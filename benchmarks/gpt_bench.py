@@ -1,0 +1,114 @@
+"""GPT (decoder-only causal LM) train-step bench — the second model
+family's TPU number.
+
+BERT-base MLM stresses flat-gradient bandwidth (``bert_bench.py``); the
+causal LM stresses the CAUSAL attention paths — on TPU, at s1024/s2048
+the 'full' gate dispatches the flash kernel (seq >= FLASH_MIN_SEQ),
+whose causal schedule skips fully-future tiles, so this line measures
+that schedule inside a whole training step rather than a kernel
+microbench. The einsum twin rides alongside at each shape as the A/B.
+
+GPT-2-small geometry (12 layers, 12 heads, 768 hidden, 50257 vocab,
+tied embeddings — ~124M params), Adam, bf16 compute. RTT-corrected
+scan timing (``utils/devtime.py``).
+
+Run on a live TPU: ``python benchmarks/gpt_bench.py``; off-TPU it runs
+one tiny honest CPU line so the script always proves itself runnable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.utils.backend_guard import (
+    enable_compilation_cache,
+    ensure_live_backend,
+)
+
+enable_compilation_cache()
+
+from benchmarks._stepbench import step_timing_fields
+from pytorch_ps_mpi_tpu.models.bert import BertConfig
+from pytorch_ps_mpi_tpu.models.gpt import GPTLM, causal_lm_loss
+from pytorch_ps_mpi_tpu.optim import AdamHyper, adam_update, init_adam_state
+
+
+def emit(**rec):
+    rec.setdefault("backend", jax.default_backend())
+    print(json.dumps(rec), flush=True)
+
+
+def _suffix(attention: str) -> str:
+    return "" if attention == "full" else f"_attn-{attention}"
+
+
+def bench_line(batch: int, seq: int, attention: str, cfg_kw: dict,
+               scan_k: int = 8, reps: int = 5) -> None:
+    cfg = BertConfig(causal=True, attention=attention,
+                     max_position=max(1024, seq), **cfg_kw)
+    model = GPTLM(cfg)
+    h = AdamHyper(lr=1e-4)
+
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size)
+
+    def loss_fn(params, toks):
+        return causal_lm_loss(model.apply(params, toks), toks)
+
+    def train_step(params, state, toks):
+        loss, grads = jax.value_and_grad(loss_fn)(params, toks)
+        p2, s2 = adam_update(params, grads, state, h)
+        return p2, s2, loss
+
+    params = jax.jit(model.init)(jax.random.key(0), tokens[:1])
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    state = init_adam_state(params)
+    fields = step_timing_fields(train_step, params, state, tokens,
+                                scan_k=scan_k, reps=reps)
+    emit(
+        metric=(f"gpt2s_{n_params//10**6}M_lm_train_step_b{batch}_s{seq}"
+                f"{_suffix(attention)}"),
+        attention=attention,
+        **fields,
+    )
+
+
+def main() -> None:
+    ensure_live_backend()
+    if jax.default_backend() != "tpu":
+        # honest CPU smoke: tiny geometry, one line, runnable anywhere
+        bench_line(2, 64, "full",
+                   dict(dtype=jnp.float32, num_layers=2, num_heads=2,
+                        hidden_size=64, intermediate_size=128,
+                        vocab_size=512),
+                   scan_k=4, reps=2)
+        return
+    gpt2s = dict(dtype=jnp.bfloat16, num_layers=12, num_heads=12,
+                 hidden_size=768, intermediate_size=3072, vocab_size=50257)
+    for batch, seq, attn in [
+        (8, 1024, "full"),    # flash via the gate (seq >= FLASH_MIN_SEQ)
+        (8, 1024, "einsum"),
+        (4, 2048, "full"),
+        (4, 2048, "einsum"),
+    ]:
+        try:
+            bench_line(batch, seq, attn, gpt2s)
+        except Exception as e:
+            # error rows keep the success-path suffix so the A/B arms of
+            # one shape never collide under a single metric name
+            emit(metric=(f"gpt2s_lm_train_step_b{batch}_s{seq}"
+                         f"{_suffix(attn)}"),
+                 attention=attn,
+                 error=f"{type(e).__name__}: {str(e)[:300]}")
+
+
+if __name__ == "__main__":
+    main()

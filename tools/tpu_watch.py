@@ -49,6 +49,9 @@ STAGES = [
      1800),  # 6 train lines (flash/einsum A/B at s128/s512/s2048) + table
     # flash-vs-dense crossover sweep behind the FLASH_MIN_SEQ dispatch
     ("flash_tune", [sys.executable, "benchmarks/flash_tune.py"], 1800),
+    # second model family: GPT-2-small causal LM at s1024/s2048,
+    # flash/einsum A/B (the causal-schedule path inside a real step)
+    ("gpt_bench", [sys.executable, "benchmarks/gpt_bench.py"], 1800),
     ("async_bench",
      [sys.executable, "benchmarks/async_bench.py", "--model", "resnet18",
       "--workers", "2", "--fast-steps", "6", "--slow-steps", "2",
