@@ -9,6 +9,7 @@ Run: ``python benchmarks/codec_bench.py [n_elems]``.
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 
@@ -71,12 +72,23 @@ def main():
           f"probe_live={live} n={n} raw={raw_bytes/1e6:.1f} MB")
     print("| codec | enc+dec ms (device) | wire MB | ratio |")
     print("|---|---|---|---|")
+    rows = []
     for label, name, kw in CODECS:
         t_rt, wire = bench_codec(name, kw, n)
         print(
             f"| {label} | {t_rt*1e3:.2f} "
             f"| {wire/1e6:.2f} | {raw_bytes/wire:.1f}x |"
         )
+        rows.append({"codec": label, "enc_dec_ms_device": round(t_rt * 1e3, 2),
+                     "wire_mb": round(wire / 1e6, 2),
+                     "ratio": round(raw_bytes / wire, 1)})
+    # same table as ONE machine-readable line: the watcher/extract_sweep
+    # pipeline keeps JSON metric lines; markdown is for humans. Size tag
+    # in binary units so distinct n never collide on one metric name
+    # (provenance keeps only the newest record per name)
+    size = f"{n//2**20}M" if n >= 2**20 else f"{n//2**10}K"
+    print(json.dumps({"metric": f"codec_wire_table_{size}", "n_elems": n,
+                      "rows": rows, "backend": backend}), flush=True)
 
     if backend == "tpu":
         print()

@@ -1,0 +1,140 @@
+"""Extract the newest watcher sweep into a committed results artifact.
+
+``tools/tpu_watch.py`` appends each stage's raw stdout to
+``BENCH_TPU_WATCH.jsonl`` the moment it finishes (crash-proof capture);
+this tool turns the latest live-window capture into a clean
+``benchmarks/results/tpu_<kind>_<date>_sweep.jsonl`` — one JSON record
+per metric line, each tagged with its stage and capture timestamp — the
+form ``utils/provenance.py`` recalls from and the round artifacts keep.
+
+Usage:
+    python tools/extract_sweep.py            # newest window -> results/
+    python tools/extract_sweep.py --since 2026-07-31T03:00 --dry-run
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WATCH = os.path.join(REPO, "BENCH_TPU_WATCH.jsonl")
+OUTDIR = os.path.join(REPO, "benchmarks", "results")
+
+
+def load(path: str) -> list[dict]:
+    recs = []
+    with open(path) as f:
+        for line in f:
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+    return recs
+
+
+def newest_window(recs: list[dict]) -> str | None:
+    """Start timestamp of the newest live window that ran at least one
+    stage (a live probe followed by stage records before the next
+    probe flips down)."""
+    window = None
+    candidate = None
+    for r in recs:
+        if r.get("stage") == "probe":
+            candidate = r["ts"] if r.get("status") == "live" else None
+        elif r.get("stage") and "ts" in r:
+            # stage record: the enclosing window is the preceding live
+            # probe, or (log truncation) the stage's own timestamp
+            window = candidate or r["ts"]
+    return window
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--since", default=None,
+                    help="ISO timestamp; default = newest live window")
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+
+    recs = load(WATCH)
+    since = args.since or newest_window(recs)
+    if since is None:
+        raise SystemExit("no live window found in the watch log")
+
+    # collect stage records from `since` until the next down-probe gap
+    # longer than one stage cycle (a later window would have its own
+    # live probe; simplest robust cut: stop at the next 'down' probe
+    # that follows at least one extracted stage)
+    rows, kinds, stages = [], set(), []
+    seen_stage = False
+    for r in recs:
+        ts = r.get("ts", "")
+        if ts < since:
+            continue
+        if r.get("stage") == "probe":
+            if r.get("status") == "down" and seen_stage:
+                break
+            continue
+        seen_stage = True
+        stages.append((r.get("stage"), r.get("status"), r.get("wall_s")))
+        for ln in (r.get("stdout") or "").splitlines():
+            ln = ln.strip()
+            if not ln.startswith("{"):
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "metric" not in rec:
+                continue
+            rec["_stage"] = r.get("stage")
+            # the exact key+format utils/provenance.py keys recency off —
+            # without it the committed artifact's records date to epoch
+            # and lose to any older record once the watch log rotates
+            rec["captured_by"] = f"watcher {ts}"
+            kinds.add(str(rec.get("device_kind", "")))
+            rows.append(rec)
+
+    if not rows:
+        raise SystemExit(f"no metric lines found since {since}")
+
+    # honest hardware slug from the records' own device_kind ("TPU v5
+    # lite" IS the v5e); never collapse other generations to v5e
+    kind = "unknown"
+    for k in kinds:
+        if k:
+            kind = ("v5e" if k.strip().lower() == "tpu v5 lite"
+                    else k.strip().lower().replace("tpu", "").strip()
+                    .replace(" ", "_") or "unknown")
+            break
+    date = since.split("T")[0]
+    out = os.path.join(OUTDIR, f"tpu_{kind}_{date}_sweep.jsonl")
+    suffix = 0
+    while os.path.exists(out):
+        suffix += 1
+        out = os.path.join(OUTDIR, f"tpu_{kind}_{date}_sweep{suffix}.jsonl")
+
+    header = {
+        "artifact": f"TPU {kind} watcher sweep, window starting {since}",
+        "stages": [
+            {"stage": s, "status": st, "wall_s": w} for s, st, w in stages
+        ],
+        "note": "extracted by tools/extract_sweep.py from "
+                "BENCH_TPU_WATCH.jsonl; one record per metric line, "
+                "tagged _stage/_captured",
+    }
+    print(f"window {since}: {len(rows)} metric rows from "
+          f"{len(stages)} stage runs -> {out}")
+    for s, st, w in stages:
+        print(f"  {s}: {st} ({w}s)")
+    if args.dry_run:
+        return
+    with open(out, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for rec in rows:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()
