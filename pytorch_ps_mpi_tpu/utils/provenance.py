@@ -189,11 +189,25 @@ def fallback_record_lines(repo_root: str, now: datetime | None = None) -> list[d
     if "value" not in summary:  # key lines existed but carried neither
         summary["value"] = 0.0
         summary["unit"] = "none"
-    ages = [a for a in (_age_hours(r, now) for r in key.values()) if a is not None]
-    if ages:
-        summary["age_hours"] = max(ages)
-    tss = [t for t in (_parse_ts(r.get("captured_by", "")) for r in key.values()) if t]
-    if tss:
-        summary["provenance"] = f"watcher {max(tss).isoformat()}"
+    # provenance/age_hours describe the records that actually FEED the
+    # summary's headline fields (agg + best_mfu): the headline is as
+    # stale as its oldest contributor — stamping the newest recalled
+    # record here once understated a 13.9h-old headline as 2h fresh.
+    # The bound over every recalled key line rides under its own name.
+    contributing = [r for r in (agg, best_mfu) if r is not None] or list(
+        key.values()
+    )
+    c_ts = [t for t in (_parse_ts(r.get("captured_by", ""))
+                        for r in contributing) if t]
+    c_ages = [a for a in (_age_hours(r, now) for r in contributing)
+              if a is not None]
+    if c_ts:
+        summary["provenance"] = f"watcher {min(c_ts).isoformat()}"
+    if c_ages:
+        summary["age_hours"] = max(c_ages)
+    all_ages = [a for a in (_age_hours(r, now) for r in key.values())
+                if a is not None]
+    if all_ages:
+        summary["oldest_record_age_hours"] = max(all_ages)
     lines.append(summary)
     return lines

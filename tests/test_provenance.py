@@ -123,7 +123,12 @@ def test_fallback_lines_end_with_tpu_summary(tmp_path):
     assert summary["mfu"] == 0.4539
     assert summary["provenance"].startswith("watcher 2026-07-30T")
     # ages measured against the stamped capture times, oldest key line wins
+    # age_hours reflects the records FEEDING the headline (agg/best-mfu,
+    # both from the 06:02 capture here — ~13.9h old), never an unrelated
+    # fresher record; the all-lines bound rides under its own name
     assert summary["age_hours"] >= 13.9
+    assert summary["provenance"].startswith("watcher 2026-07-30T06:")
+    assert summary["oldest_record_age_hours"] >= summary["age_hours"]
     for rec in lines[:-1]:
         assert rec["provenance"].startswith("watcher")
         assert "age_hours" in rec
