@@ -221,3 +221,29 @@ def test_flash_auto_gate_requires_min_seq(monkeypatch):
     # a failing Mosaic probe vetoes regardless of length
     monkeypatch.setattr(ap, "mosaic_lowering_ok", lambda *a, **k: False)
     assert not ap.flash_auto_ok(4 * floor, 4 * floor, 64, jnp.bfloat16)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_multi_tile_backward_both_masks_odd_heads(causal):
+    """Multi-tile (4x4 grid) BACKWARD at causal=False and with a
+    non-power-of-two head count — the two cells the other tests leave
+    open: test_gradients_match_dense_oracle sweeps the multi-tile
+    backward only causally, and every test uses power-of-two heads
+    (the flattened batch*heads dim here is 6)."""
+    q, k, v = qkv(b=2, l=64, h=3, d=16, seed=5)
+    sc = q.shape[-1] ** -0.5
+
+    def flash_loss(q, k, v):
+        out = flash_attention(q, k, v, causal=causal,
+                              block_q=16, block_k=16)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def ref_loss(q, k, v):
+        out, _ = _attention_jnp(q, k, v, 0, 0, causal, sc)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    gf = jax.grad(flash_loss, (0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
