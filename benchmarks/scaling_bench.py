@@ -114,8 +114,11 @@ def run_world(world: int, steps: int) -> dict:
     }
 
 
-def run_dcn_point(steps: int, timeout: float = 1200.0) -> dict | None:
-    """8 devices across 2 coordinated processes via launch.py.
+def run_dcn_point(steps: int, n_procs: int = 2,
+                  timeout: float = 1200.0) -> dict | None:
+    """8 devices across ``n_procs`` coordinated processes via launch.py
+    (4x2 exercises a LARGER process topology on the same runtime path —
+    every psum crosses 3 process boundaries instead of 1).
 
     Children write to temp FILES, not pipes — a rank blocked on a full
     unread pipe while the other rank waits in a collective would
@@ -123,27 +126,31 @@ def run_dcn_point(steps: int, timeout: float = 1200.0) -> dict | None:
     an error row so the extrapolation row still prints."""
     import tempfile
 
+    dev_per_proc = 8 // n_procs
     s = socket.socket()
     s.bind(("localhost", 0))
     port = s.getsockname()[1]
     s.close()
     env = dict(os.environ)
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={dev_per_proc}"
+    )
     env.pop("JAX_PLATFORMS", None)
     logs = [tempfile.NamedTemporaryFile("w+", suffix=f".rank{r}.log",
-                                        delete=False) for r in range(2)]
+                                        delete=False)
+            for r in range(n_procs)]
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "pytorch_ps_mpi_tpu.launch",
              "--platform", "cpu",
              "--coordinator", f"localhost:{port}",
-             "--num-processes", "2", "--process-id", str(r),
+             "--num-processes", str(n_procs), "--process-id", str(r),
              os.path.join(REPO, "benchmarks", "scaling_worker.py"),
              str(PER_WORKER_BATCH), str(steps)],
             cwd=REPO, env=env, text=True,
             stdout=logs[r], stderr=subprocess.STDOUT,
         )
-        for r in range(2)
+        for r in range(n_procs)
     ]
     deadline = time.time() + timeout
     timed_out = False
@@ -166,18 +173,20 @@ def run_dcn_point(steps: int, timeout: float = 1200.0) -> dict | None:
         f.close()
         os.unlink(f.name)
     if timed_out:
-        return {"workers": 8, "processes": 2,
-                "error": f"timeout after {timeout}s; rank logs: "
-                         f"{outs[0][-200:]!r} / {outs[1][-200:]!r}"}
+        # every rank's tail: the rank that actually crashed pre-collective
+        # is usually not rank 0 or N-1
+        tails = " / ".join(f"r{r}:{o[-160:]!r}" for r, o in enumerate(outs))
+        return {"workers": 8, "processes": n_procs,
+                "error": f"timeout after {timeout}s; rank logs: {tails}"}
     for r, (p, out) in enumerate(zip(procs, outs)):
         if p.returncode != 0:
-            return {"workers": 8, "processes": 2,
+            return {"workers": 8, "processes": n_procs,
                     "error": f"rank {r} rc={p.returncode}: {out[-400:]}"}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("SCALING_ROW "):
                 return json.loads(line[len("SCALING_ROW "):])
-    return {"workers": 8, "processes": 2, "error": "no row emitted"}
+    return {"workers": 8, "processes": n_procs, "error": "no row emitted"}
 
 
 def extrapolate(ici_gbytes: float) -> dict:
@@ -249,14 +258,18 @@ def main():
         print(json.dumps(row), flush=True)
 
     if not args.skip_dcn:
-        dcn = run_dcn_point(args.steps)
-        if dcn is not None:
-            dcn["kind"] = "cross-process (DCN code path, loopback)"
-            if "steps_per_sec" in dcn and base:
-                dcn["weak_scaling_efficiency"] = round(
-                    dcn["steps_per_sec"] / base, 4
+        for n_procs in (2, 4):
+            dcn = run_dcn_point(args.steps, n_procs=n_procs)
+            if dcn is not None:
+                dcn["kind"] = (
+                    f"cross-process (DCN code path, {n_procs} procs, "
+                    "loopback)"
                 )
-            print(json.dumps(dcn), flush=True)
+                if "steps_per_sec" in dcn and base:
+                    dcn["weak_scaling_efficiency"] = round(
+                        dcn["steps_per_sec"] / base, 4
+                    )
+                print(json.dumps(dcn), flush=True)
 
     print(json.dumps(extrapolate(args.ici_gbytes)), flush=True)
 

@@ -1,8 +1,9 @@
-"""SPMD worker for ``scaling_bench.py``'s cross-process (DCN) point —
-NOT a pytest file. Launched 2x via ``pytorch_ps_mpi_tpu.launch`` with 4
-local CPU devices each: the global 8-device mesh spans a real process
-boundary, so the gradient psum crosses the distributed runtime the way
-a multi-host pod's DCN hop would (loopback here; same code path).
+"""SPMD worker for ``scaling_bench.py``'s cross-process (DCN) points —
+NOT a pytest file. Launched N times via ``pytorch_ps_mpi_tpu.launch``
+(N=2 with 4 local CPU devices each, N=4 with 2 each): the global
+8-device mesh spans real process boundaries, so the gradient psum
+crosses the distributed runtime the way a multi-host pod's DCN hop
+would (loopback here; same code path).
 
 Rank 0 prints one JSON row compatible with the in-process sweep's rows.
 """
