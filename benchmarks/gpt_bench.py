@@ -96,8 +96,9 @@ def main() -> None:
     for batch, seq, attn in [
         (8, 1024, "full"),    # flash via the gate (seq >= FLASH_MIN_SEQ)
         (8, 1024, "einsum"),
-        (4, 2048, "full"),
-        (4, 2048, "einsum"),
+        (1, 2048, "full"),    # A/B pair at a batch the dense path can hold
+        (1, 2048, "einsum"),  # (b4 einsum keeps ~4.8 GB of p residuals)
+        (4, 2048, "full"),    # flash-only capacity line: O(L*d) residuals
     ]:
         try:
             bench_line(batch, seq, attn, gpt2s)
