@@ -135,12 +135,22 @@ def main():
                 best = ((bq, bk), dev_s)
 
         if best:
+            # dense_s == 0.0 is a devtime zero-clamp (RTT jitter
+            # swallowed the k-step signal): distinct from "errored"
+            # (None), but comparing a finite flash time against 0.0 is
+            # meaningless — report it indeterminate, never as a verdict
+            if dense_s is None:
+                verdict = "dense errored"
+            elif dense_s == 0.0:
+                verdict = "dense zero-clamped"
+            else:
+                verdict = bool(best[1] < dense_s)
             emit(metric="attn_crossover_summary", seq=l, batch=b,
-                 dense_ms=round(dense_s * 1e3, 3) if dense_s else None,
+                 dense_ms=(round(dense_s * 1e3, 3)
+                           if dense_s is not None else None),
                  best_flash_ms=round(best[1] * 1e3, 3),
                  best_block=f"{best[0][0]}x{best[0][1]}",
-                 flash_wins=(bool(best[1] < dense_s) if dense_s
-                             else "dense errored"))
+                 flash_wins=verdict)
 
 
 if __name__ == "__main__":

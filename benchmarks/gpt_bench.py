@@ -50,8 +50,24 @@ def _suffix(attention: str) -> str:
     return "" if attention == "full" else f"_attn-{attention}"
 
 
+def metric_name(batch: int, seq: int, attention: str, cfg_kw: dict) -> str:
+    """Metric name derived from the config alone (abstract eval, no
+    device work), so error and success rows for one config share the
+    same name and provenance's newest-per-metric recall sees one series.
+    """
+    cfg = BertConfig(causal=True, attention=attention,
+                     max_position=max(1024, seq), **cfg_kw)
+    model = GPTLM(cfg)
+    shapes = jax.eval_shape(
+        model.init, jax.random.key(0),
+        jax.ShapeDtypeStruct((1, seq), jnp.int32))
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
+    return (f"gpt2s_{n_params//10**6}M_lm_train_step_b{batch}_s{seq}"
+            f"{_suffix(attention)}")
+
+
 def bench_line(batch: int, seq: int, attention: str, cfg_kw: dict,
-               scan_k: int = 8, reps: int = 5) -> None:
+               metric: str, scan_k: int = 8, reps: int = 5) -> None:
     cfg = BertConfig(causal=True, attention=attention,
                      max_position=max(1024, seq), **cfg_kw)
     model = GPTLM(cfg)
@@ -69,26 +85,20 @@ def bench_line(batch: int, seq: int, attention: str, cfg_kw: dict,
         return p2, s2, loss
 
     params = jax.jit(model.init)(jax.random.key(0), tokens[:1])
-    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     state = init_adam_state(params)
     fields = step_timing_fields(train_step, params, state, tokens,
                                 scan_k=scan_k, reps=reps)
-    emit(
-        metric=(f"gpt2s_{n_params//10**6}M_lm_train_step_b{batch}_s{seq}"
-                f"{_suffix(attention)}"),
-        attention=attention,
-        **fields,
-    )
+    emit(metric=metric, attention=attention, **fields)
 
 
 def main() -> None:
     ensure_live_backend()
     if jax.default_backend() != "tpu":
         # honest CPU smoke: tiny geometry, one line, runnable anywhere
-        bench_line(2, 64, "full",
-                   dict(dtype=jnp.float32, num_layers=2, num_heads=2,
-                        hidden_size=64, intermediate_size=128,
-                        vocab_size=512),
+        tiny = dict(dtype=jnp.float32, num_layers=2, num_heads=2,
+                    hidden_size=64, intermediate_size=128, vocab_size=512)
+        bench_line(2, 64, "full", tiny,
+                   metric=metric_name(2, 64, "full", tiny),
                    scan_k=4, reps=2)
         return
     gpt2s = dict(dtype=jnp.bfloat16, num_layers=12, num_heads=12,
@@ -100,14 +110,16 @@ def main() -> None:
         (1, 2048, "einsum"),  # (b4 einsum keeps ~4.8 GB of p residuals)
         (4, 2048, "full"),    # flash-only capacity line: O(L*d) residuals
     ]:
+        # name computed BEFORE the try: it re-runs the constructor/trace
+        # steps, so calling it inside the handler would just re-raise
+        # and kill the rest of the sweep with no error row
+        name = metric_name(batch, seq, attn, gpt2s)
         try:
-            bench_line(batch, seq, attn, gpt2s)
+            bench_line(batch, seq, attn, gpt2s, metric=name)
         except Exception as e:
-            # error rows keep the success-path suffix so the A/B arms of
-            # one shape never collide under a single metric name
-            emit(metric=(f"gpt2s_lm_train_step_b{batch}_s{seq}"
-                         f"{_suffix(attn)}"),
-                 attention=attn,
+            # same config-derived name as the success path, so one
+            # config is one metric series whether the run lives or dies
+            emit(metric=name, attention=attn,
                  error=f"{type(e).__name__}: {str(e)[:300]}")
 
 

@@ -94,7 +94,7 @@ class SelfAttention(nn.Module):
             # 'full' prefers the path that measured faster: the gate
             # includes a FLASH_MIN_SEQ floor because XLA's fused dense
             # attention wins short sequences on the MXU (TPU v5e,
-            # BERT-base b16 s128: dense 14.5 ms/step vs flash 18.6;
+            # BERT-base b16 s128: einsum 14.75 ms/step vs flash 15.18;
             # benchmarks/flash_tune.py measures the crossover)
             use_kernel = c.attention == "flash" or (
                 c.attention == "full" and flash_auto_ok(l, l, head_dim, c.dtype)

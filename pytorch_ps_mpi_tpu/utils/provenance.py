@@ -125,14 +125,18 @@ def fallback_record_lines(repo_root: str, now: datetime | None = None) -> list[d
     is genuinely no TPU truth to carry and fabricating one is worse.
     """
     now = now or datetime.now()
-    # Plausibility gate: MFU >= 1 is physically impossible — such records
+    # Plausibility gates: MFU >= 1 is physically impossible — such records
     # are pre-RTT-correction measurement bugs still sitting in the watcher
     # log (the scan-hoisting artifact VERDICT r3 weak #3 describes for
-    # powersgd also inflated early bert lines). Never recall them.
+    # powersgd also inflated early bert lines). A value <= 0 on a rate
+    # metric is a failed capture (devtime zero-clamp; the committed
+    # bert bf16 0.0 row, VERDICT r4 weak #5) — a real step is never free.
+    # Never recall either.
     records = [
         r for r in load_tpu_records(repo_root)
         if "error" not in r  # errored rows are provenance, not truth
         and not ((m := _num(r.get("mfu"))) is not None and m >= 1.0)
+        and not ((v := _num(r.get("value"))) is not None and v <= 0.0)
     ]
     newest = newest_per_metric(records)
     key = {

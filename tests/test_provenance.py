@@ -161,6 +161,56 @@ def test_implausible_mfu_records_never_recalled(tmp_path):
     assert "bert_base_132M_mlm_train_step_b16_s128" not in metrics
 
 
+def test_zero_value_records_never_recalled(tmp_path):
+    """A 0.0 value on a rate metric is a failed capture (devtime
+    zero-clamp — the committed bert bf16 0.0 row, VERDICT r4 weak #5);
+    even when it is the NEWEST record for its metric it must not be
+    recalled, and must not shadow an older genuine measurement."""
+    root = _mk_repo(tmp_path)
+    _write(
+        os.path.join(root, "benchmarks", "results", "tpu_zero.jsonl"),
+        [
+            {
+                "metric": "bert_base_mlm_train_step_b16_s128_bf16_steps_per_sec",
+                "value": 0.0,
+                "unit": "steps/sec",
+                "backend": "tpu",
+                "captured_by": "tpu_watch sweep 2026-07-30T19:30:00",
+            },
+            # newest-per-metric shadow case: a zero row NEWER than a real one
+            {
+                "metric": "resnet18_train_step_b256_bf16_steps_per_sec",
+                "value": 0.0,
+                "unit": "steps/sec",
+                "backend": "tpu",
+                "captured_by": "tpu_watch sweep 2026-07-30T19:30:00",
+            },
+        ],
+    )
+    lines = fallback_record_lines(root)
+    by_metric = {r.get("metric"): r for r in lines}
+    assert "bert_base_mlm_train_step_b16_s128_bf16_steps_per_sec" not in by_metric
+    # the genuine 06:02 bf16 line still wins its metric
+    assert by_metric["resnet18_train_step_b256_bf16_steps_per_sec"]["value"] == 119.99
+
+
+def test_real_repo_zero_bf16_row_is_tagged():
+    """The specific committed failed capture must carry an error tag so
+    both the error filter and the value<=0 gate exclude it."""
+    import pytest
+
+    path = os.path.join(
+        REPO, "benchmarks", "results", "tpu_v5e_2026-07-31_sweep.jsonl")
+    if not os.path.exists(path):
+        pytest.skip("sweep artifact not in this tree")
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    zero = [r for r in rows
+            if r.get("metric") == "bert_base_mlm_train_step_b16_s128_bf16_steps_per_sec"]
+    assert zero and all("error" in r for r in zero)
+    metrics = {r.get("metric") for r in fallback_record_lines(REPO)}
+    assert "bert_base_mlm_train_step_b16_s128_bf16_steps_per_sec" not in metrics
+
+
 def test_summary_value_unit_without_aggregation_record(tmp_path):
     """No grad_aggregation survivor -> summary still honors the
     value/unit contract, drawn from the best train-step line; a string
