@@ -234,6 +234,11 @@ def main():
             (max(args.batch // 4, 1), 512, "einsum"),
             (1, 2048, "full"),
             (1, 2048, "einsum"),
+            # MFU-push configs (VERDICT r4 next #5): bigger batches
+            # amortize fixed per-step work — chart MFU vs batch at the
+            # two headline sequence lengths
+            (2 * args.batch, args.seq, "full"),
+            (max(args.batch // 2, 1), 512, "full"),
         ]:
             try:
                 single_device_bench(b, s, attention=attn)

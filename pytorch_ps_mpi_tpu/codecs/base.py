@@ -45,6 +45,16 @@ class Codec:
     #: codecs that consume randomness (random-k, QSGD) set this so the
     #: train step threads a per-worker PRNG key in.
     needs_rng: bool = False
+    #: codecs whose aggregation IS a collective protocol (PowerSGD's
+    #: two-psum shared-Q form) set this and implement
+    #: ``fused_allreduce(grad, state, axis_name, comm_dtype=None) ->
+    #: (summed, new_state)`` (+ ``fused_wire_bits(shape, dtype,
+    #: comm_dtype=None)`` for metrics): the fused on-mesh step then runs
+    #: it in place of encode → all_gather → decode_sum, threading the
+    #: optimizer's ``comm_dtype`` so uncompressed leaves still ride a
+    #: narrowed wire. ``encode``/``decode_sum`` remain the payload form
+    #: for wires with no synchronous collective (async/DCN/host PS).
+    supports_fused_allreduce: bool = False
 
     def init_state(self, shape: Tuple[int, ...], dtype) -> PyTree:
         return ()

@@ -121,6 +121,65 @@ def ragged_all_gather(
     return payloads, lengths
 
 
+# -- collective/autodiff pairs for model-parallel regions --------------------
+#
+# Under ``shard_map(..., check_vma=False)`` the transpose of ``lax.psum``
+# is another psum, which scales gradients by the axis size when the
+# cotangent is replicated (the failure mode ``parallel/pp.py``'s module
+# docstring documents). These two custom-VJP wrappers pin the correct
+# local-gradient semantics explicitly — the classic conjugate pair of
+# tensor-parallel frameworks (Megatron's f/g, Shoeybi et al. 2019,
+# arXiv:1909.08053 §3 — public technique): an all-reduce in one
+# direction is an identity in the other. They make model-parallel
+# forward functions differentiable inside the optimizer's vma-unchecked
+# shard_map, producing per-device LOCAL gradients that ``MPI_PS`` then
+# aggregates over the data axis only.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_fwd_identity_bwd(x: jax.Array, axis_name) -> jax.Array:
+    """Forward: ``lax.psum(x, axis_name)``; backward: identity.
+
+    Use at a model-parallel region's OUTPUT reduction (row-parallel
+    matmul, pipeline loss replication): the output is replicated across
+    the axis, so its replicated cotangent is already each shard's
+    correct local cotangent — summing it again would scale gradients by
+    the axis size."""
+    return lax.psum(x, axis_name)
+
+
+def _pfib_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _pfib_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+psum_fwd_identity_bwd.defvjp(_pfib_fwd, _pfib_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def identity_fwd_psum_bwd(x: jax.Array, axis_name) -> jax.Array:
+    """Forward: identity; backward: ``lax.psum`` of the cotangent.
+
+    Use at a model-parallel region's INPUT (a replicated activation
+    consumed by every shard, e.g. the input of a column-parallel
+    matmul): each shard back-propagates only its own contribution, and
+    the true input gradient is their sum across the axis."""
+    return x
+
+
+def _ifpb_fwd(x, axis_name):
+    return x, None
+
+
+def _ifpb_bwd(axis_name, _res, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+identity_fwd_psum_bwd.defvjp(_ifpb_fwd, _ifpb_bwd)
+
+
 def ring_permute(x: jax.Array, axis_name: str, shift: int = 1) -> jax.Array:
     """Send ``x`` to the next rank around the ring (receives from previous).
 

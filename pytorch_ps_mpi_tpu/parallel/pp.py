@@ -50,6 +50,7 @@ def pipeline_apply(
     x_mb: jax.Array,
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
     pipe_axis: str,
+    local_grads: bool = False,
 ) -> jax.Array:
     """Run ``stage_fn`` S times (once per pipeline stage) over M
     microbatches.
@@ -69,8 +70,17 @@ def pipeline_apply(
     to a psum, so every device returns the same value — out_specs P()).
     """
     out, is_last = _pipeline_scan(stage_params, x_mb, stage_fn, pipe_axis)
-    # only the last stage holds real outputs; replicate via psum
-    return lax.psum(jnp.where(is_last, out, 0.0), pipe_axis)
+    # only the last stage holds real outputs; replicate via psum.
+    # local_grads: the psum here is a replication of one live copy, so
+    # its correct transpose is identity (comms.psum_fwd_identity_bwd) —
+    # required when differentiating under check_vma=False (the MPI_PS
+    # fused-step contract; see module docstring for the failure mode)
+    masked = jnp.where(is_last, out, 0.0)
+    if local_grads:
+        from pytorch_ps_mpi_tpu import comms
+
+        return comms.psum_fwd_identity_bwd(masked, pipe_axis)
+    return lax.psum(masked, pipe_axis)
 
 
 def _pipeline_scan(stage_params, x_mb, stage_fn, pipe_axis):
@@ -127,6 +137,7 @@ def pipeline_loss(
     stage_fn: Callable[[PyTree, jax.Array], jax.Array],
     loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
     pipe_axis: str,
+    local_grads: bool = False,
 ) -> jax.Array:
     """Mean of ``loss_fn(pipeline(x_mb), y_mb)`` over microbatches —
     differentiate THIS with ``jax.grad`` for the backward pipeline; the
@@ -135,10 +146,18 @@ def pipeline_loss(
 
     The scalar is computed on the LAST stage only and psum-replicated —
     one live loss copy, one cotangent stream through the reverse ring.
-    Requires a vma-checked shard_map (module docstring)."""
+    Requires a vma-checked shard_map (module docstring) UNLESS
+    ``local_grads=True``, which lowers the replication through
+    ``comms.psum_fwd_identity_bwd`` (correct transpose explicitly, for
+    the optimizer's vma-unchecked fused step)."""
     out, is_last = _pipeline_scan(stage_params, x_mb, stage_fn, pipe_axis)
     local_loss = jax.vmap(loss_fn)(out, y_mb).mean()
-    return lax.psum(jnp.where(is_last, local_loss, 0.0), pipe_axis)
+    masked = jnp.where(is_last, local_loss, 0.0)
+    if local_grads:
+        from pytorch_ps_mpi_tpu import comms
+
+        return comms.psum_fwd_identity_bwd(masked, pipe_axis)
+    return lax.psum(masked, pipe_axis)
 
 
 def init_stage_stack(key, s_count: int, init_one: Callable) -> PyTree:

@@ -42,12 +42,22 @@ def column_parallel(x: jax.Array, w: jax.Array, b: Optional[jax.Array] = None):
 
 def row_parallel(
     y_local: jax.Array, w: jax.Array, tp_axis: str,
-    b: Optional[jax.Array] = None,
+    b: Optional[jax.Array] = None, local_grads: bool = False,
 ):
     """``out = psum_tp(y_local @ w_local) (+ b)`` — weight sharded on the
     INPUT dim; input sharded, output replicated. The block's single
-    collective."""
-    out = lax.psum(y_local @ w, tp_axis)
+    collective.
+
+    ``local_grads=True`` lowers the reduction through
+    :func:`comms.psum_fwd_identity_bwd` so differentiating inside a
+    vma-UNCHECKED shard_map (``MPI_PS``'s fused step) yields correct
+    per-device gradients — under ``check_vma=False`` a plain psum
+    transposes into another psum and scales gradients by the axis size."""
+    from pytorch_ps_mpi_tpu import comms
+
+    yw = y_local @ w
+    out = (comms.psum_fwd_identity_bwd(yw, tp_axis) if local_grads
+           else lax.psum(yw, tp_axis))
     return out + b if b is not None else out
 
 
@@ -56,16 +66,28 @@ def _sq(x):
     return x[0]
 
 
-def tp_mlp(x: jax.Array, params: Dict[str, jax.Array], tp_axis: str):
+def tp_mlp(x: jax.Array, params: Dict[str, jax.Array], tp_axis: str,
+           local_grads: bool = False):
     """Transformer MLP: column-parallel up-projection + gelu +
     row-parallel down-projection; one psum total.
 
     ``params`` leaves (host-side, leading [tp] axis): ``w1 [tp, d, f/tp]``,
     ``b1 [tp, f/tp]``, ``w2 [tp, f/tp, d]``, ``b2 [d]`` (replicated — added
     once after the psum).
+
+    ``local_grads=True``: Megatron f/g region markers replace the bare
+    psum so gradients are correct under ``check_vma=False`` (the
+    ``MPI_PS`` fused-step contract) — the replicated input's gradient is
+    psum'd across ``tp_axis`` (every shard contributes) and the output
+    reduction back-propagates as identity.
     """
+    if local_grads:
+        from pytorch_ps_mpi_tpu import comms
+
+        x = comms.identity_fwd_psum_bwd(x, tp_axis)
     h = jax.nn.gelu(column_parallel(x, _sq(params["w1"]), _sq(params["b1"])))
-    return row_parallel(h, _sq(params["w2"]), tp_axis, params["b2"])
+    return row_parallel(h, _sq(params["w2"]), tp_axis, params["b2"],
+                        local_grads=local_grads)
 
 
 def tp_self_attention(
@@ -76,6 +98,7 @@ def tp_self_attention(
     seq_axis: Optional[str] = None,
     causal: bool = False,
     sp: str = "ring",
+    local_grads: bool = False,
 ):
     """Self-attention with heads split over ``tp_axis``: the QKV
     projection is column-parallel (each worker computes its local heads),
@@ -90,6 +113,15 @@ def tp_self_attention(
     """
     if sp not in ("ring", "ulysses"):
         raise ValueError(f"sp must be 'ring' or 'ulysses', got {sp!r}")
+    if local_grads:
+        # Megatron 'f' at region entry: every head shard consumes the
+        # replicated x, so its true gradient is the psum of per-shard
+        # contributions (see tp_mlp; sequence-axis collectives inside
+        # ring/ulysses are ppermute/all-to-all, whose transposes are
+        # already correct without vma checking)
+        from pytorch_ps_mpi_tpu import comms
+
+        x = comms.identity_fwd_psum_bwd(x, tp_axis)
     wqkv = _sq(params["wqkv"])                     # [d, 3, h_loc, hd]
     qkv = jnp.einsum("bld,dche->blche", x, wqkv)   # [b, l, 3, h_loc, hd]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -111,7 +143,8 @@ def tp_self_attention(
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
     flat = out.reshape(out.shape[0], out.shape[1], -1)   # [b, l, h_loc*hd]
-    return row_parallel(flat, _sq(params["wo"]), tp_axis, params["bo"])
+    return row_parallel(flat, _sq(params["wo"]), tp_axis, params["bo"],
+                        local_grads=local_grads)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,168 @@
+"""Fit the scaling model's transport parameters to the MEASURED
+multi-process DCN points (VERDICT r4 next #6).
+
+The ring extrapolation (`benchmarks/results/cpu_scaling_resnet18_*.jsonl`,
+`scaling_extrapolation_ring_model` row) anchored weak-scaling efficiency
+to one measured TPU step time with link bandwidth as an ASSUMED
+parameter. This script replaces assumption with fit wherever this host
+actually measured transport:
+
+1. **In-process collective bandwidth** — the 2/4/8-virtual-device rows
+   measure `comm_ms_per_dev` against known `wire_bytes_per_worker`:
+   fit one effective bandwidth `BW_eff` minimizing the relative residual
+   of `comm_ms = wire_bytes / BW_eff`, and report per-point residuals
+   (how well the model's linear-in-bytes structure holds).
+2. **Per-boundary DCN cost** — the 8-worker runs at 1/2/4 processes
+   measure the same program with every psum crossing 0/1/3 process
+   boundaries: fit `T(p) = T_inproc + k * boundaries(p)` by least
+   squares and report the residual — the model's
+   linear-in-boundary-crossings structure, checked against data.
+
+The ICI tier stays a labeled parameter (a single tunneled chip has no
+ICI link to measure); what the fit buys is (a) the model's *structure*
+validated on the two tiers this host can measure, and (b) the honest
+magnitude gap between loopback-process transport and the assumed ICI.
+
+Run: ``python tools/fit_scaling.py [--artifact PATH]`` — prints JSON
+rows; append to ``benchmarks/results/`` and cite from docs/RESULTS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT = os.path.join(
+    REPO, "benchmarks", "results", "cpu_scaling_resnet18_2026-07-31.jsonl"
+)
+
+
+def emit(**rec):
+    print(json.dumps(rec), flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--artifact", default=DEFAULT)
+    args = ap.parse_args()
+
+    rows = [json.loads(l) for l in open(args.artifact) if l.strip()]
+    inproc = {r["workers"]: r for r in rows
+              if r.get("processes") == 1 and "comm_ms_per_dev" in r}
+    multi = {r["processes"]: r for r in rows
+             if r.get("processes", 1) > 1 and r.get("workers") == 8}
+
+    # -- 1. in-process collective bandwidth fit -------------------------
+    pts = [(r["wire_bytes_per_worker"], r["comm_ms_per_dev"])
+           for w, r in sorted(inproc.items()) if w > 1]
+    # least squares on the RELATIVE error of comm_ms = bytes / BW:
+    # minimize sum_i ((b_i * x - t_i) / t_i)^2 over x = 1/BW, whose
+    # closed form is x = sum(b_i/t_i) / sum(b_i^2/t_i^2) — every point
+    # weighs equally regardless of its absolute wall (an absolute-error
+    # OLS would let the largest-byte point dominate and contradict the
+    # per-point relative residuals reported below)
+    num = sum(b / t for b, t in pts)
+    den = sum((b * b) / (t * t) for b, t in pts)
+    inv_bw = num / den                       # ms per byte
+    bw_eff = 1.0 / inv_bw / 1e6              # bytes/ms -> GB/s-ish scale
+    resid = [
+        {"workers": w,
+         "measured_comm_ms": r["comm_ms_per_dev"],
+         "fit_comm_ms": round(r["wire_bytes_per_worker"] * inv_bw, 2),
+         "rel_residual": round(
+             (r["wire_bytes_per_worker"] * inv_bw - r["comm_ms_per_dev"])
+             / r["comm_ms_per_dev"], 3)}
+        for w, r in sorted(inproc.items()) if w > 1
+    ]
+    emit(
+        metric="scaling_fit_inprocess_collective_bw",
+        value=round(bw_eff, 3),
+        unit="GB/s",
+        model="comm_ms_per_dev = wire_bytes_per_worker / BW_eff",
+        points=resid,
+        note=(
+            "effective XLA:CPU collective bandwidth on this host, fitted "
+            "to the measured 2/4/8-device comm walls; the linear-in-bytes "
+            "structure of the ring model is what the residuals check. "
+            "Host-CPU magnitude — NOT an ICI estimate"
+        ),
+        artifact=os.path.basename(args.artifact),
+    )
+
+    # -- 2. per-boundary DCN (multi-process) cost fit --------------------
+    if 1 not in {r.get("processes") for r in rows} or not multi:
+        emit(metric="scaling_fit_boundary_cost", error="missing rows")
+        return
+    t1 = inproc[8]["step_ms"]
+    # contiguous-block rings: p processes -> p-1 boundary chains crossed
+    pts2 = [(p - 1, r["step_ms"] - t1) for p, r in sorted(multi.items())]
+    # same relative-error objective as fit #1 (see comment there)
+    k = (sum(b / dt for b, dt in pts2)
+         / sum((b * b) / (dt * dt) for b, dt in pts2))
+    resid2 = [
+        {"processes": p,
+         "boundaries": p - 1,
+         "measured_extra_ms": round(r["step_ms"] - t1, 1),
+         "fit_extra_ms": round(k * (p - 1), 1),
+         "rel_residual": round(
+             (k * (p - 1) - (r["step_ms"] - t1)) / (r["step_ms"] - t1), 3)}
+        for p, r in sorted(multi.items())
+    ]
+    wire = inproc[8]["wire_bytes_per_worker"]
+    emit(
+        metric="scaling_fit_boundary_cost",
+        value=round(k, 1),
+        unit="ms/boundary",
+        model="step_ms(p procs) = step_ms(in-proc) + k * (p - 1)",
+        points=resid2,
+        implied_boundary_gbytes_per_s=round(wire / k / 1e6, 4),
+        note=(
+            "per-process-boundary transport cost fitted to the measured "
+            "2- and 4-process coordinated runs (loopback gRPC + one "
+            "shared kernel); the linear-in-boundaries structure is the "
+            "checked claim. The implied boundary bandwidth is loopback-"
+            "on-a-contended-host magnitude — it bounds the DCN tier's "
+            "STRUCTURE, not a datacenter NIC's rate"
+        ),
+        artifact=os.path.basename(args.artifact),
+    )
+
+    # -- 3. re-anchored extrapolation: fitted-vs-assumed ----------------
+    extrap = next((r for r in rows
+                   if r.get("metric") == "scaling_extrapolation_ring_model"),
+                  None)
+    if extrap:
+        t_c = extrap["t_compute_ms"]
+        wire_b = extrap["wire_bytes"]
+
+        def eff(w, bw_gbs):
+            t_comm = 2 * (w - 1) / w * wire_b / (bw_gbs * 1e6)  # ms
+            return t_c / (t_c + t_comm)
+
+        assumed = extrap["ici_gbytes_per_s"]
+        emit(
+            metric="scaling_extrapolation_fitted_vs_assumed",
+            t_compute_ms=t_c,
+            wire_bytes=wire_b,
+            assumed_ici_gbytes_per_s=assumed,
+            predicted_efficiency_assumed={
+                str(w): round(eff(w, assumed), 4) for w in (8, 64, 256)
+            },
+            fitted_host_collective_gbytes_per_s=round(bw_eff, 3),
+            predicted_efficiency_if_links_were_host_grade={
+                str(w): round(eff(w, bw_eff), 4) for w in (8, 64, 256)
+            },
+            note=(
+                "the ring model's structure is now validated against both "
+                "measured tiers (see the two fit rows); the ICI magnitude "
+                "remains a labeled parameter — the host-grade column shows "
+                "the same model under the FITTED transport rate, bounding "
+                "how much the conclusion depends on the assumed number"
+            ),
+        )
+
+
+if __name__ == "__main__":
+    main()

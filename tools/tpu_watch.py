@@ -46,7 +46,11 @@ STAGES = [
     ("leader_bench", [sys.executable, "benchmarks/leader_bench.py"], 600),
     ("bert_bench",
      [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed"],
-     1800),  # 6 train lines (flash/einsum A/B at s128/s512/s2048) + table
+     2400),  # 8 train lines (flash/einsum A/B at s128/s512/s2048 +
+             # b32 s128 / b8 s512 MFU-push configs) + codec table
+    # peak-HBM with/without donate_buffers (+ remat), fresh subprocess
+    # per config so PJRT's cumulative peak is honest (VERDICT r4 #8)
+    ("memory_bench", [sys.executable, "benchmarks/memory_bench.py"], 1800),
     # flash-vs-dense crossover sweep behind the FLASH_MIN_SEQ dispatch
     ("flash_tune", [sys.executable, "benchmarks/flash_tune.py"], 1800),
     # second model family: GPT-2-small causal LM at s1024/s2048,
