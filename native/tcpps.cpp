@@ -29,9 +29,20 @@
 //        4 PUSH_GRAD (worker->server; version = params version used)
 //        5 ACK (server->worker; confirms one PUSH_GRAD was queued)
 
+//
+// WAN emulation (test mode): the kernel here has no netem qdisc, so
+// cross-host latency is emulated in the WORKER-side calls — env
+// TPS_WAN_RTT_MS adds rtt/2 before each request is sent and rtt/2
+// before its reply is returned (both propagation directions);
+// TPS_WAN_JITTER_MS adds uniform [0, J) per direction. The server
+// stays delay-free: it is single-threaded and non-blocking, and a
+// server-side sleep would serialize every connection (over-modeling a
+// shared medium). Zero/unset env = zero overhead (checked once).
+
 #include <arpa/inet.h>
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <fcntl.h>
@@ -100,6 +111,51 @@ struct Worker {
 void set_nonblock(int fd) {
   int fl = fcntl(fd, F_GETFL, 0);
   fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+// ---- WAN-emulation delay shim (see file header) ---------------------------
+
+double wan_env_ms(const char* name) {
+  const char* v = getenv(name);
+  if (!v || !*v) return 0.0;
+  double ms = atof(v);
+  return ms > 0.0 ? ms : 0.0;
+}
+
+double wan_oneway_ms() {
+  static double ms = wan_env_ms("TPS_WAN_RTT_MS") / 2.0;
+  return ms;
+}
+
+double wan_jitter_ms() {
+  static double ms = wan_env_ms("TPS_WAN_JITTER_MS");
+  return ms;
+}
+
+// xorshift64: cheap per-process jitter stream, seeded once from pid+time
+uint64_t wan_rand() {
+  static uint64_t s = [] {
+    struct timespec t;
+    clock_gettime(CLOCK_MONOTONIC, &t);
+    uint64_t x = (uint64_t)t.tv_nsec ^ ((uint64_t)getpid() << 32) ^ 0x9e3779b9ULL;
+    return x ? x : 1ULL;
+  }();
+  s ^= s << 13;
+  s ^= s >> 7;
+  s ^= s << 17;
+  return s;
+}
+
+// one direction's propagation delay; no-op when the env is unset
+void wan_delay_oneway() {
+  double ms = wan_oneway_ms();
+  double j = wan_jitter_ms();
+  if (ms <= 0.0 && j <= 0.0) return;
+  if (j > 0.0) ms += (double)(wan_rand() % 10000) / 10000.0 * j;
+  struct timespec ts;
+  ts.tv_sec = (time_t)(ms / 1000.0);
+  ts.tv_nsec = (long)((ms - ts.tv_sec * 1000.0) * 1e6);
+  nanosleep(&ts, nullptr);
 }
 
 void set_nodelay(int fd) {
@@ -422,12 +478,25 @@ int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
   // worst-case block 2x what the caller asked for)
   struct timespec t0;
   clock_gettime(CLOCK_MONOTONIC, &t0);
+  wan_delay_oneway();  // request propagation (WAN emulation; usually 0)
   std::vector<uint8_t> tx;
   append_frame(tx, GET_PARAMS, w->id, 0, nullptr, 0);
   if (write_full(w->fd, tx.data(), tx.size()) != 0) return -1;
   FrameHdr h;
+  // header read gets the REMAINING budget (the emulated request delay
+  // above counted against the deadline like any network time would);
+  // the reply-direction delay after the reads is additive latency by
+  // design — it models propagation the caller cannot see into, so only
+  // the emulated-WAN latency itself, never an extra timeout window,
+  // extends the call
+  struct timespec nowh;
+  clock_gettime(CLOCK_MONOTONIC, &nowh);
+  long spent = (nowh.tv_sec - t0.tv_sec) * 1000 +
+               (nowh.tv_nsec - t0.tv_nsec) / 1000000;
+  long hleft = timeout_ms - spent;
+  if (hleft <= 0) return -2;
   int rc = read_full(w->fd, reinterpret_cast<uint8_t*>(&h), sizeof(h),
-                     timeout_ms);
+                     (int)hleft);
   if (rc != 0) return rc;
   if (h.magic != kMagic || h.op != PARAMS) return -1;
   if (h.len > cap) return -3;
@@ -441,6 +510,7 @@ int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
     rc = read_full(w->fd, buf, h.len, (int)left);
     if (rc != 0) return rc;
   }
+  wan_delay_oneway();  // reply propagation
   if (version_out) *version_out = h.version;
   return (int64_t)h.len;
 }
@@ -451,6 +521,7 @@ int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
 int tps_worker_push_grad(void* wv, const uint8_t* buf, uint64_t len,
                          uint64_t version, int timeout_ms) {
   Worker* w = (Worker*)wv;
+  wan_delay_oneway();  // push propagation (WAN emulation; usually 0)
   FrameHdr h{};
   h.magic = kMagic;
   h.op = PUSH_GRAD;
@@ -465,6 +536,7 @@ int tps_worker_push_grad(void* wv, const uint8_t* buf, uint64_t len,
                      timeout_ms);
   if (rc != 0) return rc;
   if (ack.magic != kMagic || ack.op != ACK || ack.len != 0) return -1;
+  wan_delay_oneway();  // ack propagation
   return 1;
 }
 

@@ -525,3 +525,68 @@ def test_worker_crash_detected_and_replacement_reconnects():
         replacement.wait(timeout=30)
     finally:
         server.close()
+
+
+def test_wan_emulation_shim_adds_rtt():
+    """TPS_WAN_RTT_MS (the netem-less WAN emulation, tcpps.cpp) must add
+    the configured round-trip to worker-side calls — measured against a
+    zero-delay control worker on the same server. The env is read by the
+    WORKER subprocess (statics latch per process), so both workers run
+    out-of-process with explicit envs."""
+    import json
+    import subprocess
+    import sys
+
+    tpl = _template(64)
+    server = tcp.TcpPSServer(0, num_workers=2, template=tpl)
+    server.publish(tpl)
+
+    code = (
+        "import os, sys, time, json\n"
+        "sys.path.insert(0, %r)\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from pytorch_ps_mpi_tpu.parallel import tcp\n"
+        "tpl = {'w': np.zeros((64,), np.float32)}\n"
+        "w = tcp.TcpPSWorker('127.0.0.1', int(sys.argv[1]), int(sys.argv[2]), tpl)\n"
+        "t0 = time.perf_counter()\n"
+        "for _ in range(5):\n"
+        "    w.read_params(timeout=30.0)\n"
+        "print(json.dumps({'ms': (time.perf_counter() - t0) / 5 * 1e3}))\n"
+        "w.close()\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def timed_worker(wid, env_extra):
+        env = {**os.environ, "TPS_WAN_RTT_MS": "0",
+               "TPS_WAN_JITTER_MS": "0", **env_extra}
+        p = subprocess.Popen([sys.executable, "-c", code,
+                              str(server.port), str(wid)],
+                             env=env, stdout=subprocess.PIPE, text=True)
+        stop = threading.Event()
+
+        def pump():
+            while not stop.is_set():
+                server.poll_grad()
+                time.sleep(0.001)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+            raise
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert p.returncode == 0
+        return json.loads(out.strip().splitlines()[-1])["ms"]
+
+    try:
+        base_ms = timed_worker(0, {})
+        wan_ms = timed_worker(1, {"TPS_WAN_RTT_MS": "30"})
+    finally:
+        server.close()
+    # 30 ms RTT -> at least ~25 ms more than the loopback control
+    assert wan_ms >= base_ms + 25.0, (base_ms, wan_ms)
