@@ -46,16 +46,18 @@ def emit(**rec):
     print(json.dumps(rec), flush=True)
 
 
-def _suffix(attention: str) -> str:
-    return "" if attention == "full" else f"_attn-{attention}"
+def _suffix(attention: str, remat: bool = False) -> str:
+    s = "" if attention == "full" else f"_attn-{attention}"
+    return s + ("_remat" if remat else "")
 
 
-def metric_name(batch: int, seq: int, attention: str, cfg_kw: dict) -> str:
+def metric_name(batch: int, seq: int, attention: str, cfg_kw: dict,
+                remat: bool = False) -> str:
     """Metric name derived from the config alone (abstract eval, no
     device work), so error and success rows for one config share the
     same name and provenance's newest-per-metric recall sees one series.
     """
-    cfg = BertConfig(causal=True, attention=attention,
+    cfg = BertConfig(causal=True, attention=attention, remat=remat,
                      max_position=max(1024, seq), **cfg_kw)
     model = GPTLM(cfg)
     shapes = jax.eval_shape(
@@ -63,12 +65,13 @@ def metric_name(batch: int, seq: int, attention: str, cfg_kw: dict) -> str:
         jax.ShapeDtypeStruct((1, seq), jnp.int32))
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(shapes))
     return (f"gpt2s_{n_params//10**6}M_lm_train_step_b{batch}_s{seq}"
-            f"{_suffix(attention)}")
+            f"{_suffix(attention, remat)}")
 
 
 def bench_line(batch: int, seq: int, attention: str, cfg_kw: dict,
-               metric: str, scan_k: int = 8, reps: int = 5) -> None:
-    cfg = BertConfig(causal=True, attention=attention,
+               metric: str, remat: bool = False,
+               scan_k: int = 8, reps: int = 5) -> None:
+    cfg = BertConfig(causal=True, attention=attention, remat=remat,
                      max_position=max(1024, seq), **cfg_kw)
     model = GPTLM(cfg)
     h = AdamHyper(lr=1e-4)
@@ -88,7 +91,7 @@ def bench_line(batch: int, seq: int, attention: str, cfg_kw: dict,
     state = init_adam_state(params)
     fields = step_timing_fields(train_step, params, state, tokens,
                                 scan_k=scan_k, reps=reps)
-    emit(metric=metric, attention=attention, **fields)
+    emit(metric=metric, attention=attention, remat=remat, **fields)
 
 
 def main() -> None:
@@ -103,23 +106,28 @@ def main() -> None:
         return
     gpt2s = dict(dtype=jnp.bfloat16, num_layers=12, num_heads=12,
                  hidden_size=768, intermediate_size=3072, vocab_size=50257)
-    for batch, seq, attn in [
-        (8, 1024, "full"),    # flash via the gate (seq >= FLASH_MIN_SEQ)
-        (8, 1024, "einsum"),
-        (1, 2048, "full"),    # A/B pair at a batch the dense path can hold
-        (1, 2048, "einsum"),  # (b4 einsum keeps ~4.8 GB of p residuals)
-        (4, 2048, "full"),    # flash-only capacity line: O(L*d) residuals
+    for batch, seq, attn, remat in [
+        (8, 1024, "full", False),   # flash via the gate (seq >= FLASH_MIN_SEQ)
+        (8, 1024, "einsum", False),
+        (1, 2048, "full", False),   # A/B pair at a batch dense can hold
+        (1, 2048, "einsum", False),  # (b4 einsum keeps ~4.8 GB of residuals)
+        (4, 2048, "full", False),   # flash-only capacity line
+        # remat completes the b4 s2048 A/B dense can't otherwise hold:
+        # per-layer rematerialization trades recompute for the O(L^2)
+        # score residuals — the HBM lever measured inside a real step
+        (4, 2048, "einsum", True),
+        (4, 2048, "full", True),    # remat tax on the flash path, same shape
     ]:
         # name computed BEFORE the try: it re-runs the constructor/trace
         # steps, so calling it inside the handler would just re-raise
         # and kill the rest of the sweep with no error row
-        name = metric_name(batch, seq, attn, gpt2s)
+        name = metric_name(batch, seq, attn, gpt2s, remat)
         try:
-            bench_line(batch, seq, attn, gpt2s, metric=name)
+            bench_line(batch, seq, attn, gpt2s, metric=name, remat=remat)
         except Exception as e:
             # same config-derived name as the success path, so one
             # config is one metric series whether the run lives or dies
-            emit(metric=name, attention=attn,
+            emit(metric=name, attention=attn, remat=remat,
                  error=f"{type(e).__name__}: {str(e)[:300]}")
 
 

@@ -21,6 +21,7 @@ from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
 from pytorch_ps_mpi_tpu.ps import (
     aggregate,
     encode_tree,
+    fused_allreduce_tree,
     leader_init_state,
     leader_scatter_shards,
     leader_shard_update,
@@ -71,9 +72,21 @@ def make_sync_train_step(
     def spmd(params, opt_state, codec_state, batch, rng):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         loss = lax.pmean(loss, axis_name)
-        payloads, new_codec_state = encode_tree(code, grads, codec_state, rng, axis_name)
+        if code.supports_fused_allreduce:
+            # collective-protocol codec (PowerSGD two-psum): aggregation
+            # IS the codec — same lowering as MPI_PS's fused step
+            summed, new_codec_state = fused_allreduce_tree(
+                code, grads, codec_state, axis_name, average, size
+            )
+        else:
+            payloads, new_codec_state = encode_tree(
+                code, grads, codec_state, rng, axis_name
+            )
+            summed = None
         if mode == "leader":
-            if code.supports_psum:
+            if summed is not None:
+                grad_shards = leader_slice_shards(summed, axis_name, size)
+            elif code.supports_psum:
                 grad_shards = leader_scatter_shards(
                     grads, axis_name, size,
                     getattr(code, "wire_dtype", None), average,
@@ -85,7 +98,8 @@ def make_sync_train_step(
                 params, opt_state, grad_shards, update_fn, h, axis_name
             )
         else:
-            summed = aggregate(code, grads, payloads, axis_name, average, size)
+            if summed is None:
+                summed = aggregate(code, grads, payloads, axis_name, average, size)
             new_params, new_opt_state = update_fn(params, summed, opt_state, h)
         return new_params, new_opt_state, new_codec_state, loss
 

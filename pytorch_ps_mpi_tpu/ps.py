@@ -482,6 +482,39 @@ def aggregate(
     return jax.tree.unflatten(treedef, summed_leaves)
 
 
+def fused_allreduce_tree(
+    code: Codec, grads: PyTree, codec_state: PyTree, axis_name,
+    average: bool, size: int, comm_dtype=None,
+    leaf_axes: Optional[list] = None, leaf_sizes: Optional[list] = None,
+):
+    """Tree-mapped collective-protocol aggregation for codecs declaring
+    ``supports_fused_allreduce`` (PowerSGD's two-psum form): returns
+    ``(summed, new_codec_state)``. Runs inside shard_map. ``leaf_axes``
+    / ``leaf_sizes`` as in :func:`aggregate` (model-parallel per-leaf
+    aggregation); codec-state leaves carry the leading local-shard axis
+    of 1 (the shard_map slice), like :func:`encode_tree`."""
+    leaves, treedef = jax.tree.flatten(grads)
+    flat_states = treedef.flatten_up_to(codec_state)
+    axes_list = leaf_axes if leaf_axes is not None else [axis_name] * len(leaves)
+    sizes = leaf_sizes if leaf_sizes is not None else [size] * len(leaves)
+    summed, new_states = [], []
+    for g, st_stacked, axes in zip(leaves, flat_states, axes_list):
+        st = jax.tree.map(lambda x: x[0], st_stacked)
+        if isinstance(axes, tuple) and not axes:
+            # sharded over every data axis (EP): local grad is complete
+            s, new_st = g, st
+        else:
+            s, new_st = code.fused_allreduce(g, st, axes, comm_dtype=comm_dtype)
+        summed.append(s)
+        new_states.append(jax.tree.map(lambda x: x[None], new_st))
+    if average:
+        summed = [x / n for x, n in zip(summed, sizes)]
+    return (
+        jax.tree.unflatten(treedef, summed),
+        jax.tree.unflatten(treedef, new_states),
+    )
+
+
 class MPI_PS:
     """Distributed parameter-server optimizer over a device mesh.
 
@@ -931,29 +964,13 @@ class MPI_PS:
         """Per-leaf collective-protocol aggregation (codec declares
         ``supports_fused_allreduce``, e.g. PowerSGD's two-psum shared-Q
         form): returns ``(summed, new_codec_state)``. Runs inside
-        shard_map."""
-        leaves, treedef = jax.tree.flatten(grads)
-        flat_states = treedef.flatten_up_to(codec_state)
-        summed, new_states = [], []
-        for i, g in enumerate(leaves):
-            st = jax.tree.map(lambda x: x[0], flat_states[i])
-            axes = (self.axis_name if self._uniform_agg
-                    else self._leaf_agg_axes[i])
-            if isinstance(axes, tuple) and not axes:
-                # sharded over every data axis (EP): local grad is
-                # complete; nothing to reduce, nothing to compress
-                s, new_st = g, st
-            else:
-                s, new_st = self.code.fused_allreduce(
-                    g, st, axes, comm_dtype=self.comm_dtype
-                )
-            summed.append(s)
-            new_states.append(jax.tree.map(lambda x: x[None], new_st))
-        if self.average:
-            summed = [x / n for x, n in zip(summed, self._leaf_agg_sizes)]
-        return (
-            jax.tree.unflatten(treedef, summed),
-            jax.tree.unflatten(treedef, new_states),
+        shard_map; the module-level :func:`fused_allreduce_tree` is the
+        one implementation (dp.py's functional step shares it)."""
+        return fused_allreduce_tree(
+            self.code, grads, codec_state, self.axis_name, self.average,
+            self.size, self.comm_dtype,
+            leaf_axes=None if self._uniform_agg else self._leaf_agg_axes,
+            leaf_sizes=None if self._uniform_agg else self._leaf_agg_sizes,
         )
 
     def _encode_aggregate_update(self, params, opt_state, codec_state,

@@ -130,3 +130,42 @@ def test_functional_leader_mode_average_flag(mesh8):
         outs[mode] = np.asarray(params["w"])
     np.testing.assert_allclose(outs["allgather"], outs["leader"],
                                rtol=1e-5, atol=1e-7)
+
+
+def test_functional_powersgd_matches_object_api(mesh8):
+    """The functional step lowers PowerSGD through the SAME all-reduced
+    two-psum protocol as MPI_PS (fused_allreduce_tree is shared) — the
+    two APIs must agree bit-for-bit, allgather and leader modes both."""
+    k = jax.random.key(0)
+    params = {"w": jax.random.normal(k, (16, 12)), "b": jnp.zeros((12,))}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    batch = (
+        jax.random.normal(jax.random.key(1), (32, 16)),
+        jax.random.normal(jax.random.key(2), (32, 12)),
+    )
+    for mode in ("allgather", "leader"):
+        init_fn, step_fn = make_sync_train_step(
+            loss_fn, mesh8, optim="sgd", lr=0.05, mode=mode, donate=False,
+            code=get_codec("powersgd", rank=2, min_compression_elems=4),
+        )
+        p = params
+        opt_state, codec_state = init_fn(p)
+        for i in range(3):
+            p, opt_state, codec_state, loss = step_fn(
+                p, opt_state, codec_state, batch, jax.random.key(10 + i)
+            )
+
+        obj = SGD(params, mesh=mesh8, lr=0.05, mode=mode,
+                  code=get_codec("powersgd", rank=2, min_compression_elems=4))
+        for _ in range(3):
+            obj_loss, _ = obj.step(loss_fn=loss_fn, batch=batch)
+
+        np.testing.assert_allclose(
+            np.asarray(p["w"]), np.asarray(obj.params["w"]),
+            rtol=1e-6, atol=1e-7, err_msg=mode,
+        )
+        np.testing.assert_allclose(float(loss), float(obj_loss), rtol=1e-5)
