@@ -569,6 +569,12 @@ class MPI_PS:
         axis). With model parallelism e.g. ``P('data')`` replicates the
         batch across model shards, or ``P('data', 'seq')`` also splits
         the sequence dim.
+      loss_reduction: how the per-device loss is reduced for reporting:
+        ``'pmean'`` (pure-DP local-batch-mean convention) or ``'psum'``
+        (local loss with a static global normalizer — the param_specs /
+        tuple-axes contract). Default None picks by convention:
+        psum when param_specs or tuple aggregation axes are in play,
+        pmean otherwise.
       **hyper: optimizer hyperparameters (lr, momentum, betas, ...).
         ``lr`` may be a float or a schedule callable ``step -> scalar``
         from :data:`pytorch_ps_mpi_tpu.optim.SCHEDULES` (e.g.
@@ -599,6 +605,7 @@ class MPI_PS:
         clip_norm: float = 0.0,
         param_specs: Optional[PyTree] = None,
         batch_spec=None,
+        loss_reduction: Optional[str] = None,
         **hyper,
     ):
         if optim not in OPTIMIZERS:
@@ -609,6 +616,12 @@ class MPI_PS:
             # a negative threshold would flip scale's sign and silently
             # turn the update into gradient ASCENT
             raise ValueError(f"clip_norm must be >= 0, got {clip_norm}")
+        if loss_reduction not in (None, "pmean", "psum"):
+            raise ValueError(
+                f"loss_reduction must be 'pmean', 'psum', or None "
+                f"(auto), got {loss_reduction!r}"
+            )
+        self._loss_reduction = loss_reduction
         hyper_cls, init_state, update_fn = OPTIMIZERS[optim]
         self.hyper = hyper_cls(**hyper)
         self._update_fn = update_fn
@@ -789,12 +802,18 @@ class MPI_PS:
         """Cross-worker reduction of the per-device loss for reporting.
 
         Pure DP: loss_fn computes a local-batch MEAN, so pmean over the
-        data axis is the global mean. With param_specs the documented
+        data axis is the global mean. With param_specs — or tuple
+        aggregation axes (the SP composition) — the documented
         convention is a local loss with a STATIC GLOBAL normalizer
         (matching the optimizer's gradient-sum semantics), so the local
         losses SUM to the global loss — pmean would deflate the reported
-        value by the world size."""
-        if self._model_parallel:
+        value by the world size. ``loss_reduction`` overrides either
+        default."""
+        how = self._loss_reduction
+        if how is None:
+            how = ("psum" if self._model_parallel
+                   or not isinstance(self.axis_name, str) else "pmean")
+        if how == "psum":
             return lax.psum(loss, self.axis_name)
         return lax.pmean(loss, self.axis_name)
 
@@ -1036,7 +1055,8 @@ class MPI_PS:
         if accum_steps:
             def grad_spmd(params, batches):
                 loss, grads = _accumulate_grads(
-                    loss_fn, accum_steps, params, batches, axis
+                    loss_fn, accum_steps, params, batches, axis,
+                    reduce_loss=self._reduce_loss,
                 )
                 return loss, jax.tree.map(lambda g: g[None], grads)
 
@@ -1048,7 +1068,7 @@ class MPI_PS:
                 )(params, aux, batch)
                 new_aux = jax.tree.map(lambda x: lax.pmean(x, axis), new_aux)
                 return (
-                    lax.pmean(loss, axis),
+                    self._reduce_loss(loss),
                     jax.tree.map(lambda g: g[None], grads),
                     new_aux,
                 )
@@ -1057,7 +1077,9 @@ class MPI_PS:
         else:
             def grad_spmd(params, batch):
                 loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-                return lax.pmean(loss, axis), jax.tree.map(lambda g: g[None], grads)
+                return self._reduce_loss(loss), jax.tree.map(
+                    lambda g: g[None], grads
+                )
 
             grad_in, grad_out = (P(), P(axis)), (P(), grads_spec)
 

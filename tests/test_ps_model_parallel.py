@@ -537,6 +537,66 @@ def test_mpips_leader_model_parallel_checkpoint_resume(mesh_dp_tp, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_mpips_3d_leader_equals_allgather():
+    """Leader (ZeRO-1) mode with TUPLE aggregation axes ('data', 'seq')
+    on the 3-D mesh: the psum_scatter/all_gather pair linearizes the
+    joint axes exactly like the host-side shard build, so numerics must
+    equal the allgather twin (the property examples/train_tp.py's
+    --mode leader --sp 2 path rides on)."""
+    from jax import lax
+
+    mesh = make_mesh(shape=(2, 2, 2), axis_names=("data", "seq", "model"))
+    vocab, d, heads, ffn = 64, 16, 4, 32
+    seq_len, batch = 16, 4
+    l_local = seq_len // 2
+
+    k = jax.random.key(0)
+    k_emb, k_pos, k_attn, k_mlp, k_head, k_tok = jax.random.split(k, 6)
+    params = {
+        "emb": 0.02 * jax.random.normal(k_emb, (vocab, d)),
+        "pos": 0.02 * jax.random.normal(k_pos, (seq_len, d)),
+        "attn": tp.init_tp_attention(k_attn, d, heads, 2),
+        "mlp": tp.init_tp_mlp(k_mlp, d, ffn, 2),
+        "head": 0.02 * jax.random.normal(k_head, (d, vocab)),
+    }
+    specs = {
+        "emb": P(), "pos": P(),
+        "attn": tp.tp_param_spec(params["attn"], "model"),
+        "mlp": tp.tp_param_spec(params["mlp"], "model"),
+        "head": P(),
+    }
+    tokens = jax.random.randint(k_tok, (batch, seq_len), 1, vocab)
+
+    def loss_fn(p, toks):
+        offset = lax.axis_index("seq") * l_local
+        x = p["emb"][toks] + p["pos"][offset + jnp.arange(l_local)][None]
+        x = x + tp.tp_self_attention(
+            x, p["attn"], "model", seq_axis="seq", causal=False,
+            local_grads=True,
+        )
+        x = x + tp.tp_mlp(x, p["mlp"], "model", local_grads=True)
+        logits = x @ p["head"]
+        ll = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(ll, toks[..., None], axis=-1)[..., 0]
+        return -ll.sum() / (batch * seq_len)
+
+    def mk(mode):
+        return MPI_PS(
+            params, optim="adam", lr=1e-2, mode=mode,
+            mesh=mesh, axis_name=("data", "seq"),
+            param_specs=specs, batch_spec=P("data", "seq"),
+        )
+
+    leader, allg = mk("leader"), mk("allgather")
+    for _ in range(3):
+        l_loss, _ = leader.step(loss_fn=loss_fn, batch=tokens)
+        a_loss, _ = allg.step(loss_fn=loss_fn, batch=tokens)
+    np.testing.assert_allclose(float(l_loss), float(a_loss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(leader.params), jax.tree.leaves(allg.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
 def test_mpips_dp_pp_matches_sequential_dense():
     """MPI_PS drives a DP(2)xPP(4) mesh: GPipe pipeline_loss with
     local_grads=True under the fused vma-unchecked step == single-device

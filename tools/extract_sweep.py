@@ -122,7 +122,7 @@ def main() -> None:
         ],
         "note": "extracted by tools/extract_sweep.py from "
                 "BENCH_TPU_WATCH.jsonl; one record per metric line, "
-                "tagged _stage/_captured",
+                "tagged _stage + captured_by",
     }
     print(f"window {since}: {len(rows)} metric rows from "
           f"{len(stages)} stage runs -> {out}")
