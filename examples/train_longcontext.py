@@ -87,18 +87,11 @@ def main(argv=None):
         # the guard already pinned the platform to the host CPU; size the
         # virtual mesh BEFORE anything initializes the backend (the knob
         # is ignored once jax.devices() has run)
-        try:
-            jax.config.update("jax_num_cpu_devices", n_need)
-        except (RuntimeError, AttributeError):
-            # older JAX without the knob: the XLA flag works as long as
-            # the backend has not initialized yet (same fallback as
-            # __graft_entry__.dryrun_multichip)
-            if "--xla_force_host_platform_device_count" not in \
-                    os.environ.get("XLA_FLAGS", ""):
-                os.environ["XLA_FLAGS"] = (
-                    os.environ.get("XLA_FLAGS", "")
-                    + f" --xla_force_host_platform_device_count={n_need}"
-                )
+        from pytorch_ps_mpi_tpu.utils.backend_guard import (
+            size_virtual_cpu_mesh,
+        )
+
+        size_virtual_cpu_mesh(n_need)
     if len(jax.devices()) < n_need:
         print(
             f"backend {jax.default_backend()!r} has {len(jax.devices())} "

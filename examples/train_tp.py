@@ -76,6 +76,10 @@ def main(argv=None):
         print(f"--heads {args.heads} must divide by --tp {args.tp}",
               file=sys.stderr)
         sys.exit(2)
+    if args.hidden % args.heads:
+        print(f"--hidden {args.hidden} must divide by --heads {args.heads}",
+              file=sys.stderr)
+        sys.exit(2)
     if args.ffn % args.tp:
         print(f"--ffn {args.ffn} must divide by --tp {args.tp}",
               file=sys.stderr)
@@ -84,6 +88,7 @@ def main(argv=None):
     from pytorch_ps_mpi_tpu.utils.backend_guard import (
         enable_compilation_cache,
         ensure_live_backend,
+        size_virtual_cpu_mesh,
     )
 
     live = ensure_live_backend()
@@ -92,18 +97,17 @@ def main(argv=None):
     import jax
 
     if not live:
-        try:
-            jax.config.update("jax_num_cpu_devices", n_need)
-        except (RuntimeError, AttributeError):
-            if "--xla_force_host_platform_device_count" not in \
-                    os.environ.get("XLA_FLAGS", ""):
-                os.environ["XLA_FLAGS"] = (
-                    os.environ.get("XLA_FLAGS", "")
-                    + f" --xla_force_host_platform_device_count={n_need}"
-                )
+        # the guard already pinned the platform to the host CPU; size
+        # the virtual mesh before anything initializes the backend
+        size_virtual_cpu_mesh(n_need)
     if len(jax.devices()) < n_need:
-        print(f"need {n_need} devices (dp*sp*tp), have {len(jax.devices())}",
-              file=sys.stderr)
+        print(
+            f"backend {jax.default_backend()!r} has {len(jax.devices())} "
+            f"device(s) < dp*sp*tp={n_need}; re-run under a larger slice "
+            "or use the virtual CPU mesh (JAX_PLATFORMS=cpu "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_need})",
+            file=sys.stderr,
+        )
         sys.exit(2)
 
     import jax.numpy as jnp

@@ -391,15 +391,14 @@ def encode_tree(code: Codec, grads: PyTree, codec_state: PyTree, rng, axis_name:
 
 
 def _accumulate_grads(loss_fn, accum_steps: int, params: PyTree,
-                      batches: PyTree, axis_name: str,
-                      reduce_loss: Optional[Callable] = None):
+                      batches: PyTree, axis_name: str, *,
+                      reduce_loss: Callable):
     """Microbatch gradient accumulation inside one SPMD program: scan
     ``accum_steps`` microbatches, mean the local grads, cross-worker-
-    reduce the mean loss (``reduce_loss``; default pmean — the pure-DP
-    local-batch-mean convention). The ONE implementation both the fused
-    accum step and the instrumented grad stage compile — they are
-    asserted numerically equal in tests, so accumulation semantics must
-    never fork."""
+    reduce the mean loss via ``reduce_loss`` (REQUIRED — every caller
+    must pass the optimizer's own reduction so the reported loss can
+    never fork between the fused accum step and the instrumented grad
+    stage; they are asserted numerically equal in tests)."""
     def micro(acc, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         return jax.tree.map(jnp.add, acc, grads), loss
@@ -407,8 +406,6 @@ def _accumulate_grads(loss_fn, accum_steps: int, params: PyTree,
     zero = jax.tree.map(jnp.zeros_like, params)
     grads, losses = lax.scan(micro, zero, batches)
     grads = jax.tree.map(lambda g: g / accum_steps, grads)
-    if reduce_loss is None:
-        reduce_loss = lambda l: lax.pmean(l, axis_name)
     return reduce_loss(losses.mean()), grads
 
 

@@ -27,6 +27,29 @@ def enable_compilation_cache(path: str = "/tmp/jax_comp_cache") -> None:
         print(f"compilation cache not enabled: {e}", file=sys.stderr)
 
 
+def size_virtual_cpu_mesh(n: int) -> None:
+    """Size the host-CPU virtual device pool to >= ``n`` — call BEFORE
+    anything initializes the backend (a no-op afterwards: JAX reads the
+    knob once). The ONE implementation of the new-knob-try /
+    XLA-flag-fallback dance the example CLIs and the dryrun entry all
+    need (three hand-copied variants had already drifted)."""
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except (RuntimeError, AttributeError):
+        # RuntimeError: backend already initialized (caller's devices
+        # stand). AttributeError: older JAX without the knob — the XLA
+        # flag works as long as the backend has not initialized yet.
+        if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""
+        ):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={n}"
+            )
+
+
 def ensure_live_backend(
     timeout: float | None = None, retries: int | None = None
 ) -> bool:

@@ -537,6 +537,49 @@ def test_mpips_leader_model_parallel_checkpoint_resume(mesh_dp_tp, tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_mpips_dp_tp_accumulate_matches_plain_step(mesh_dp_tp):
+    """step_accumulate on the TP mesh: two identical microbatches mean
+    to exactly one plain step's gradient — params must match the
+    non-accum twin bit-for-bit shapes-wise and numerically."""
+    params, x, y = _tp_setup()
+    kw = dict(
+        optim="sgd", lr=0.1, mesh=mesh_dp_tp, axis_name="data",
+        param_specs=tp.tp_param_spec(params, "model"),
+        batch_spec=P("data"),
+    )
+    plain = MPI_PS(params, **kw)
+    accum = MPI_PS(params, **kw)
+    plain.step(loss_fn=_tp_loss_fn, batch=(x, y))
+    micro = (
+        jnp.broadcast_to(x[None], (2,) + x.shape),
+        jnp.broadcast_to(y[None], (2,) + y.shape),
+    )
+    loss, data = accum.step_accumulate(_tp_loss_fn, micro)
+    assert data["accum_steps"] == 2.0
+    for a, b in zip(jax.tree.leaves(plain.params), jax.tree.leaves(accum.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    assert "model" in str(accum.params["w1"].sharding.spec)
+
+
+def test_mpips_dp_tp_profile_smoke(mesh_dp_tp):
+    """profile=True on the model-parallel fused step: the traced
+    comm/compute split fills the reference schema without breaking the
+    step (instrument=True is the blocked mode, profile is the supported
+    one)."""
+    params, x, y = _tp_setup()
+    opt = MPI_PS(
+        params, optim="sgd", lr=0.1, mesh=mesh_dp_tp, axis_name="data",
+        param_specs=tp.tp_param_spec(params, "model"),
+        batch_spec=P("data"),
+    )
+    opt.step(loss_fn=_tp_loss_fn, batch=(x, y))  # compile first
+    loss, data = opt.step(loss_fn=_tp_loss_fn, batch=(x, y), profile=True)
+    assert jnp.isfinite(loss)
+    assert "profile_device_busy" in data
+    assert data["comm_wait"] >= 0.0
+
+
 def test_mpips_3d_leader_equals_allgather():
     """Leader (ZeRO-1) mode with TUPLE aggregation axes ('data', 'seq')
     on the 3-D mesh: the psum_scatter/all_gather pair linearizes the
