@@ -597,3 +597,43 @@ def test_blocktopk8_decode_sum_and_single_block():
     s = c8.decode_sum(stacked, g2.shape, g2.dtype)
     ref = c8.decode(p1, g2.shape, g2.dtype) + c8.decode(p2, g2.shape, g2.dtype)
     np.testing.assert_allclose(np.asarray(s), np.asarray(ref), rtol=1e-6)
+
+
+def test_every_codec_handles_local_shard_shapes():
+    """Model-parallel contract: under MPI_PS(param_specs=...) codecs
+    encode LOCAL shard gradients whose shapes carry the leading
+    [1]-shard axis ([1, d, f/tp] for TP leaves, [e_loc, d, f] for EP) —
+    every registered codec must init/encode/decode_sum at such shapes
+    without assuming 2-D or flat inputs, and identity-class codecs must
+    stay exact."""
+    from pytorch_ps_mpi_tpu.codecs.base import _REGISTRY
+
+    shapes = [(1, 8, 16), (2, 8, 16)]
+    kw = {
+        "ef": {"inner_name": "topk", "fraction": 0.5},
+        "powersgd": {"rank": 2, "min_compression_elems": 4},
+        "sign": {"use_pallas": False},
+        "topk": {"fraction": 0.5},
+        "blocktopk": {"fraction": 0.5, "block_size": 128},
+        "blocktopk8": {"fraction": 0.5, "block_size": 128},
+        "randomk": {"fraction": 0.5},
+        "qsgd": {"levels": 16},
+        "threshold": {"tau": 0.5, "max_fraction": 0.9},
+    }
+    for name in sorted(_REGISTRY):
+        code = get_codec(name, **kw.get(name, {}))
+        for shape in shapes:
+            g = jax.random.normal(jax.random.key(7), shape, jnp.float32)
+            st = code.init_state(shape, jnp.float32)
+            rng = jax.random.key(1) if code.needs_rng else None
+            payload, _ = code.encode(g, st, rng)
+            stacked = jax.tree.map(lambda x: jnp.stack([x, x]), payload)
+            out = code.decode_sum(stacked, shape, jnp.float32)
+            assert out.shape == shape, (name, shape, out.shape)
+            assert bool(jnp.all(jnp.isfinite(out))), (name, shape)
+            if name in ("identity", "bf16", "f16"):
+                np.testing.assert_allclose(
+                    np.asarray(out), 2 * np.asarray(g, np.float32),
+                    rtol=1e-2, atol=1e-3, err_msg=f"{name}@{shape}",
+                )
+            assert int(code.payload_bits(shape, jnp.float32)) > 0
