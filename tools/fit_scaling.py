@@ -12,11 +12,12 @@ actually measured transport:
    fit one effective bandwidth `BW_eff` minimizing the relative residual
    of `comm_ms = wire_bytes / BW_eff`, and report per-point residuals
    (how well the model's linear-in-bytes structure holds).
-2. **Per-boundary DCN cost** — the 8-worker runs at 1/2/4 processes
-   measure the same program with every psum crossing 0/1/3 process
+2. **Per-boundary DCN cost** — the 8-worker runs at 1/2/4/8 processes
+   measure the same program with every psum crossing 0/1/3/7 process
    boundaries: fit `T(p) = T_inproc + k * boundaries(p)` by least
    squares and report the residual — the model's
-   linear-in-boundary-crossings structure, checked against data.
+   linear-in-boundary-crossings structure, checked against data over a
+   7x boundary range.
 
 The ICI tier stays a labeled parameter (a single tunneled chip has no
 ICI link to measure); what the fit buys is (a) the model's *structure*
@@ -120,7 +121,7 @@ def main() -> None:
         implied_boundary_gbytes_per_s=round(wire / k / 1e6, 4),
         note=(
             "per-process-boundary transport cost fitted to the measured "
-            "2- and 4-process coordinated runs (loopback gRPC + one "
+            "2-, 4-, and 8-process coordinated runs (loopback gRPC + one "
             "shared kernel); the linear-in-boundaries structure is the "
             "checked claim. The implied boundary bandwidth is loopback-"
             "on-a-contended-host magnitude — it bounds the DCN tier's "
