@@ -401,12 +401,10 @@ def test_mpips_dp_ep_matches_dense_oracle():
     assert "expert" in str(opt.params["w1"].sharding.spec)
 
 
-def test_mpips_3d_dp_sp_tp_runs():
-    """The full 3-D composition the dryrun validates, as a regression
-    test: DP(2) x SP(2, ring attention) x TP(2) transformer block under
-    MPI_PS with tuple aggregation axes ('data', 'seq') and a
-    wire-narrowing codec. Loss must decrease and TP leaves stay
-    sharded."""
+def _3d_setup(sp: str = "ring"):
+    """Shared DP(2) x SP(2) x TP(2) toy transformer for the 3-D tests:
+    returns (mesh, params, specs, tokens, loss_fn) — one definition so
+    the ring/ulysses/leader variants can never silently diverge."""
     from jax import lax
 
     mesh = make_mesh(shape=(2, 2, 2), axis_names=("data", "seq", "model"))
@@ -436,7 +434,7 @@ def test_mpips_3d_dp_sp_tp_runs():
         x = p["emb"][toks] + p["pos"][offset + jnp.arange(l_local)][None]
         x = x + tp.tp_self_attention(
             x, p["attn"], "model", seq_axis="seq", causal=False,
-            local_grads=True,
+            sp=sp, local_grads=True,
         )
         x = x + tp.tp_mlp(x, p["mlp"], "model", local_grads=True)
         logits = x @ p["head"]
@@ -444,6 +442,16 @@ def test_mpips_3d_dp_sp_tp_runs():
         ll = jnp.take_along_axis(ll, toks[..., None], axis=-1)[..., 0]
         return -ll.sum() / (batch * seq_len)  # static global normalizer
 
+    return mesh, params, specs, tokens, loss_fn
+
+
+def test_mpips_3d_dp_sp_tp_runs():
+    """The full 3-D composition the dryrun validates, as a regression
+    test: DP(2) x SP(2, ring attention) x TP(2) transformer block under
+    MPI_PS with tuple aggregation axes ('data', 'seq') and a
+    wire-narrowing codec. Loss must decrease and TP leaves stay
+    sharded."""
+    mesh, params, specs, tokens, loss_fn = _3d_setup()
     opt = MPI_PS(
         params, optim="sgd", lr=0.5, code=get_codec("bf16"),
         mesh=mesh, axis_name=("data", "seq"),
@@ -621,48 +629,41 @@ def test_mpips_dp_tp_profile_smoke(mesh_dp_tp):
     assert data["comm_wait"] >= 0.0
 
 
+def test_mpips_3d_ulysses_equals_ring_twin():
+    """The DP x SP x TP composition with the ALL-TO-ALL sequence-
+    parallel design (Ulysses) under MPI_PS: both SP designs compute
+    IDENTICAL full attention, so 3 optimizer steps through each must
+    agree leaf-for-leaf — the numerics oracle for the ulysses +
+    local_grads path (all_to_all's transpose is the reverse
+    all_to_all). heads=4, tp=2 -> 2 local heads; seq size 2 divides
+    them."""
+    def run(sp):
+        mesh, params, specs, tokens, loss_fn = _3d_setup(sp)
+        opt = MPI_PS(
+            params, optim="sgd", lr=0.5,
+            mesh=mesh, axis_name=("data", "seq"),
+            param_specs=specs, batch_spec=P("data", "seq"),
+        )
+        for _ in range(3):
+            loss, _ = opt.step(loss_fn=loss_fn, batch=tokens)
+        return opt.params, float(loss)
+
+    ring_p, ring_loss = run("ring")
+    uly_p, uly_loss = run("ulysses")
+    np.testing.assert_allclose(ring_loss, uly_loss, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ring_p), jax.tree.leaves(uly_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+    assert "model" in str(uly_p["mlp"]["w1"].sharding.spec)
+
+
 def test_mpips_3d_leader_equals_allgather():
     """Leader (ZeRO-1) mode with TUPLE aggregation axes ('data', 'seq')
     on the 3-D mesh: the psum_scatter/all_gather pair linearizes the
     joint axes exactly like the host-side shard build, so numerics must
     equal the allgather twin (the property examples/train_tp.py's
     --mode leader --sp 2 path rides on)."""
-    from jax import lax
-
-    mesh = make_mesh(shape=(2, 2, 2), axis_names=("data", "seq", "model"))
-    vocab, d, heads, ffn = 64, 16, 4, 32
-    seq_len, batch = 16, 4
-    l_local = seq_len // 2
-
-    k = jax.random.key(0)
-    k_emb, k_pos, k_attn, k_mlp, k_head, k_tok = jax.random.split(k, 6)
-    params = {
-        "emb": 0.02 * jax.random.normal(k_emb, (vocab, d)),
-        "pos": 0.02 * jax.random.normal(k_pos, (seq_len, d)),
-        "attn": tp.init_tp_attention(k_attn, d, heads, 2),
-        "mlp": tp.init_tp_mlp(k_mlp, d, ffn, 2),
-        "head": 0.02 * jax.random.normal(k_head, (d, vocab)),
-    }
-    specs = {
-        "emb": P(), "pos": P(),
-        "attn": tp.tp_param_spec(params["attn"], "model"),
-        "mlp": tp.tp_param_spec(params["mlp"], "model"),
-        "head": P(),
-    }
-    tokens = jax.random.randint(k_tok, (batch, seq_len), 1, vocab)
-
-    def loss_fn(p, toks):
-        offset = lax.axis_index("seq") * l_local
-        x = p["emb"][toks] + p["pos"][offset + jnp.arange(l_local)][None]
-        x = x + tp.tp_self_attention(
-            x, p["attn"], "model", seq_axis="seq", causal=False,
-            local_grads=True,
-        )
-        x = x + tp.tp_mlp(x, p["mlp"], "model", local_grads=True)
-        logits = x @ p["head"]
-        ll = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(ll, toks[..., None], axis=-1)[..., 0]
-        return -ll.sum() / (batch * seq_len)
+    mesh, params, specs, tokens, loss_fn = _3d_setup()
 
     def mk(mode):
         return MPI_PS(
