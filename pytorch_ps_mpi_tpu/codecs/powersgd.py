@@ -42,8 +42,16 @@ from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
 
 
 def _matrix_shape(shape):
-    n = shape[0]
-    m = int(np.prod(shape[1:]))
+    """Matrix view [n, m] of a tensor: first dim x rest — SKIPPING
+    leading singleton dims. The model-parallel shard convention carries
+    a leading [1] local-shard axis ([1, d, f/tp] TP leaves); without the
+    skip that axis becomes n=1, the rank clips to 1, r*(n+m) >= n*m,
+    and PowerSGD silently refuses to compress every TP leaf."""
+    i = 0
+    while i < len(shape) - 1 and shape[i] == 1:
+        i += 1
+    n = shape[i]
+    m = int(np.prod(shape[i + 1:]))
     return n, m
 
 
