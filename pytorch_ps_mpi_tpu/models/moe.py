@@ -3,9 +3,9 @@
 No reference analog (the reference ships no models at all — SURVEY: "no
 models, no training loop"); this pairs with ``parallel/ep.py`` the way
 ``models/bert.py`` pairs with ``parallel/ring.py``: the dense encoder
-stack with every other FFN replaced by a top-1 mixture-of-experts layer
-(Fedus et al. 2021, Switch Transformer, arXiv:2101.03961 — public
-technique).
+stack with every other FFN replaced by a top-k mixture-of-experts layer
+(``top_k=1``: Fedus et al. 2021, Switch Transformer, arXiv:2101.03961;
+``top_k=2``: the classic GShard gate — public techniques).
 
 Two execution modes, same parameters:
 
@@ -41,6 +41,7 @@ class SwitchConfig:
     max_position: int = 128
     n_experts: int = 8
     capacity: int = 64          # per (expert, source device) — ep.py note
+    top_k: int = 1              # 1 = Switch; 2 = classic GShard gate
     expert_axis: Optional[str] = None
     dtype: Any = jnp.float32
 
@@ -54,7 +55,7 @@ class SwitchConfig:
 
 
 class MoEFFN(nn.Module):
-    """Top-1 routed FFN over n_experts expert MLPs."""
+    """Top-k routed FFN over n_experts expert MLPs (cfg.top_k)."""
 
     cfg: SwitchConfig
 
@@ -83,11 +84,12 @@ class MoEFFN(nn.Module):
         b, l, _ = x.shape
         tok = x.reshape(b * l, d)
         if c.expert_axis is not None:
-            out = moe_apply(tok, params, c.expert_axis, capacity=c.capacity)
+            out = moe_apply(tok, params, c.expert_axis,
+                            capacity=c.capacity, top_k=c.top_k)
         else:
             from pytorch_ps_mpi_tpu.parallel.ep import moe_dense_oracle
 
-            out = moe_dense_oracle(tok, params)
+            out = moe_dense_oracle(tok, params, top_k=c.top_k)
         return out.reshape(b, l, d)
 
 

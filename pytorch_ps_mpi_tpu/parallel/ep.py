@@ -1,4 +1,6 @@
-"""Expert parallelism: GShard-style top-1 MoE over a mesh axis.
+"""Expert parallelism: GShard-style top-k MoE over a mesh axis
+(top-1 Switch gate by default; ``top_k=2`` is the classic GShard gate
+with the chosen experts' probs renormalized per token).
 
 No reference analog (SURVEY §2.5: EP absent — out of reference scope) —
 added to complete the parallelism matrix (DP × SP × TP × PP × EP). The
@@ -74,67 +76,114 @@ def _route_top1(x, wr) -> Tuple[jax.Array, jax.Array]:
     return eidx, gate
 
 
+def _route_topk(x, wr, k: int) -> Tuple[jax.Array, jax.Array]:
+    """(expert indices [n, k], gates [n, k]) — softmax probs of the
+    top-k experts, renormalized to sum to 1 per token (the GShard top-2
+    convention: the chosen experts split the token's whole weight)."""
+    probs = jax.nn.softmax(x @ wr, axis=-1)          # [n, E]
+    gates, eidx = lax.top_k(probs, k)                # [n, k] each
+    gates = gates / jnp.maximum(gates.sum(axis=-1, keepdims=True), 1e-9)
+    return eidx, gates
+
+
+def _dispatch_combine(x, eidx_k, gate_k, w1, w2, expert_axis, capacity):
+    """Dispatch→expert→combine for a top-k assignment in ONE all_to_all
+    round trip: choice rank c writes its tokens into slots
+    ``[c*C, (c+1)*C)`` of a single ``[E, k*C, d]`` buffer (each choice
+    has its own independent capacity budget, so a token can lose its
+    2nd choice to capacity while keeping its 1st), the experts process
+    all k*C slots together, and each choice combines from its slice.
+    k=1 reduces exactly to the original top-1 machinery; k>1 costs the
+    same two all_to_all launches per layer, not 2k.
+
+    ``eidx_k``/``gate_k``: [n, k]."""
+    n_loc, d = x.shape
+    k = eidx_k.shape[1]
+    n_dev = lax.axis_size(expert_axis)
+    e_loc = w1.shape[0]
+    n_experts = n_dev * e_loc
+
+    buf = jnp.zeros((n_experts, k * capacity, d), x.dtype)
+    keeps, slots = [], []
+    for c in range(k):
+        eidx = eidx_k[:, c]
+        # slot of each token within its expert's capacity buffer for THIS
+        # choice rank (among this device's tokens): running count of
+        # same-expert tokens before it
+        onehot = jax.nn.one_hot(eidx, n_experts, dtype=jnp.int32)   # [n, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot                    # 1-based
+        slot0 = pos.max(axis=1) - 1                                  # [n]
+        keep = (slot0 >= 0) & (slot0 < capacity)
+        slot = jnp.clip(slot0, 0, capacity - 1)
+        buf = buf.at[eidx, c * capacity + slot].add(
+            jnp.where(keep[:, None], x, jnp.zeros_like(x))
+        )
+        keeps.append(keep)
+        slots.append(slot)
+
+    # one all_to_all over the expert axis: send device j its experts'
+    # slots (all k choices at once), receive my experts' tokens
+    buf = buf.reshape(n_dev, e_loc, k * capacity, d)
+    recv = lax.all_to_all(buf, expert_axis, split_axis=0, concat_axis=0)
+    # [n_dev, e_loc, k*C, d] — recv[j] = device j's tokens for MY experts
+
+    tok = recv.transpose(1, 0, 2, 3).reshape(e_loc, n_dev * k * capacity, d)
+    h = jax.nn.gelu(jnp.einsum("etd,edf->etf", tok, w1))
+    y = jnp.einsum("etf,efd->etd", h, w2)
+    y = y.reshape(e_loc, n_dev, k * capacity, d).transpose(1, 0, 2, 3)
+
+    # return trip: outputs for device j's tokens go back to device j
+    back = lax.all_to_all(y, expert_axis, split_axis=0, concat_axis=0)
+    out_buf = back.reshape(n_experts, k * capacity, d)
+
+    # combine: each kept (token, choice) reads its slot, scaled by gate
+    out = jnp.zeros_like(x)
+    for c in range(k):
+        tok_out = out_buf[eidx_k[:, c], c * capacity + slots[c]]
+        tok_out = tok_out * gate_k[:, c][:, None]
+        out = out + jnp.where(keeps[c][:, None], tok_out,
+                              jnp.zeros_like(tok_out))
+    return out
+
+
 def moe_apply(
     x: jax.Array,
     params: Dict[str, jax.Array],
     expert_axis: str,
     *,
     capacity: int,
+    top_k: int = 1,
 ) -> jax.Array:
-    """Top-1 MoE forward for this device's tokens.
+    """Top-k MoE forward for this device's tokens (default top-1, the
+    Switch/GShard-minimal config; ``top_k=2`` is the classic GShard
+    gate with the chosen experts' probs renormalized per token).
 
     Returns ``[n_loc, d]``: each token's gated expert output (zeros for
-    capacity-dropped tokens). Differentiable end to end — the dispatch/
+    capacity-dropped choices). Differentiable end to end — the dispatch/
     combine are scatter-adds/gathers and the collective is all_to_all
-    (whose transpose is the reverse all_to_all).
+    (whose transpose is the reverse all_to_all). Each choice rank owns
+    an independent capacity budget inside ONE shared ``[E, k*C, d]``
+    buffer (2x the slots at top-2 — GShard's budget), so a token can
+    lose its 2nd choice to capacity while keeping its 1st — and every
+    layer pays exactly one all_to_all round trip regardless of k.
     """
-    n_loc, d = x.shape
-    n_dev = lax.axis_size(expert_axis)
     w1, w2 = params["w1"], params["w2"]         # [e_loc, d, f], [e_loc, f, d]
-    e_loc = w1.shape[0]
-    n_experts = params["wr"].shape[1]
-    assert n_experts == n_dev * e_loc, (n_experts, n_dev, e_loc)
-
-    eidx, gate = _route_top1(x, params["wr"])   # [n], [n]
-
-    # slot of each token within its expert's capacity buffer (among THIS
-    # device's tokens): running count of same-expert tokens before it
-    onehot = jax.nn.one_hot(eidx, n_experts, dtype=jnp.int32)      # [n, E]
-    pos = jnp.cumsum(onehot, axis=0) * onehot                       # 1-based
-    slot0 = pos.max(axis=1) - 1                                     # [n]
-    keep = (slot0 >= 0) & (slot0 < capacity)
-    slot = jnp.clip(slot0, 0, capacity - 1)
-
-    # dispatch: [E, C, d] buffer, capacity-dropped tokens masked out
-    buf = jnp.zeros((n_experts, capacity, d), x.dtype)
-    buf = buf.at[eidx, slot].add(
-        jnp.where(keep[:, None], x, jnp.zeros_like(x))
-    )
-
-    # all_to_all over the expert axis: send device j its experts' slots,
-    # receive my experts' tokens from every device
-    buf = buf.reshape(n_dev, e_loc, capacity, d)
-    recv = lax.all_to_all(buf, expert_axis, split_axis=0, concat_axis=0)
-    # [n_dev, e_loc, C, d] — recv[j] = device j's tokens for MY experts
-
-    tok = recv.transpose(1, 0, 2, 3).reshape(e_loc, n_dev * capacity, d)
-    h = jax.nn.gelu(jnp.einsum("etd,edf->etf", tok, w1))
-    y = jnp.einsum("etf,efd->etd", h, w2)
-    y = y.reshape(e_loc, n_dev, capacity, d).transpose(1, 0, 2, 3)
-
-    # return trip: outputs for device j's tokens go back to device j
-    back = lax.all_to_all(y, expert_axis, split_axis=0, concat_axis=0)
-    out_buf = back.reshape(n_experts, capacity, d)
-
-    # combine: each kept token reads its slot, scaled by its gate
-    tok_out = out_buf[eidx, slot] * gate[:, None]
-    return jnp.where(keep[:, None], tok_out, jnp.zeros_like(tok_out))
+    n_dev = lax.axis_size(expert_axis)
+    assert params["wr"].shape[1] == n_dev * w1.shape[0], (
+        params["wr"].shape, n_dev, w1.shape)
+    if top_k == 1:
+        eidx, gate = _route_top1(x, params["wr"])
+        eidx_k, gate_k = eidx[:, None], gate[:, None]
+    else:
+        eidx_k, gate_k = _route_topk(x, params["wr"], top_k)
+    return _dispatch_combine(x, eidx_k, gate_k, w1, w2, expert_axis, capacity)
 
 
-def moe_dense_oracle(x: jax.Array, params: Dict[str, jax.Array]) -> jax.Array:
-    """Single-device reference: every token through its own top-1 expert
-    (no capacity limit) — the equality oracle for tests AND the dense
-    fallback ``models/moe.py`` runs outside ``shard_map``.
+def moe_dense_oracle(x: jax.Array, params: Dict[str, jax.Array],
+                     top_k: int = 1) -> jax.Array:
+    """Single-device reference: every token through its own top-k
+    expert(s) (no capacity limit) — the equality oracle for tests AND
+    the dense fallback ``models/moe.py`` runs outside ``shard_map``.
 
     Computes all experts for all tokens and combines with a one-hot
     select (n·E·f work) rather than gathering per-token weight copies: a
@@ -143,8 +192,17 @@ def moe_dense_oracle(x: jax.Array, params: Dict[str, jax.Array]) -> jax.Array:
     ``[n, E, f]``, ~30x smaller there. Gradients are identical: the
     one-hot zeroes non-selected experts' paths exactly like the gather.
     """
-    eidx, gate = _route_top1(x, params["wr"])
     h = jax.nn.gelu(jnp.einsum("td,edf->tef", x, params["w1"]))
     y_all = jnp.einsum("tef,efd->ted", h, params["w2"])
-    onehot = jax.nn.one_hot(eidx, params["wr"].shape[1], dtype=x.dtype)
-    return jnp.einsum("ted,te->td", y_all, onehot) * gate[:, None]
+    n_experts = params["wr"].shape[1]
+    if top_k == 1:
+        eidx, gate = _route_top1(x, params["wr"])
+        onehot = jax.nn.one_hot(eidx, n_experts, dtype=x.dtype)
+        return jnp.einsum("ted,te->td", y_all, onehot) * gate[:, None]
+    eidx, gates = _route_topk(x, params["wr"], top_k)
+    out = jnp.zeros_like(x)
+    for c in range(top_k):
+        onehot = jax.nn.one_hot(eidx[:, c], n_experts, dtype=x.dtype)
+        out = out + (jnp.einsum("ted,te->td", y_all, onehot)
+                     * gates[:, c][:, None])
+    return out
