@@ -16,6 +16,12 @@ Two execution modes, same parameters:
   ``parallel/ep.moe_apply`` (capacity buffers + all_to_all). Expert
   weights are stacked on a leading ``[E]`` axis either way — shard them
   ``P(expert_axis)`` host-side (see :func:`moe_param_spec`).
+
+Load balancing: set ``aux_loss_weight`` and apply with
+``mutable=["aux_loss"]`` — each MoE layer sows its weighted
+Switch/GShard balance loss (``parallel/ep.load_balance_loss``); add the
+collection's sum to the objective, or the router collapses onto a few
+experts and the capacity buffers drop the rest.
 """
 
 from __future__ import annotations
@@ -28,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_ps_mpi_tpu.models.bert import BertConfig, SelfAttention
-from pytorch_ps_mpi_tpu.parallel.ep import moe_apply
+from pytorch_ps_mpi_tpu.parallel.ep import load_balance_loss, moe_apply
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +48,11 @@ class SwitchConfig:
     n_experts: int = 8
     capacity: int = 64          # per (expert, source device) — ep.py note
     top_k: int = 1              # 1 = Switch; 2 = classic GShard gate
+    # weight of the Switch/GShard load-balancing auxiliary loss each MoE
+    # layer SOWS into the "aux_loss" collection: apply with
+    # mutable=["aux_loss"] and add the collection's SUM to the objective
+    # as-is — the sown values already carry this weight. 0 disables.
+    aux_loss_weight: float = 0.0
     expert_axis: Optional[str] = None
     dtype: Any = jnp.float32
 
@@ -83,6 +94,10 @@ class MoEFFN(nn.Module):
         }
         b, l, _ = x.shape
         tok = x.reshape(b * l, d)
+        if c.aux_loss_weight:
+            aux = load_balance_loss(tok, params["wr"], top_k=c.top_k,
+                                    expert_axis=c.expert_axis)
+            self.sow("aux_loss", "load_balance", c.aux_loss_weight * aux)
         if c.expert_axis is not None:
             out = moe_apply(tok, params, c.expert_axis,
                             capacity=c.capacity, top_k=c.top_k)

@@ -179,6 +179,40 @@ def moe_apply(
     return _dispatch_combine(x, eidx_k, gate_k, w1, w2, expert_axis, capacity)
 
 
+def load_balance_loss(x: jax.Array, wr: jax.Array, top_k: int = 1,
+                      expert_axis: str = None) -> jax.Array:
+    """Switch/GShard auxiliary load-balancing loss for this device's
+    tokens: ``E * sum_e f_e * P_e`` where ``f_e`` is the fraction of
+    (token, choice) assignments routed to expert e and ``P_e`` the mean
+    router probability of e (Fedus et al. 2021 eq. 4; Lepikhin et al.
+    2020 §3.2 — public techniques). Minimized (value 1.0) at a perfectly
+    uniform assignment; without it the router collapses onto a few
+    experts and the capacity buffers drop everything else.
+
+    Differentiable through ``P_e`` (the f_e counts are stop-gradient
+    by construction — argmax/top_k are non-differentiable). With
+    ``expert_axis`` bound, f/P are psum-averaged so every device
+    penalizes the GLOBAL balance, not its local slice. The router
+    forward here duplicates the dispatch path's textually, but under
+    jit XLA's common-subexpression elimination merges the identical
+    ``x @ wr`` / softmax; ``lax.top_k`` breaks ties lowest-index-first
+    exactly like ``_route_top1``'s argmax, so the assignment counted is
+    the assignment dispatched."""
+    probs = jax.nn.softmax(x @ wr, axis=-1)              # [n, E]
+    n_experts = wr.shape[1]
+    _, eidx = lax.top_k(probs, top_k)                    # [n, k]
+    counts = jax.nn.one_hot(eidx, n_experts, dtype=probs.dtype).sum(
+        axis=(0, 1))                                     # [E]
+    n_assign = jnp.asarray(eidx.size, probs.dtype)
+    p_mean = probs.mean(axis=0)                          # [E]
+    if expert_axis is not None:
+        counts = lax.psum(counts, expert_axis)
+        n_assign = lax.psum(n_assign, expert_axis)
+        p_mean = lax.pmean(p_mean, expert_axis)
+    f = counts / jnp.maximum(n_assign, 1.0)
+    return n_experts * jnp.sum(f * p_mean)
+
+
 def moe_dense_oracle(x: jax.Array, params: Dict[str, jax.Array],
                      top_k: int = 1) -> jax.Array:
     """Single-device reference: every token through its own top-k

@@ -190,3 +190,96 @@ def test_moe_top2_gates_renormalized():
     _, gates = _route_topk(x, params["wr"], 2)
     np.testing.assert_allclose(np.asarray(gates.sum(axis=-1)),
                                np.ones(x.shape[0]), rtol=1e-5)
+
+
+def test_load_balance_loss_properties(exp4):
+    """Switch aux loss: exactly 1.0 at a perfectly uniform assignment,
+    > 1 when the router collapses, matches the E*sum(f*P) formula, and
+    the expert_axis form psums to the GLOBAL balance."""
+    from pytorch_ps_mpi_tpu.parallel.ep import load_balance_loss
+
+    n, d = 64, D_MODEL
+    x = jax.random.normal(jax.random.key(13), (n, d))
+
+    # collapsed router: one dominant column -> loss far above 1
+    wr_collapsed = jnp.zeros((d, E)).at[:, 0].set(5.0)
+    l_col = float(load_balance_loss(x, wr_collapsed))
+    assert l_col > 2.0, l_col
+
+    # random router: near-uniform-ish, strictly less than collapsed
+    wr = 0.02 * jax.random.normal(jax.random.key(14), (d, E))
+    l_rand = float(load_balance_loss(x, wr))
+    assert 0.9 < l_rand < l_col
+
+    # formula check against a hand computation (top-1)
+    probs = jax.nn.softmax(x @ wr, axis=-1)
+    eidx = jnp.argmax(probs, axis=-1)
+    f = np.bincount(np.asarray(eidx), minlength=E) / n
+    want = E * float((f * np.asarray(probs.mean(axis=0))).sum())
+    np.testing.assert_allclose(l_rand, want, rtol=1e-5)
+
+    # distributed form == computing on the concatenated global tokens
+    l_global = float(load_balance_loss(x, wr))
+    l_dist = float(jax.jit(
+        jax.shard_map(
+            lambda xs: load_balance_loss(xs, wr, expert_axis="expert")[None],
+            mesh=exp4, in_specs=P("expert"), out_specs=P("expert"),
+        )
+    )(x)[0])
+    np.testing.assert_allclose(l_dist, l_global, rtol=1e-5)
+
+
+def test_switch_aux_loss_sown_and_trainable():
+    """cfg.aux_loss_weight sows the weighted balance loss per MoE layer
+    (one value each, differentiable w.r.t. the router), and descending
+    the aux loss alone genuinely improves balance — the sign check a
+    nonzero-gradient assert cannot give."""
+    from pytorch_ps_mpi_tpu.models.moe import SwitchConfig, SwitchMLM
+
+    cfg = SwitchConfig(vocab_size=211, hidden_size=32, num_layers=2,
+                       num_heads=4, intermediate_size=48, max_position=32,
+                       n_experts=8, capacity=256, aux_loss_weight=0.01)
+    tokens = jax.random.randint(jax.random.key(0), (2, 16), 0, 211)
+    model = SwitchMLM(cfg)
+    # init sows too: keep only the params collection (the documented
+    # usage — apply with mutable=["aux_loss"] collects fresh values)
+    params = {"params": model.init(jax.random.key(1), tokens)["params"]}
+
+    logits, aux = model.apply(params, tokens, mutable=["aux_loss"])
+    sown = jax.tree.leaves(aux["aux_loss"])
+    assert len(sown) == cfg.num_layers  # one per MoE layer
+    total_aux = sum(jnp.sum(v) for v in sown)
+    assert float(total_aux) > 0.0
+    # the sown values already carry the weight: each ~ 0.01 * O(1)
+    assert float(total_aux) < 1.0
+
+    # and it is differentiable: grads w.r.t. the router are nonzero
+    def loss(p):
+        _, a = model.apply(p, tokens, mutable=["aux_loss"])
+        return sum(jnp.sum(v) for v in jax.tree.leaves(a["aux_loss"]))
+
+    g = jax.grad(loss)(params)
+    wr_grads = [np.asarray(v) for path, v in
+                jax.tree_util.tree_flatten_with_path(g)[0]
+                if any(getattr(p, "key", "") == "wr" for p in path)]
+    assert wr_grads and any(np.abs(w).max() > 0 for w in wr_grads)
+
+
+def test_load_balance_loss_descent_improves_balance():
+    """Gradient descent on the aux loss ALONE reduces it from a
+    collapsed router — the sign/semantics check (a wrong-signed psum or
+    negated loss would pass a nonzero-grad assert but fail this)."""
+    from pytorch_ps_mpi_tpu.parallel.ep import load_balance_loss
+
+    n, d = 64, D_MODEL
+    x = jax.random.normal(jax.random.key(21), (n, d))
+    wr = jnp.zeros((d, E)).at[:, 0].set(2.0)  # collapsed start
+
+    loss = jax.jit(lambda w: load_balance_loss(x, w, top_k=2))
+    grad = jax.jit(jax.grad(lambda w: load_balance_loss(x, w, top_k=2)))
+    l0 = float(loss(wr))
+    for _ in range(50):
+        wr = wr - 0.5 * grad(wr)
+    l1 = float(loss(wr))
+    assert l1 < l0, (l0, l1)
+    assert l1 < 1.5  # approaching the uniform optimum of 1.0
