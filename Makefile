@@ -18,4 +18,13 @@ native:
 	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp
 	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libtcpps.so native/tcpps.cpp
 
-.PHONY: test bench native tpu-watch
+# CPU-runnable protocol/convergence benches (the TPU-window stages run
+# via tpu-watch); each emits JSON lines for benchmarks/results/
+bench-protocol:
+	python benchmarks/async_bench.py --model resnet18 --workers 2 \
+		--fast-steps 6 --slow-steps 2 --slow-ms 2000
+	python benchmarks/wan_bench.py
+	python benchmarks/staleness_bench.py
+	python benchmarks/convergence_bench.py
+
+.PHONY: test bench bench-protocol native tpu-watch
