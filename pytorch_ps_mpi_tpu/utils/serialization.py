@@ -98,9 +98,17 @@ def load_pytree(path: str, template: PyTree) -> PyTree:
             leaves = []
             for i, t in enumerate(tmpl_leaves):
                 raw = native.decompress(data[f"leaf_{i}"].tobytes())
-                leaves.append(
-                    np.frombuffer(raw, np.dtype(t.dtype)).reshape(np.shape(t))
-                )
+                # template leaves may be plain python scalars (an
+                # optimizer state_dict carries step_count as an int):
+                # coerce ONLY those — np.asarray on an array leaf would
+                # device->host copy every sharded param just to read its
+                # dtype (and raise on non-addressable multi-host arrays)
+                if hasattr(t, "dtype"):
+                    dt, shp = np.dtype(t.dtype), np.shape(t)
+                else:
+                    scalar = np.asarray(t)
+                    dt, shp = scalar.dtype, scalar.shape
+                leaves.append(np.frombuffer(raw, dt).reshape(shp))
         else:
             leaves = [data[f"leaf_{i}"] for i in range(n)]
     return jax.tree.unflatten(treedef, leaves)

@@ -509,6 +509,41 @@ def test_mpips_model_parallel_checkpoint_resume(mesh_dp_tp, tmp_path):
     assert "model" in str(fresh.params["w1"].sharding.spec)
 
 
+def test_mpips_model_parallel_numpy_fallback_restore(mesh_dp_tp, tmp_path):
+    """The npz fallback path (use_orbax=False): restored leaves come
+    back as host arrays with no sharding — _decommit_restored must let
+    the next fused step reshard them, and training must continue
+    bit-exactly on the TP mesh."""
+    from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
+
+    params, x, y = _tp_setup()
+
+    def mk():
+        return MPI_PS(
+            params, optim="sgd", lr=0.1, momentum=0.9,
+            mesh=mesh_dp_tp, axis_name="data",
+            param_specs=tp.tp_param_spec(params, "model"),
+            batch_spec=P("data"),
+        )
+
+    opt = mk()
+    for _ in range(2):
+        opt.step(loss_fn=_tp_loss_fn, batch=(x, y))
+    ckpt = CheckpointManager(str(tmp_path / "npz"), use_orbax=False)
+    ckpt.save(opt._step_count, opt.state_dict())
+    for _ in range(2):
+        opt.step(loss_fn=_tp_loss_fn, batch=(x, y))
+
+    fresh = mk()
+    fresh.load_state_dict(ckpt.restore(fresh.state_dict()))
+    for _ in range(2):
+        fresh.step(loss_fn=_tp_loss_fn, batch=(x, y))
+    for a, b in zip(jax.tree.leaves(opt.params), jax.tree.leaves(fresh.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    assert "model" in str(fresh.params["w1"].sharding.spec)
+
+
 def test_mpips_leader_model_parallel_checkpoint_resume(mesh_dp_tp, tmp_path):
     """Same round trip for leader (ZeRO-1) mode: the jointly-sharded
     [data*model, shard_len] master-param/optimizer shards restore

@@ -191,3 +191,25 @@ def test_codec_timing_encode_phase_is_partial_cost():
 
     with pytest.raises(ValueError):
         codec_roundtrip_seconds(code, shape, jnp.float32, k=8, phase="dec")
+
+
+def test_save_load_pytree_python_scalar_leaves(tmp_path):
+    """Regression: load_pytree's compressed path crashed on template
+    leaves that are plain python scalars (an optimizer state_dict
+    carries step_count as an int) — np.asarray-coerced dtype/shape must
+    be used, not array-only attributes."""
+    import numpy as np
+
+    from pytorch_ps_mpi_tpu.utils.serialization import (
+        load_pytree,
+        save_pytree,
+    )
+
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "step_count": 7}
+    p = str(tmp_path / "t.npz")
+    save_pytree(p, tree, compress=True)
+    out = load_pytree(p, {"w": np.zeros((3, 4), np.float32),
+                          "step_count": 0})
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    assert int(out["step_count"]) == 7
