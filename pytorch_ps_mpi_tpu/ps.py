@@ -69,11 +69,6 @@ def _tree_bytes(tree: PyTree) -> int:
     )
 
 
-def _tree_size(tree: PyTree) -> int:
-    """Total element count of a pytree's arrays."""
-    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
-
-
 def _spec_axes(spec) -> Tuple[str, ...]:
     """Flattened mesh-axis names a PartitionSpec shards over (in spec
     order); () for a replicated leaf."""
