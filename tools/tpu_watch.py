@@ -40,22 +40,26 @@ OUT = os.path.join(REPO, "BENCH_TPU_WATCH.jsonl")
 # (bert --skip-distributed; a light async fleet): their full-size runs
 # have committed artifacts in benchmarks/results/, and the watcher's job
 # is to catch TPU liveness windows quickly, not to redo CPU work.
+# ORDER = information value: a window can close mid-sweep, so the
+# stages with NO committed TPU rows yet run FIRST (VERDICT r4 next #1:
+# flash floor's upper half, the first GPT-2 rows, the donate_buffers
+# HBM measurement); re-measurement of already-committed series follows.
 STAGES = [
+    # flash-vs-dense crossover sweep behind the FLASH_MIN_SEQ dispatch
+    ("flash_tune", [sys.executable, "benchmarks/flash_tune.py"], 1800),
+    # second model family: GPT-2-small causal LM at s1024/s2048,
+    # flash/einsum A/B (+ remat pair) — no committed rows yet
+    ("gpt_bench", [sys.executable, "benchmarks/gpt_bench.py"], 1800),
+    # peak-HBM with/without donate_buffers (+ remat), fresh subprocess
+    # per config so PJRT's cumulative peak is honest (VERDICT r4 #8)
+    ("memory_bench", [sys.executable, "benchmarks/memory_bench.py"], 1800),
     ("bench", [sys.executable, "bench.py"], 900),
-    ("codec_bench", [sys.executable, "benchmarks/codec_bench.py"], 1800),
-    ("leader_bench", [sys.executable, "benchmarks/leader_bench.py"], 600),
     ("bert_bench",
      [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed"],
      2400),  # 8 train lines (flash/einsum A/B at s128/s512/s2048 +
              # b32 s128 / b8 s512 MFU-push configs) + codec table
-    # peak-HBM with/without donate_buffers (+ remat), fresh subprocess
-    # per config so PJRT's cumulative peak is honest (VERDICT r4 #8)
-    ("memory_bench", [sys.executable, "benchmarks/memory_bench.py"], 1800),
-    # flash-vs-dense crossover sweep behind the FLASH_MIN_SEQ dispatch
-    ("flash_tune", [sys.executable, "benchmarks/flash_tune.py"], 1800),
-    # second model family: GPT-2-small causal LM at s1024/s2048,
-    # flash/einsum A/B (the causal-schedule path inside a real step)
-    ("gpt_bench", [sys.executable, "benchmarks/gpt_bench.py"], 1800),
+    ("codec_bench", [sys.executable, "benchmarks/codec_bench.py"], 1800),
+    ("leader_bench", [sys.executable, "benchmarks/leader_bench.py"], 600),
     ("async_bench",
      [sys.executable, "benchmarks/async_bench.py", "--model", "resnet18",
       "--workers", "2", "--fast-steps", "6", "--slow-steps", "2",
