@@ -387,12 +387,27 @@ def _attention_jnp(q, k, v, q_offset, k_offset, causal, scale):
     return out, lse
 
 
+def _default_block_targets(lq: int, lk: int) -> tuple:
+    """Measured block-size policy (flash_tune sweep, v5e 2026-08-01,
+    `BENCH_TPU_WATCH.jsonl`): at s512 the 128x128 tile wins (3.28 ms vs
+    3.55 for 512x512); at s2048 512x1024 wins 4.9x over 128x128 (6.64 vs
+    32.5 ms) and at s8192 7.2x (16.4 vs 117.6 ms) — larger k/v tiles
+    amortize per-grid-step dispatch and keep the MXU fed once the score
+    block is MXU-shaped on both dims, while below ~1k sequence the grid
+    is too small for tile residency to matter and 128's divisibility
+    into short tails wins. Crossover bracketed between 512 and 2048;
+    big tiles engage from 1024 up."""
+    if max(lq, lk) >= 1024:
+        return 512, 1024
+    return 128, 128
+
+
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *,
     causal: bool = False,
     scale: Optional[float] = None,
     q_offset=None, k_offset=None,
-    block_q: int = 128, block_k: int = 128,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
     return_lse: bool = False,
 ):
     """Tiled attention over ``[batch, seq, heads, head_dim]`` tensors.
@@ -411,8 +426,9 @@ def flash_attention(
     k_offset = jnp.zeros((), jnp.int32) if k_offset is None else k_offset
 
     mb = _min_block_for(q.dtype)
-    bq = _pick_block(lq, block_q, mb)
-    bk = _pick_block(lk, block_k, mb)
+    dbq, dbk = _default_block_targets(lq, lk)
+    bq = _pick_block(lq, block_q if block_q is not None else dbq, mb)
+    bk = _pick_block(lk, block_k if block_k is not None else dbk, mb)
     if bq is None or bk is None:
         out, lse = _attention_jnp(q, k, v, q_offset, k_offset, causal, scale)
         return (out, lse) if return_lse else out
@@ -448,13 +464,22 @@ def mosaic_lowering_ok(head_dim: int = 64, dtype=jnp.bfloat16,
     can regress independently). Gates the AUTO dispatches ('full'
     attention, ring/ulysses defaults) so a lowering regression degrades
     to the dense path instead of breaking every TPU bench/model; the
-    explicit 'flash' mode stays ungated and fails loudly. The probe
-    sequence is clamped small — lowering failures are shape-class
-    properties (dtype tiling, lane-dim head size), not length
-    properties."""
-    bq = _pick_block(seq, 128, _min_block_for(dtype))
+    explicit 'flash' mode stays ungated and fails loudly. Lowering
+    failures are shape-CLASS properties (dtype tiling, lane-dim head
+    size, per-block VMEM footprint) — and since the default block tier
+    is now a function of sequence length (`_default_block_targets`),
+    the probe must compile the SAME tier the dispatch would use: a
+    small-tile probe passing says nothing about whether the 512x1024
+    tiles lower or fit VMEM at this head_dim. The probe sequence is
+    therefore clamped per tier — small for the 128-tile tier, 1024 for
+    the big-tile tier — each cached independently."""
+    if seq >= 1024:
+        probe_seq = 1024  # compiles the 512x1024-block kernel family
+    else:
+        bq = _pick_block(seq, 128, _min_block_for(dtype))
+        probe_seq = 2 * (bq or 64)
     return _lowering_probe(int(head_dim), jnp.dtype(dtype).name,
-                           2 * (bq or 64))
+                           probe_seq)
 
 
 @functools.lru_cache(maxsize=16)
@@ -466,8 +491,11 @@ def _lowering_probe(head_dim: int, dtype_name: str, seq: int) -> bool:
         # is 1, and a block dim of 1 trivially "equals the array dim" —
         # Mosaic's tile rule then passes shapes it rejects for every real
         # model (this exact coincidence let a (1, bq) lse block through
-        # the probe and then broke BERT on the first live TPU window)
-        q = jnp.zeros((1, min(seq, 256), 2, head_dim), dtype_name)
+        # the probe and then broke BERT on the first live TPU window).
+        # seq arrives pre-clamped per block tier by mosaic_lowering_ok —
+        # 1024 probes the big-tile (512x1024) kernel family, smaller
+        # values the 128-tile tier — so no further clamp here.
+        q = jnp.zeros((1, seq, 2, head_dim), dtype_name)
 
         def loss(x):
             return jnp.sum(
@@ -490,4 +518,4 @@ def flash_auto_ok(lq: int, lk: int, head_dim: int, dtype) -> bool:
     mode bypasses this gate entirely."""
     return (max(lq, lk) >= FLASH_MIN_SEQ
             and flash_supported(lq, lk, dtype=dtype)
-            and mosaic_lowering_ok(head_dim, dtype, lq))
+            and mosaic_lowering_ok(head_dim, dtype, max(lq, lk)))
