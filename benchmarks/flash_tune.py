@@ -84,8 +84,10 @@ def main():
     ensure_live_backend()
 
     h, d = args.heads, args.head_dim
-    # token budget ~constant: b*l = 16k
-    cases = [(128, 128), (32, 512), (8, 2048), (2, 8192)]
+    # token budget ~constant: b*l = 16k; s1024 sits ON the default-tier
+    # boundary (_default_block_targets switches at 1024), so its row
+    # decides the boundary rather than bracketing it
+    cases = [(128, 128), (32, 512), (16, 1024), (8, 2048), (2, 8192)]
     blocks = [(128, 128)] if args.quick else [
         (128, 128), (128, 256), (256, 256), (128, 512), (256, 512),
         (512, 512), (256, 1024), (512, 1024),

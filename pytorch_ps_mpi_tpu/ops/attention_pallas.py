@@ -466,24 +466,29 @@ def mosaic_lowering_ok(head_dim: int = 64, dtype=jnp.bfloat16,
     to the dense path instead of breaking every TPU bench/model; the
     explicit 'flash' mode stays ungated and fails loudly. Lowering
     failures are shape-CLASS properties (dtype tiling, lane-dim head
-    size, per-block VMEM footprint) — and since the default block tier
-    is now a function of sequence length (`_default_block_targets`),
-    the probe must compile the SAME tier the dispatch would use: a
-    small-tile probe passing says nothing about whether the 512x1024
-    tiles lower or fit VMEM at this head_dim. The probe sequence is
-    therefore clamped per tier — small for the 128-tile tier, 1024 for
-    the big-tile tier — each cached independently."""
-    if seq >= 1024:
-        probe_seq = 1024  # compiles the 512x1024-block kernel family
-    else:
-        bq = _pick_block(seq, 128, _min_block_for(dtype))
-        probe_seq = 2 * (bq or 64)
-    return _lowering_probe(int(head_dim), jnp.dtype(dtype).name,
-                           probe_seq)
+    size, per-block VMEM footprint) — and since the default block size
+    is a function of sequence length (`_default_block_targets` targets
+    degraded by `_pick_block` divisibility), the probe must compile the
+    SAME (bq, bk) family the dispatch would run: a small-tile probe
+    passing says nothing about 512x1024 VMEM, and a big-tile probe says
+    nothing about the degraded tiles a non-power-of-two-friendly length
+    actually gets. The probe resolves the dispatch's exact blocks, then
+    compiles them at the shortest length that still exercises a
+    MULTI-block grid on both axes (2*max(bq, bk): nq, nk >= 2 — an
+    nk==1 probe is the block-dim-equals-array-dim coincidence class
+    that let a broken lse block through once before, see
+    `_lowering_probe`). Cached per (head_dim, dtype, bq, bk)."""
+    mb = _min_block_for(dtype)
+    dbq, dbk = _default_block_targets(seq, seq)
+    bq = _pick_block(seq, dbq, mb)
+    bk = _pick_block(seq, dbk, mb)
+    if bq is None or bk is None:
+        return False  # dispatch would fall back to dense anyway
+    return _lowering_probe(int(head_dim), jnp.dtype(dtype).name, bq, bk)
 
 
 @functools.lru_cache(maxsize=16)
-def _lowering_probe(head_dim: int, dtype_name: str, seq: int) -> bool:
+def _lowering_probe(head_dim: int, dtype_name: str, bq: int, bk: int) -> bool:
     if jax.default_backend() != "tpu":
         return False
     try:
@@ -492,14 +497,16 @@ def _lowering_probe(head_dim: int, dtype_name: str, seq: int) -> bool:
         # Mosaic's tile rule then passes shapes it rejects for every real
         # model (this exact coincidence let a (1, bq) lse block through
         # the probe and then broke BERT on the first live TPU window).
-        # seq arrives pre-clamped per block tier by mosaic_lowering_ok —
-        # 1024 probes the big-tile (512x1024) kernel family, smaller
-        # values the 128-tile tier — so no further clamp here.
+        # The probe length keeps BOTH grid axes multi-block (2*max of
+        # two powers of two is divisible by each, so nq, nk >= 2) — an
+        # nk==1 probe is the same coincidence class on the k axis.
+        seq = 2 * max(bq, bk)
         q = jnp.zeros((1, seq, 2, head_dim), dtype_name)
 
         def loss(x):
             return jnp.sum(
-                flash_attention(x, x, x, causal=True).astype(jnp.float32)
+                flash_attention(x, x, x, causal=True,
+                                block_q=bq, block_k=bk).astype(jnp.float32)
             )
 
         jax.jit(jax.grad(loss)).lower(q).compile()

@@ -1347,6 +1347,50 @@ class MPI_PS:
             donate_argnums=(0, 1, 2) if self.donate_buffers else (),
         )
 
+    def step_memory_analysis(
+        self, loss_fn: Callable, batch: PyTree, rng=None,
+        aux_state: PyTree = None,
+    ) -> Dict[str, Optional[int]]:
+        """HBM footprint of the fused step from XLA's own buffer
+        assignment (``compiled.memory_analysis()``), independent of
+        runtime allocator stats — some PJRT plugins (e.g. the tunneled
+        axon TPU) return no ``memory_stats()``, and this is the honest
+        substitute: ``donate_buffers`` shows up as
+        ``alias_size_in_bytes`` (outputs re-using argument buffers), so
+        ``argument + output + temp - alias`` estimates the step's peak
+        working set either way. Pass ``aux_state`` iff the step does
+        (the loss_fn signature changes with it). NOTE the first call
+        per loss_fn pays a full AOT compile — ``jitted.lower()`` does
+        not consult the jit dispatch cache — so the compiled object is
+        memoized here for repeat calls."""
+        has_aux = aux_state is not None
+        key = ("grad", _fn_cache_key(loss_fn), has_aux)
+        if key not in self._compiled:
+            self._compiled[key] = self._build_grad_step(loss_fn, has_aux)
+        rng = jax.random.key(0) if rng is None else rng
+        extra = (aux_state,) if has_aux else ()
+        ma_key = ("memory_analysis",) + key
+        if ma_key not in self._compiled:
+            self._compiled[ma_key] = self._compiled[key].lower(
+                self.params, self.opt_state, self.codec_state, batch, rng,
+                *extra
+            ).compile()
+        ma = self._compiled[ma_key].memory_analysis()
+        out = {
+            k: int(getattr(ma, k))
+            for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes")
+            if getattr(ma, k, None) is not None
+        }
+        if {"argument_size_in_bytes", "output_size_in_bytes",
+                "temp_size_in_bytes"} <= out.keys():
+            out["estimated_peak_bytes"] = (
+                out["argument_size_in_bytes"] + out["output_size_in_bytes"]
+                + out["temp_size_in_bytes"] - out.get("alias_size_in_bytes", 0)
+            )
+        return out
+
     def step_accumulate(
         self, loss_fn: Callable, microbatches: PyTree, *,
         profile: bool = False,
