@@ -197,6 +197,14 @@ def main():
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--skip-distributed", action="store_true")
+    ap.add_argument("--codec-table-only", action="store_true",
+                    help="run ONLY the 13-codec table (its own watcher "
+                         "stage, so a timeout costs nothing else)")
+    ap.add_argument("--skip-codec-table", action="store_true",
+                    help="train lines only: the 13-codec 132M-element "
+                         "table costs most of the stage's wall, and a "
+                         "flaky window should spend itself on the A/B "
+                         "train lines first")
     args = ap.parse_args()
 
     live = ensure_live_backend()
@@ -216,7 +224,10 @@ def main():
     )
     # measuring 110M-elem encodes on the host CPU takes minutes; analytic
     # table only when the accelerator is down
-    codec_table(n_params, measure=on_tpu)
+    if not args.skip_codec_table:
+        codec_table(n_params, measure=on_tpu)
+    if args.codec_table_only:
+        return
     if on_tpu:
         # flash-vs-einsum A/B at the headline shape, plus the long-seq
         # line the dense path collapses on (VERDICT r3 item 5). Each line

@@ -80,12 +80,17 @@ def run_one(donate: bool, remat: bool, batch: int, seq: int) -> None:
     }
     if peak is None:
         # the axon-tunneled PJRT plugin exposes no allocator stats —
-        # fall back to XLA's buffer assignment for the compiled step
-        # (already in the jit cache), where donation is visible as
-        # output buffers aliasing argument buffers
-        ma = opt.step_memory_analysis(loss_fn, (tokens, targets, mask))
-        rec.update(value=ma.get("estimated_peak_bytes"),
-                   source="xla_memory_analysis", **ma)
+        # fall back to XLA's buffer assignment for the compiled step,
+        # where donation is visible as output buffers aliasing argument
+        # buffers. Guarded: a backend with NEITHER stats nor
+        # memory_analysis must still emit this config's row (the
+        # bench's contract is one row per config, whatever happens)
+        try:
+            ma = opt.step_memory_analysis(loss_fn, (tokens, targets, mask))
+            rec.update(value=ma.get("estimated_peak_bytes"),
+                       source="xla_memory_analysis", **ma)
+        except Exception as e:
+            rec["fallback_error"] = f"{type(e).__name__}: {str(e)[:200]}"
     print(json.dumps(rec), flush=True)
 
 

@@ -1369,7 +1369,14 @@ class MPI_PS:
             self._compiled[key] = self._build_grad_step(loss_fn, has_aux)
         rng = jax.random.key(0) if rng is None else rng
         extra = (aux_state,) if has_aux else ()
-        ma_key = ("memory_analysis",) + key
+        # the batch's avals join the key — jit keys its dispatch cache
+        # the same way, and without them a second call with a larger
+        # batch would silently return the first batch's footprint
+        batch_avals = tuple(
+            (getattr(l, "shape", ()), str(jnp.asarray(l).dtype))
+            for l in jax.tree.leaves((batch,) + extra)
+        )
+        ma_key = ("memory_analysis",) + key + (batch_avals,)
         if ma_key not in self._compiled:
             self._compiled[ma_key] = self._compiled[key].lower(
                 self.params, self.opt_state, self.codec_state, batch, rng,

@@ -45,20 +45,30 @@ OUT = os.path.join(REPO, "BENCH_TPU_WATCH.jsonl")
 # flash floor's upper half, the first GPT-2 rows, the donate_buffers
 # HBM measurement); re-measurement of already-committed series follows.
 STAGES = [
-    # flash-vs-dense crossover sweep behind the FLASH_MIN_SEQ dispatch
-    ("flash_tune", [sys.executable, "benchmarks/flash_tune.py"], 1800),
-    # second model family: GPT-2-small causal LM at s1024/s2048,
-    # flash/einsum A/B (+ remat pair) — no committed rows yet
+    # GPT-2 rows with the seq-adaptive flash tiles (the 2026-08-01
+    # window's 128x128-tile rows showed flash LOSING to einsum at
+    # s1024/s2048; flash_tune says the 512x1024 tiles cut attention
+    # 4.9x — this A/B decides the model-level verdict)
     ("gpt_bench", [sys.executable, "benchmarks/gpt_bench.py"], 1800),
-    # peak-HBM with/without donate_buffers (+ remat), fresh subprocess
-    # per config so PJRT's cumulative peak is honest (VERDICT r4 #8)
+    # train lines ONLY (codec table split into its own stage below:
+    # table-first burned the whole 2400s budget on 2026-08-01 and the
+    # timeout discarded every train line with it)
+    ("bert_bench",
+     [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed",
+      "--skip-codec-table"],
+     2400),  # 8 train lines: flash/einsum A/B at s128/s512/s2048 +
+             # b32 s128 / b8 s512 MFU-push configs
+    # crossover sweep incl. the s1024 tier-boundary case
+    ("flash_tune", [sys.executable, "benchmarks/flash_tune.py"], 1800),
+    # peak-HBM per config; falls back to XLA memory_analysis where the
+    # tunneled plugin reports no runtime stats (VERDICT r4 #8)
     ("memory_bench", [sys.executable, "benchmarks/memory_bench.py"], 1800),
     ("bench", [sys.executable, "bench.py"], 900),
-    ("bert_bench",
-     [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed"],
-     2400),  # 8 train lines (flash/einsum A/B at s128/s512/s2048 +
-             # b32 s128 / b8 s512 MFU-push configs) + codec table
     ("codec_bench", [sys.executable, "benchmarks/codec_bench.py"], 1800),
+    # the 13-codec 132M-element table from bert_bench, as its own stage
+    ("bert_codec_table",
+     [sys.executable, "benchmarks/bert_bench.py", "--skip-distributed",
+      "--codec-table-only"], 1800),
     ("leader_bench", [sys.executable, "benchmarks/leader_bench.py"], 600),
     ("async_bench",
      [sys.executable, "benchmarks/async_bench.py", "--model", "resnet18",
@@ -122,10 +132,18 @@ def run_stage(name: str, argv: list[str], timeout: int) -> bool:
             }
         )
         return out.returncode == 0
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # salvage whatever the stage printed before the kill — a
+        # 40-minute bench that times out on its LAST config has already
+        # emitted every earlier row, and losing them re-opens the
+        # round-1/2 "no evidence" failure mode this watcher exists for
+        partial = e.stdout or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
         append_record(
             {"stage": name, "status": "timeout",
-             "wall_s": round(time.time() - t0, 1)}
+             "wall_s": round(time.time() - t0, 1),
+             "stdout": partial[-8000:]}
         )
         return False
 
