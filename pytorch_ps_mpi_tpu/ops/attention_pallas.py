@@ -456,7 +456,7 @@ def flash_supported(lq: int, lk: int, block_q: int = 128,
 
 
 def mosaic_lowering_ok(head_dim: int = 64, dtype=jnp.bfloat16,
-                       seq: int = 128) -> bool:
+                       seq: int = 128, lk: Optional[int] = None) -> bool:
     """Cached compile probe: does this backend's Mosaic lower the kernel
     family for THIS head_dim/dtype (the parameters tiling actually
     depends on)? Probes the CAUSAL forward AND the backward pass (grad
@@ -477,11 +477,16 @@ def mosaic_lowering_ok(head_dim: int = 64, dtype=jnp.bfloat16,
     MULTI-block grid on both axes (2*max(bq, bk): nq, nk >= 2 — an
     nk==1 probe is the block-dim-equals-array-dim coincidence class
     that let a broken lse block through once before, see
-    `_lowering_probe`). Cached per (head_dim, dtype, bq, bk)."""
+    `_lowering_probe`). ``seq``/``lk`` are the q/k lengths (``lk``
+    defaults to ``seq``) — bq derives from the q length and bk from
+    the k length SEPARATELY, because ring attention's rotating blocks
+    can degrade one axis's tile and not the other's. Cached per
+    (head_dim, dtype, bq, bk)."""
+    lk = seq if lk is None else lk
     mb = _min_block_for(dtype)
-    dbq, dbk = _default_block_targets(seq, seq)
+    dbq, dbk = _default_block_targets(seq, lk)
     bq = _pick_block(seq, dbq, mb)
-    bk = _pick_block(seq, dbk, mb)
+    bk = _pick_block(lk, dbk, mb)
     if bq is None or bk is None:
         return False  # dispatch would fall back to dense anyway
     return _lowering_probe(int(head_dim), jnp.dtype(dtype).name, bq, bk)
@@ -525,4 +530,4 @@ def flash_auto_ok(lq: int, lk: int, head_dim: int, dtype) -> bool:
     mode bypasses this gate entirely."""
     return (max(lq, lk) >= FLASH_MIN_SEQ
             and flash_supported(lq, lk, dtype=dtype)
-            and mosaic_lowering_ok(head_dim, dtype, max(lq, lk)))
+            and mosaic_lowering_ok(head_dim, dtype, lq, lk))

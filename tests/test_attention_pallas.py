@@ -247,3 +247,37 @@ def test_multi_tile_backward_both_masks_odd_heads(causal):
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_default_block_targets_tiers():
+    """Measured tile policy: 128x128 below seq 1024, 512x1024 above
+    (flash_tune, v5e 2026-08-01: 4.9x at s2048)."""
+    from pytorch_ps_mpi_tpu.ops.attention_pallas import (
+        _default_block_targets, _min_block_for, _pick_block)
+
+    assert _default_block_targets(128, 128) == (128, 128)
+    assert _default_block_targets(512, 512) == (128, 128)
+    assert _default_block_targets(1024, 1024) == (512, 1024)
+    assert _default_block_targets(8192, 8192) == (512, 1024)
+    # cross-length (ring attention blocks): max drives the tier
+    assert _default_block_targets(512, 2048) == (512, 1024)
+
+    # divisibility degradation: targets cap, never break tiling
+    mb = _min_block_for(jnp.float32)
+    assert _pick_block(1536, 512, mb) == 512   # 1536 = 3*512
+    assert _pick_block(1536, 1024, mb) == 512  # largest pow2 divisor
+    assert _pick_block(1280, 512, mb) == 256   # 1280 = 5*256
+    assert _pick_block(96, 128, mb) == 32
+
+
+def test_flash_auto_ok_false_off_tpu():
+    """The auto gate must consult the probe for the DISPATCHED tier and
+    return False off-TPU at every tier (dense fallback everywhere)."""
+    from pytorch_ps_mpi_tpu.ops.attention_pallas import flash_auto_ok
+
+    if jax.default_backend() == "tpu":
+        import pytest
+        pytest.skip("on-TPU the gate legitimately returns True")
+    assert not flash_auto_ok(512, 512, 64, jnp.bfloat16)
+    assert not flash_auto_ok(2048, 2048, 64, jnp.bfloat16)
+    assert not flash_auto_ok(8192, 8192, 128, jnp.float32)
