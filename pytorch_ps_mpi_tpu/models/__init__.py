@@ -10,7 +10,7 @@ bfloat16-friendly, static shapes, ring-attention option for long context.
 
 from pytorch_ps_mpi_tpu.models.mlp import MLP
 from pytorch_ps_mpi_tpu.models.resnet import ResNet, ResNet18, ResNet50
-from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM
+from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM, stack_layer_params
 from pytorch_ps_mpi_tpu.models.moe import SwitchConfig, SwitchMLM
 from pytorch_ps_mpi_tpu.models.gpt import GPTLM, causal_lm_loss, gpt_config, gpt_tiny
 

@@ -39,6 +39,12 @@ class BertConfig:
     # in the backward pass (jax.checkpoint): activation memory drops from
     # O(layers) to O(1) layers' worth for ~1/3 extra FLOPs — the standard
     # HBM-for-FLOPs trade for long sequences / deep stacks on TPU
+    scan_layers: bool = False     # lax.scan over a stacked layer body:
+    # ONE layer's HLO instead of num_layers unrolled copies, cutting
+    # compile time ~proportionally (the binding constraint on tunneled
+    # remote_compile windows) at identical math. Param layout changes
+    # (stacked [L, ...] leaves under 'layers'), so it is opt-in;
+    # stack_layer_params converts a loop-layout checkpoint.
 
     @staticmethod
     def base() -> "BertConfig":
@@ -136,6 +142,53 @@ class EncoderLayer(nn.Module):
         return x + y
 
 
+class _ScanBody(nn.Module):
+    """Carry-style wrapper ``(x, None) -> (x, None)`` so ``nn.scan``
+    can drive :class:`EncoderLayer` (whose call is plain ``x -> x``)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, _):
+        return EncoderLayer(self.cfg)(x), None
+
+
+def encoder_stack(c: BertConfig, x):
+    """The shared L-layer trunk: unrolled named layers (``layer_{i}``)
+    by default, or ONE scanned body with stacked ``[L, ...]`` params
+    under ``layers`` when ``c.scan_layers`` — same math, one layer's
+    HLO to compile instead of L copies."""
+    if c.scan_layers:
+        body = nn.remat(_ScanBody, prevent_cse=False) if c.remat else _ScanBody
+        stack = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=c.num_layers,
+        )
+        x, _ = stack(c, name="layers")(x, None)
+        return x
+    layer_cls = nn.remat(EncoderLayer) if c.remat else EncoderLayer
+    for i in range(c.num_layers):
+        x = layer_cls(c, name=f"layer_{i}")(x)
+    return x
+
+
+def stack_layer_params(params, num_layers: int):
+    """Convert loop-layout params (``layer_{i}`` subtrees) to the
+    ``scan_layers`` layout (one ``layers/EncoderLayer_0`` subtree with a
+    stacked leading axis) — the checkpoint-migration shim and the
+    numerics-equality test's bridge."""
+    stacked = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves),
+        *[params[f"layer_{i}"] for i in range(num_layers)],
+    )
+    rest = {k: v for k, v in params.items()
+            if not k.startswith("layer_")}
+    rest["layers"] = {"EncoderLayer_0": stacked}
+    return rest
+
+
 class BertMLM(nn.Module):
     """Token-in, vocab-logits-out masked-LM model (pre-norm encoder)."""
 
@@ -152,9 +205,7 @@ class BertMLM(nn.Module):
             positions
         )
         x = tok + pos[None]
-        layer_cls = nn.remat(EncoderLayer) if c.remat else EncoderLayer
-        for i in range(c.num_layers):
-            x = layer_cls(c, name=f"layer_{i}")(x)
+        x = encoder_stack(c, x)
         x = nn.LayerNorm(dtype=c.dtype)(x)
         logits = nn.Dense(c.vocab_size, dtype=c.dtype, name="mlm_head")(x)
         return logits.astype(jnp.float32)

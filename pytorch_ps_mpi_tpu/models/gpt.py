@@ -21,7 +21,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from pytorch_ps_mpi_tpu.models.bert import BertConfig, EncoderLayer
+from pytorch_ps_mpi_tpu.models.bert import BertConfig, encoder_stack
 
 
 def gpt_config(**kw) -> BertConfig:
@@ -59,9 +59,7 @@ class GPTLM(nn.Module):
         pos = nn.Embed(c.max_position, c.hidden_size, dtype=c.dtype,
                        name="pos_emb")(positions)
         x = x + pos[None]
-        layer_cls = nn.remat(EncoderLayer) if c.remat else EncoderLayer
-        for i in range(c.num_layers):
-            x = layer_cls(c, name=f"layer_{i}")(x)
+        x = encoder_stack(c, x)
         x = nn.LayerNorm(dtype=c.dtype)(x)
         if self.tie_embeddings:
             logits = x @ tok_emb.embedding.T.astype(c.dtype)
