@@ -12,6 +12,8 @@ place the *step-bench* variant of it lives.
 
 from __future__ import annotations
 
+import time
+
 import jax
 
 from pytorch_ps_mpi_tpu.utils.devtime import (
@@ -29,8 +31,15 @@ def step_timing_fields(train_step, params, state, batch, scan_k: int = 8,
     and return the shared metric fields (steps/sec in ``value``)."""
     fn = jax.jit(train_step)
     flops = 0.0
+    compile_s = None
     try:
-        cost = fn.lower(params, state, batch).compile().cost_analysis()
+        t0 = time.perf_counter()
+        compiled = fn.lower(params, state, batch).compile()
+        # the single-step program's AOT compile wall — through the
+        # tunnel's remote_compile this is what bounds a bench window,
+        # and it is the number scan_layers exists to cut
+        compile_s = round(time.perf_counter() - t0, 2)
+        cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         flops = float(cost.get("flops", 0.0))
@@ -60,6 +69,7 @@ def step_timing_fields(train_step, params, state, batch, scan_k: int = 8,
         "rtt_probe_ms": round(rtt_floor() * 1e3, 2),
         "rtt_subtracted_ms": rtt_subtracted_ms(),
         "flops_per_step": flops,
+        "compile_s": compile_s,
         "mfu": round(safe_ratio(flops, dev_s * peak), 4) if peak else 0.0,
         "device_kind": jax.devices()[0].device_kind,
     }

@@ -106,6 +106,7 @@ def main() -> None:
         return
     gpt2s = dict(dtype=jnp.bfloat16, num_layers=12, num_heads=12,
                  hidden_size=768, intermediate_size=3072, vocab_size=50257)
+    names = {}
     for batch, seq, attn, remat in [
         (8, 1024, "full", False),   # flash via the gate (seq >= FLASH_MIN_SEQ)
         (8, 1024, "einsum", False),
@@ -122,6 +123,7 @@ def main() -> None:
         # steps, so calling it inside the handler would just re-raise
         # and kill the rest of the sweep with no error row
         name = metric_name(batch, seq, attn, gpt2s, remat)
+        names[(batch, seq, attn, remat)] = name
         try:
             bench_line(batch, seq, attn, gpt2s, metric=name, remat=remat)
         except Exception as e:
@@ -129,6 +131,29 @@ def main() -> None:
             # config is one metric series whether the run lives or dies
             emit(metric=name, attention=attn, remat=remat,
                  error=f"{type(e).__name__}: {str(e)[:300]}")
+
+    # scan_layers A/B at the headline shape: same math (loop-vs-scan
+    # equality tested in tests/test_models.py), different compile
+    # economics — compile_s is the column this pair exists for, and
+    # step_ms_device answers whether lax.scan costs any runtime by
+    # inhibiting inter-layer fusion. The persistent compilation cache
+    # would turn compile_s into a cache-load time on warm reruns, so
+    # the PAIR runs with the cache disabled — the loop twin recompiles
+    # cold too (one extra compile is the price of an honest column).
+    base = names[(8, 1024, "full", False)]
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        for kw, suffix in [
+            (gpt2s, "_coldcompile"),
+            (dict(gpt2s, scan_layers=True), "_scanlayers"),
+        ]:
+            try:
+                bench_line(8, 1024, "full", kw, metric=base + suffix)
+            except Exception as e:
+                emit(metric=base + suffix, attention="full", remat=False,
+                     error=f"{type(e).__name__}: {str(e)[:300]}")
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
 
 
 if __name__ == "__main__":

@@ -46,7 +46,8 @@ CONFIGS = ["mlp_mnist", "resnet18_cifar10", "resnet50_imagenet", "bert_mlm",
            "switch_mlm", "gpt_lm"]
 
 
-def build(config: str, batch: int, seed: int = 0, remat: bool = False):
+def build(config: str, batch: int, seed: int = 0, remat: bool = False,
+          scan_layers: bool = False):
     """Returns (params, loss_fn, batch_iterator)."""
     key = jax.random.key(seed)
     if config == "switch_mlm":
@@ -68,7 +69,8 @@ def build(config: str, batch: int, seed: int = 0, remat: bool = False):
 
         gcfg = gpt_config(vocab_size=8192, hidden_size=256, num_layers=4,
                           num_heads=8, intermediate_size=1024,
-                          max_position=256, remat=remat)
+                          max_position=256, remat=remat,
+                          scan_layers=scan_layers)
         model = GPTLM(gcfg)
         data = synthetic_lm(batch, seq_len=128, vocab_size=gcfg.vocab_size)
         b0 = next(data)
@@ -90,7 +92,8 @@ def build(config: str, batch: int, seed: int = 0, remat: bool = False):
     elif config == "resnet50_imagenet":
         model = ResNet50(num_classes=1000)
     else:
-        cfg = dataclasses.replace(BertConfig.base(), remat=remat)
+        cfg = dataclasses.replace(BertConfig.base(), remat=remat,
+                                  scan_layers=scan_layers)
         model = BertMLM(cfg)
         data = synthetic_mlm(batch, seq_len=128, vocab_size=cfg.vocab_size)
         b0 = next(data)
@@ -144,6 +147,10 @@ def main(argv=None):
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize transformer layers in backward "
                          "(bert_mlm / gpt_lm configs)")
+    ap.add_argument("--scan-layers", action="store_true",
+                    help="lax.scan over a stacked layer body: one "
+                         "layer's HLO to compile instead of L copies "
+                         "(bert_mlm / gpt_lm configs)")
     ap.add_argument("--scan-chunk", type=int, default=1,
                     help=">1 fuses N steps per XLA program")
     ap.add_argument("--checkpoint-dir", default=None)
@@ -176,7 +183,11 @@ def main(argv=None):
     if args.remat and args.config not in ("bert_mlm", "gpt_lm"):
         print(f"note: --remat has no effect on {args.config} "
               "(transformer configs only)")
-    params, loss_fn, data = build(args.config, args.batch, remat=args.remat)
+    if args.scan_layers and args.config not in ("bert_mlm", "gpt_lm"):
+        print(f"note: --scan-layers has no effect on {args.config} "
+              "(transformer configs only)")
+    params, loss_fn, data = build(args.config, args.batch, remat=args.remat,
+                                  scan_layers=args.scan_layers)
     from pytorch_ps_mpi_tpu.data import prefetch
 
     data = prefetch(data)  # overlap host batch construction with the step
