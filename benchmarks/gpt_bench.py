@@ -107,7 +107,7 @@ def main() -> None:
     gpt2s = dict(dtype=jnp.bfloat16, num_layers=12, num_heads=12,
                  hidden_size=768, intermediate_size=3072, vocab_size=50257)
     names = {}
-    for batch, seq, attn, remat in [
+    sweep = [
         (8, 1024, "full", False),   # flash via the gate (seq >= FLASH_MIN_SEQ)
         (8, 1024, "einsum", False),
         (1, 2048, "full", False),   # A/B pair at a batch dense can hold
@@ -118,7 +118,8 @@ def main() -> None:
         # score residuals — the HBM lever measured inside a real step
         (4, 2048, "einsum", True),
         (4, 2048, "full", True),    # remat tax on the flash path, same shape
-    ]:
+    ]
+    for batch, seq, attn, remat in sweep:
         # name computed BEFORE the try: it re-runs the constructor/trace
         # steps, so calling it inside the handler would just re-raise
         # and kill the rest of the sweep with no error row
@@ -154,6 +155,20 @@ def main() -> None:
                      error=f"{type(e).__name__}: {str(e)[:300]}")
     finally:
         jax.config.update("jax_enable_compilation_cache", True)
+
+    # bf16-logits lever: f32_logits=False skips the 1.65 GB f32
+    # materialization of the [b, s, V] logits at b8 s1024 (the loss
+    # reduces in f32 through a fused upcast instead); A/B against the
+    # einsum twin above under the same metric-series convention
+    # (compilation cache back ON — this pair compares step time, not
+    # compile time)
+    name_bf = names[(8, 1024, "einsum", False)] + "_bf16logits"
+    try:
+        bench_line(8, 1024, "einsum", dict(gpt2s, f32_logits=False),
+                   metric=name_bf)
+    except Exception as e:
+        emit(metric=name_bf, attention="einsum", remat=False,
+             error=f"{type(e).__name__}: {str(e)[:300]}")
 
 
 if __name__ == "__main__":

@@ -21,7 +21,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from pytorch_ps_mpi_tpu.models.bert import BertConfig, encoder_stack
+from pytorch_ps_mpi_tpu.models.bert import (
+    BertConfig,
+    encoder_stack,
+    target_log_likelihood,
+)
 
 
 def gpt_config(**kw) -> BertConfig:
@@ -65,16 +69,16 @@ class GPTLM(nn.Module):
             logits = x @ tok_emb.embedding.T.astype(c.dtype)
         else:
             logits = nn.Dense(c.vocab_size, dtype=c.dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        return logits.astype(jnp.float32) if c.f32_logits else logits
 
 
 def causal_lm_loss(logits, tokens, mask=None):
     """Next-token cross-entropy: position t predicts token t+1. ``mask``
     (optional, [b, l]) marks VALID input positions; the loss at the last
-    position (no target) is always dropped."""
-    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-    ll = jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)[..., 0]
+    position (no target) is always dropped. f32 accumulation at any
+    logits dtype (``bert.target_log_likelihood``)."""
+    ll = target_log_likelihood(logits[:, :-1], tokens[:, 1:])
     if mask is None:
         return -ll.mean()
-    m = mask[:, 1:].astype(logits.dtype)
+    m = mask[:, 1:].astype(jnp.float32)
     return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)

@@ -280,3 +280,36 @@ def test_scan_layers_matches_loop_layout():
             np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5),
         g1s, g2,
     )
+
+
+def test_bf16_logits_loss_matches_f32():
+    """f32_logits=False keeps the [B,S,V] logits in compute dtype; the
+    loss must do its reductions in f32 (fused upcast, no full-size f32
+    array) and agree with the f32-logits twin to bf16 resolution."""
+    import dataclasses
+    from pytorch_ps_mpi_tpu.models.bert import target_log_likelihood
+    from pytorch_ps_mpi_tpu.models.gpt import GPTLM, causal_lm_loss
+
+    # the stable form IS log_softmax+gather for f32 inputs
+    logits = jax.random.normal(jax.random.key(0), (4, 16, 64)) * 5.0
+    tgt = jax.random.randint(jax.random.key(1), (4, 16), 0, 64)
+    ref = jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                              tgt[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(np.asarray(target_log_likelihood(logits, tgt)),
+                               np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+    # model-level: bf16 logits vs f32 logits, same params
+    cfg = BertConfig.tiny(causal=True, dtype=jnp.bfloat16)
+    cfg_bf = dataclasses.replace(cfg, f32_logits=False)
+    toks = jax.random.randint(jax.random.key(2), (2, 32), 0, cfg.vocab_size)
+    m32, mbf = GPTLM(cfg), GPTLM(cfg_bf)
+    p = m32.init(jax.random.key(3), toks)
+    out = mbf.apply(p, toks)
+    assert out.dtype == jnp.bfloat16
+    l32 = causal_lm_loss(m32.apply(p, toks), toks)
+    lbf = causal_lm_loss(out, toks)
+    np.testing.assert_allclose(float(l32), float(lbf), rtol=2e-2)
+
+    # gradients flow and are finite through the bf16 head
+    g = jax.grad(lambda pr: causal_lm_loss(mbf.apply(pr, toks), toks))(p)
+    assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
