@@ -62,9 +62,9 @@ def make_batch(key, batch, seq, vocab):
 
 
 def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10,
-                        attention: str = "full"):
+                        attention: str = "full", f32_logits: bool = True):
     cfg = BertConfig(dtype=jnp.bfloat16, max_position=max(512, seq),
-                     attention=attention)
+                     attention=attention, f32_logits=f32_logits)
     model = BertMLM(cfg)
     h = AdamHyper(lr=1e-4)
 
@@ -88,6 +88,7 @@ def single_device_bench(batch: int, seq: int, scan_k: int = 8, reps: int = 10,
     fields = step_timing_fields(train_step, params, state, b,
                                 scan_k=scan_k, reps=reps)
     suffix = "" if attention == "full" else f"_attn-{attention}"
+    suffix += "" if f32_logits else "_bf16logits"
     emit(
         metric=(f"bert_base_{n_params//10**6}M_mlm_train_step"
                 f"_b{batch}_s{seq}{suffix}"),
@@ -256,6 +257,15 @@ def main():
             except Exception as e:
                 emit(metric=f"bert_train_step_b{b}_s{s}", attention=attn,
                      error=f"{type(e).__name__}: {str(e)[:300]}")
+        # bf16-logits lever on the biggest-logits config (b32 s128:
+        # 500 MB of f32 [B,S,V] skipped) — the bert twin of the
+        # gpt_bench A/B row
+        try:
+            single_device_bench(2 * args.batch, args.seq, f32_logits=False)
+        except Exception as e:
+            emit(metric=f"bert_train_step_b{2*args.batch}_s{args.seq}"
+                        "_bf16logits",
+                 error=f"{type(e).__name__}: {str(e)[:300]}")
     else:
         single_device_bench(4, 64)
     if not args.skip_distributed:
