@@ -155,6 +155,51 @@ def sweep() -> bool:
     return ok_all
 
 
+def commit_capture() -> None:
+    """Extract the just-finished window into a committed results
+    artifact and commit it together with the watch log. A window can
+    open while nobody is attending the session (or after it ends) —
+    captured TPU rows must land in git the moment they exist, not when
+    someone next looks. Failures are logged, never raised: the capture
+    itself is already durable in the watch log."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "tools/extract_sweep.py"],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        if out.returncode != 0:
+            append_record({"stage": "autocommit",
+                           "status": f"extract rc={out.returncode}",
+                           "stderr": out.stderr[-500:]})
+            return
+        added = subprocess.run(
+            ["git", "add", "BENCH_TPU_WATCH.jsonl", "benchmarks/results"],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        if added.returncode != 0:
+            append_record({"stage": "autocommit",
+                           "status": f"add rc={added.returncode}",
+                           "stderr": added.stderr[-300:]})
+            return
+        # pathspec'd commit: the operator may have unrelated work staged
+        # while the watcher runs unattended — only the capture commits
+        done = subprocess.run(
+            ["git", "commit", "-m",
+             "Commit TPU watcher window capture\n\n"
+             "Auto-committed by tools/tpu_watch.py at sweep completion "
+             "(extract_sweep artifact + watch log).",
+             "--", "BENCH_TPU_WATCH.jsonl", "benchmarks/results"],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        append_record({"stage": "autocommit",
+                       "status": "ok" if done.returncode == 0
+                       else f"commit rc={done.returncode}",
+                       "detail": (done.stdout or done.stderr)[-300:]})
+    except Exception as e:  # never kill the watch loop over bookkeeping
+        append_record({"stage": "autocommit",
+                       "status": f"{type(e).__name__}: {str(e)[:200]}"})
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=240,
@@ -170,6 +215,7 @@ def main() -> None:
         append_record({"stage": "probe", "status": "live" if live else "down"})
         if live:
             ok = sweep()
+            commit_capture()
             if args.once:
                 sys.exit(0 if ok else 1)
             time.sleep(args.after_success)
