@@ -21,7 +21,7 @@ Everything on-device runs under ``jax.jit``/``shard_map`` over a
 ``ppermute``) instead of MPI over Ethernet.
 """
 
-from pytorch_ps_mpi_tpu.ps import MPI_PS, Adam, SGD
+from pytorch_ps_mpi_tpu.ps import MPI_PS, Adafactor, Adam, SGD
 
-__all__ = ["MPI_PS", "Adam", "SGD"]
+__all__ = ["MPI_PS", "Adafactor", "Adam", "SGD"]
 __version__ = "0.1.0"

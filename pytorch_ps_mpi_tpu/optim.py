@@ -22,10 +22,11 @@ shape of torch's host-side ``lr_scheduler.step()`` mutation.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, NamedTuple, Tuple, Union
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 PyTree = Any
 LR = Union[float, Callable[[jax.Array], jax.Array]]
@@ -189,7 +190,128 @@ def adam_update(
     return pick(0), AdamState(step, pick(1), pick(2), pick(3))
 
 
+# ---------------------------------------------------------------------------
+# Adafactor (Shazeer & Stern 2018) — the TPU-native memory-efficient
+# optimizer: second moments of [n, m] leaves are stored FACTORED as a
+# row vector + column vector (sublinear optimizer state; the rank-1
+# reconstruction is exact at the optimum of the I-divergence, paper
+# §3). Beyond the reference's SGD/Adam family — at BERT/GPT scale the
+# optimizer state drops from 2x params (Adam) to ~1/128th of one copy,
+# which is HBM that goes back to batch size. No-momentum form (the
+# paper's memory-efficient default; Adam covers the momentum niche).
+# Semantics mirror optax.adafactor leaf-for-leaf (factoring over the
+# two LARGEST dims, clip-by-block-rms, optional parameter-scale
+# multiply) and are pinned to it in tests/test_optim.py.
+
+_FACTOR_MIN = 128  # fixed at init (registry inits see params only)
+
+
+def _factored_dims(shape) -> Optional[Tuple[int, int]]:
+    """The two largest axes (d1, d0), or None when the second-largest
+    is below the factoring threshold — optax's rule exactly."""
+    if len(shape) < 2:
+        return None
+    order = sorted(range(len(shape)), key=lambda i: shape[i])
+    if shape[order[-2]] < _FACTOR_MIN:
+        return None
+    return order[-2], order[-1]
+
+
+class AdafactorHyper(NamedTuple):
+    lr: LR = None                 # None -> relative step min(1e-2, t^-0.5)
+    decay_rate: float = 0.8       # beta2_t = 1 - t^-decay_rate
+    eps1: float = 1e-30           # squared-gradient regularizer
+    eps2: float = 1e-3            # parameter-scale floor (paper alg. 4)
+    clip_threshold: float = 1.0   # update block-RMS clip
+    weight_decay: float = 0.0     # added to the update un-lr-scaled
+    # (optax add_decayed_weights semantics)
+    multiply_by_parameter_scale: bool = True
+
+
+class AdafactorState(NamedTuple):
+    step: jax.Array
+    v_row: PyTree   # factored leaves: [shape minus largest dim];
+    v_col: PyTree   # [shape minus second-largest]; zeros((1,)) sentinel
+    v_full: PyTree  # unfactored leaves: full shape; sentinel otherwise
+
+
+def init_adafactor_state(params: PyTree) -> AdafactorState:
+    def vr(p):
+        d = _factored_dims(p.shape)
+        if d is None:
+            return jnp.zeros((1,), p.dtype)
+        return jnp.zeros(tuple(np.delete(p.shape, d[1])), p.dtype)
+
+    def vc(p):
+        d = _factored_dims(p.shape)
+        if d is None:
+            return jnp.zeros((1,), p.dtype)
+        return jnp.zeros(tuple(np.delete(p.shape, d[0])), p.dtype)
+
+    def vf(p):
+        return (jnp.zeros_like(p) if _factored_dims(p.shape) is None
+                else jnp.zeros((1,), p.dtype))
+
+    return AdafactorState(
+        step=jnp.zeros((), jnp.int32),
+        v_row=jax.tree.map(vr, params),
+        v_col=jax.tree.map(vc, params),
+        v_full=jax.tree.map(vf, params),
+    )
+
+
+def adafactor_update(
+    params: PyTree, grads: PyTree, state: AdafactorState, h: AdafactorHyper
+) -> Tuple[PyTree, AdafactorState]:
+    """One fused Adafactor step on the aggregated gradient."""
+    step = state.step + 1
+    t = step.astype(jnp.float32)
+    beta2t = 1.0 - t ** (-h.decay_rate)
+    if h.lr is None:
+        lr = jnp.minimum(1e-2, 1.0 / jnp.sqrt(t))
+    else:
+        lr = _lr_at(h.lr, state.step)
+
+    def leaf(p, g, vr, vc, vf):
+        dims = _factored_dims(p.shape)
+        g2 = g * g + h.eps1
+        if dims is not None:
+            d1, d0 = dims
+            vr_new = beta2t * vr + (1.0 - beta2t) * jnp.mean(g2, axis=d0)
+            vc_new = beta2t * vc + (1.0 - beta2t) * jnp.mean(g2, axis=d1)
+            # the per-row mean normalizer lives in the row factor
+            reduced_d1 = d1 - 1 if d1 > d0 else d1
+            row_mean = jnp.mean(vr_new, axis=reduced_d1, keepdims=True)
+            u = (g * jnp.expand_dims((vr_new / row_mean) ** -0.5, d0)
+                 * jnp.expand_dims(vc_new ** -0.5, d1))
+            vf_new = vf
+        else:
+            vf_new = beta2t * vf + (1.0 - beta2t) * g2
+            u = g * vf_new ** -0.5
+            vr_new, vc_new = vr, vc
+        rms_u = jnp.sqrt(jnp.mean(u * u))
+        u = u / jnp.maximum(1.0, rms_u / h.clip_threshold)
+        scale = lr
+        if h.multiply_by_parameter_scale:
+            scale = scale * jnp.maximum(
+                h.eps2, jnp.sqrt(jnp.mean(p.astype(jnp.float32) ** 2))
+            )
+        p_new = p - scale * u
+        if h.weight_decay:
+            p_new = p_new - h.weight_decay * p
+        return p_new, vr_new, vc_new, vf_new
+
+    out = jax.tree.map(
+        leaf, params, grads, state.v_row, state.v_col, state.v_full
+    )
+    pick = lambda i: jax.tree.map(
+        lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple)
+    )
+    return pick(0), AdafactorState(step, pick(1), pick(2), pick(3))
+
+
 OPTIMIZERS: Dict[str, Any] = {
     "sgd": (SGDHyper, init_sgd_state, sgd_update),
     "adam": (AdamHyper, init_adam_state, adam_update),
+    "adafactor": (AdafactorHyper, init_adafactor_state, adafactor_update),
 }

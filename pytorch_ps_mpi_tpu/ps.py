@@ -667,6 +667,25 @@ class MPI_PS:
                 "over the aggregation axes; use mode='allgather' for "
                 "expert-parallel layouts"
             )
+        if optim == "adafactor" and (mode == "leader" or self._model_parallel):
+            # Both shardings change WHICH elements share a row/col mean:
+            # leader mode flattens leaves to 1-D per-worker shards, and
+            # param_specs leaves factor over shard-local axes while the
+            # replicated state spec broadcasts against the global
+            # factored state (shape corruption, verified in review).
+            # Factored moments need a dedicated sharded design (psum'd
+            # row/col means) — reject loudly until it exists.
+            raise NotImplementedError(
+                "optim='adafactor' requires fully-replicated params in "
+                "allgather mode: its factored second moments (row/col "
+                "means) depend on each leaf's GLOBAL 2-D shape, which "
+                "leader-mode 1-D shards and param_specs shard-local "
+                "leaves both change — the result would be a silently "
+                "different (or shape-corrupted) update. Use "
+                "optim='adam'/'sgd' for sharded layouts; Adafactor's "
+                "state is already sublinear, so ZeRO-1's state-sharding "
+                "win is marginal for it anyway"
+            )
         if self._model_parallel and mode == "leader":
             for p, sp in zip(jax.tree.leaves(params), self._spec_leaves):
                 entries = tuple(sp)
@@ -1781,4 +1800,20 @@ class Adam(MPI_PS):
 
     def __init__(self, params, **kwargs):
         kwargs.setdefault("optim", "adam")
+        super().__init__(params, **kwargs)
+
+
+class Adafactor(MPI_PS):
+    """PS-fused Adafactor (Shazeer & Stern 2018) — beyond the
+    reference's SGD/Adam family: factored second moments make the
+    optimizer state sublinear in params (``optim.py::adafactor_update``,
+    optax-pinned), freeing the ~2x-params Adam state for batch size.
+    Composes with codecs and accumulation on the replicated-param DP
+    wires; leader/ZeRO-1 and model-parallel ``param_specs`` are
+    rejected loudly — factored moments are shape-dependent and need a
+    dedicated sharded design (see the constructor guard)."""
+
+    def __init__(self, params, **kwargs):
+        kwargs.setdefault("optim", "adafactor")
+        kwargs.setdefault("lr", None)  # paper's relative step size
         super().__init__(params, **kwargs)

@@ -138,3 +138,96 @@ def test_adamw_decoupled_matches_optax_adamw():
         not np.allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
         for a, b in zip(jax.tree.leaves(ours), jax.tree.leaves(coupled))
     )
+
+
+def test_adafactor_matches_optax():
+    """Leaf-for-leaf parity with optax.adafactor at matched hypers:
+    factored [n>=128, m>=128] leaves, an unfactored small leaf, and a
+    1-D leaf, over several steps (the decay schedule is step-dependent,
+    so multi-step catches a step-counter offset)."""
+    import optax
+    from pytorch_ps_mpi_tpu.optim import (
+        AdafactorHyper, adafactor_update, init_adafactor_state)
+
+    key = jax.random.key(0)
+    params = {
+        "big": jax.random.normal(jax.random.fold_in(key, 0), (256, 160)),
+        "small": jax.random.normal(jax.random.fold_in(key, 1), (16, 8)),
+        "vec": jax.random.normal(jax.random.fold_in(key, 2), (64,)),
+    }
+    lr = 0.01
+    h = AdafactorHyper(lr=lr, multiply_by_parameter_scale=True)
+    state = init_adafactor_state(params)
+
+    ox = optax.adafactor(learning_rate=lr, momentum=None,
+                         weight_decay_rate=None)
+    ox_state = ox.init(params)
+    p_mine, p_ox = params, params
+    for i in range(4):
+        grads = jax.tree.map(
+            lambda p, j=i: jax.random.normal(
+                jax.random.fold_in(key, 100 + j), p.shape) * 0.1,
+            p_mine)
+        p_mine, state = adafactor_update(p_mine, grads, state, h)
+        upd, ox_state = ox.update(grads, ox_state, p_ox)
+        p_ox = optax.apply_updates(p_ox, upd)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5),
+            p_mine, p_ox)
+
+
+def test_adafactor_state_is_sublinear_and_trains(mesh8):
+    """The memory claim and the end-to-end claim: factored state is a
+    tiny fraction of a params copy, and MPI_PS(optim='adafactor')
+    drives loss down through the fused step."""
+    from pytorch_ps_mpi_tpu import MPI_PS
+    from pytorch_ps_mpi_tpu.optim import init_adafactor_state
+
+    big = {"w": jnp.zeros((512, 384))}
+    st = init_adafactor_state(big)
+    state_elems = sum(x.size for x in jax.tree.leaves(
+        (st.v_row, st.v_col, st.v_full)))
+    assert state_elems < big["w"].size // 100  # 896 vs 196608
+
+    # nonzero init: the parameter-scale multiply floors updates at
+    # eps2 for all-zero params (correct Adafactor behavior — relative
+    # step sizes need a parameter scale to be relative TO)
+    ki = jax.random.key(7)
+    params = {"w": jax.random.normal(ki, (256, 128)) * 0.1,
+              "b": jnp.zeros((128,))}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    opt = MPI_PS(params, mesh=mesh8, optim="adafactor", lr=0.05)
+    k1, k2 = jax.random.split(jax.random.key(3))
+    batch = (jax.random.normal(k1, (16, 256)),
+             jax.random.normal(k2, (16, 128)) * 2.0)
+    losses = [float(opt.step(loss_fn=loss_fn, batch=batch)[0])
+              for _ in range(10)]
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_adafactor_sharded_layouts_rejected(mesh8):
+    """Factored moments depend on each leaf's GLOBAL 2-D shape: ZeRO-1
+    flattens leaves to 1-D shards, and param_specs leaves factor over
+    shard-local axes (review-verified shape corruption) — BOTH must be
+    rejected loudly, never silently re-semanticized."""
+    import pytest
+    from jax.sharding import PartitionSpec as P
+    from pytorch_ps_mpi_tpu import MPI_PS
+
+    params = {"w": jnp.zeros((256, 128))}
+    with pytest.raises(NotImplementedError, match="[Aa]dafactor"):
+        MPI_PS(params, mesh=mesh8, optim="adafactor", mode="leader")
+
+    import numpy as npo
+    from jax.sharding import Mesh
+    devs = npo.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh2d = Mesh(devs, ("data", "model"))
+    sharded = {"w": jnp.zeros((4, 256, 128))}
+    with pytest.raises(NotImplementedError, match="[Aa]dafactor"):
+        MPI_PS(sharded, mesh=mesh2d, optim="adafactor",
+               param_specs={"w": P("model")})
