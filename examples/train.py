@@ -116,7 +116,8 @@ def main(argv=None):
     ap.add_argument("--config", choices=CONFIGS, default="mlp_mnist")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=64)
-    ap.add_argument("--optim", choices=["sgd", "adam"], default="sgd")
+    ap.add_argument("--optim", choices=["sgd", "adam", "adafactor"],
+                    default="sgd")
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--lr-schedule", choices=["constant", "warmup_cosine",
                                               "step_decay"], default=None,
@@ -209,6 +210,10 @@ def main(argv=None):
         hyper["weight_decay"] = args.weight_decay
     if args.adamw:
         hyper["decoupled_weight_decay"] = True
+    if args.optim == "adafactor" and args.lr_schedule is None \
+            and "--lr" not in (argv if argv is not None else sys.argv):
+        # no explicit lr and no schedule: the paper's relative step size
+        hyper["lr"] = None
     opt = MPI_PS(
         params, optim=args.optim, code=code, mode=args.mode,
         average=True, instrument=args.instrument,
