@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec
 
 PyTree = Any
 LR = Union[float, Callable[[jax.Array], jax.Array]]
@@ -261,9 +262,20 @@ def init_adafactor_state(params: PyTree) -> AdafactorState:
 
 
 def adafactor_update(
-    params: PyTree, grads: PyTree, state: AdafactorState, h: AdafactorHyper
+    params: PyTree, grads: PyTree, state: AdafactorState, h: AdafactorHyper,
+    scalar_mean: Optional[Callable] = None,
 ) -> Tuple[PyTree, AdafactorState]:
-    """One fused Adafactor step on the aggregated gradient."""
+    """One fused Adafactor step on the aggregated gradient.
+
+    ``scalar_mean`` turns the two per-leaf SCALAR reductions (the
+    update-clip RMS and the parameter-scale RMS) into global means
+    under sharded execution: pass ``lambda s: lax.pmean(s, model_axes)``
+    inside shard_map and — because uniform shards have equal sizes —
+    the pmean of per-shard means IS the global mean, while replicated
+    leaves pmean to themselves. The factored row/col means never need
+    it: :func:`adafactor_check_sharding` guarantees the factored dims
+    are unsharded, so those reductions are shard-local by construction.
+    """
     step = state.step + 1
     t = step.astype(jnp.float32)
     beta2t = 1.0 - t ** (-h.decay_rate)
@@ -271,6 +283,8 @@ def adafactor_update(
         lr = jnp.minimum(1e-2, 1.0 / jnp.sqrt(t))
     else:
         lr = _lr_at(h.lr, state.step)
+
+    mean_sq = scalar_mean if scalar_mean is not None else (lambda x: x)
 
     def leaf(p, g, vr, vc, vf):
         dims = _factored_dims(p.shape)
@@ -289,12 +303,13 @@ def adafactor_update(
             vf_new = beta2t * vf + (1.0 - beta2t) * g2
             u = g * vf_new ** -0.5
             vr_new, vc_new = vr, vc
-        rms_u = jnp.sqrt(jnp.mean(u * u))
+        rms_u = jnp.sqrt(mean_sq(jnp.mean(u * u)))
         u = u / jnp.maximum(1.0, rms_u / h.clip_threshold)
         scale = lr
         if h.multiply_by_parameter_scale:
             scale = scale * jnp.maximum(
-                h.eps2, jnp.sqrt(jnp.mean(p.astype(jnp.float32) ** 2))
+                h.eps2,
+                jnp.sqrt(mean_sq(jnp.mean(p.astype(jnp.float32) ** 2))),
             )
         p_new = p - scale * u
         if h.weight_decay:
@@ -308,6 +323,70 @@ def adafactor_update(
         lambda o: o[i], out, is_leaf=lambda x: isinstance(x, tuple)
     )
     return pick(0), AdafactorState(step, pick(1), pick(2), pick(3))
+
+
+def adafactor_check_sharding(params: PyTree, param_specs: PyTree) -> None:
+    """Reject leaves whose GLOBAL factored dims are sharded: the
+    row/col means would then span devices, and a shard-local mean
+    silently computes a different (and shape-corrupting, once the
+    replicated-state broadcast joins in) update. Sharding any OTHER
+    axis is exactly decomposable — the factored means stay shard-local
+    and the scalar reductions go through ``scalar_mean``."""
+    spec_leaves = jax.tree.structure(params).flatten_up_to(param_specs)
+    for p, sp in zip(jax.tree.leaves(params), spec_leaves):
+        dims = _factored_dims(p.shape)
+        if dims is None:
+            continue  # v_full mirrors the leaf: elementwise, any sharding
+        entries = tuple(sp) if sp is not None else ()
+        sharded = {i for i, e in enumerate(entries) if e is not None}
+        if sharded & set(dims):
+            raise NotImplementedError(
+                "optim='adafactor': leaf with global shape "
+                f"{p.shape} factors over dims {dims}, but spec {sp} "
+                "shards one of them — the row/col second-moment means "
+                "would span devices. Shard a non-factored axis (e.g. a "
+                "leading stack axis) or use optim='adam'/'sgd'"
+            )
+
+
+def _delete_spec_dim(sp, ndim: int, d: int):
+    entries = (tuple(sp) if sp is not None else ()) + (None,) * ndim
+    entries = entries[:ndim]
+    kept = entries[:d] + entries[d + 1:]
+    return PartitionSpec(*kept)
+
+
+def adafactor_state_specs(params: PyTree, param_specs: PyTree):
+    """Per-leaf shard_map specs for :class:`AdafactorState` under
+    model-parallel ``param_specs``: v_row/v_col inherit the leaf's spec
+    minus the deleted (factored, guaranteed-unsharded) dim; v_full
+    mirrors the leaf for unfactored leaves; sentinels replicate."""
+    P_ = PartitionSpec
+    treedef = jax.tree.structure(params)
+    spec_leaves = treedef.flatten_up_to(param_specs)
+    p_leaves = jax.tree.leaves(params)
+
+    def per_leaf(which):
+        out = []
+        for p, sp in zip(p_leaves, spec_leaves):
+            dims = _factored_dims(p.shape)
+            if which == "v_full":
+                out.append(P_() if dims is not None
+                           else (sp if sp is not None else P_()))
+            elif dims is None:
+                out.append(P_())
+            else:
+                d1, d0 = dims
+                d = d0 if which == "v_row" else d1
+                out.append(_delete_spec_dim(sp, len(p.shape), d))
+        return jax.tree.unflatten(treedef, out)
+
+    return AdafactorState(
+        step=P_(),
+        v_row=per_leaf("v_row"),
+        v_col=per_leaf("v_col"),
+        v_full=per_leaf("v_full"),
+    )
 
 
 OPTIMIZERS: Dict[str, Any] = {

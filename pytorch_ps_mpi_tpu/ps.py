@@ -43,6 +43,7 @@ Async (AsySG-InCon) training lives in ``parallel/async_ps.py``.
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
@@ -55,7 +56,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from pytorch_ps_mpi_tpu import comms
 from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
 from pytorch_ps_mpi_tpu.mesh import DATA_AXIS, make_mesh
-from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+from pytorch_ps_mpi_tpu.optim import (
+    OPTIMIZERS,
+    AdafactorState,
+    adafactor_check_sharding,
+    adafactor_state_specs,
+    adafactor_update,
+)
 
 PyTree = Any
 
@@ -667,24 +674,38 @@ class MPI_PS:
                 "over the aggregation axes; use mode='allgather' for "
                 "expert-parallel layouts"
             )
-        if optim == "adafactor" and (mode == "leader" or self._model_parallel):
-            # Both shardings change WHICH elements share a row/col mean:
-            # leader mode flattens leaves to 1-D per-worker shards, and
-            # param_specs leaves factor over shard-local axes while the
-            # replicated state spec broadcasts against the global
-            # factored state (shape corruption, verified in review).
-            # Factored moments need a dedicated sharded design (psum'd
-            # row/col means) — reject loudly until it exists.
+        if optim == "adafactor" and mode == "leader":
+            # leader mode flattens leaves to 1-D per-worker shards —
+            # Adafactor's factored moments depend on each leaf's GLOBAL
+            # 2-D shape, so the sharded step would silently compute a
+            # DIFFERENT update than the allgather form.
             raise NotImplementedError(
-                "optim='adafactor' requires fully-replicated params in "
-                "allgather mode: its factored second moments (row/col "
-                "means) depend on each leaf's GLOBAL 2-D shape, which "
-                "leader-mode 1-D shards and param_specs shard-local "
-                "leaves both change — the result would be a silently "
-                "different (or shape-corrupted) update. Use "
-                "optim='adam'/'sgd' for sharded layouts; Adafactor's "
-                "state is already sublinear, so ZeRO-1's state-sharding "
-                "win is marginal for it anyway"
+                "optim='adafactor' does not support mode='leader': "
+                "ZeRO-1's 1-D shards destroy the leaf shapes the "
+                "factored second moments are defined over (and its "
+                "state-sharding win is marginal for a sublinear-state "
+                "optimizer). Use mode='allgather'"
+            )
+        if optim == "adafactor" and self._model_parallel:
+            # model-parallel Adafactor is exactly shard-local
+            # decomposable iff no FACTORED dim is sharded (then the
+            # row/col means never span devices); the two per-leaf
+            # scalar reductions (clip RMS, parameter scale) become
+            # global via pmean over the model axes — identity on
+            # replicated leaves, exact global mean on uniform shards.
+            if not self._uniform_agg:
+                raise NotImplementedError(
+                    "optim='adafactor' with expert-parallel layouts "
+                    "(leaves sharded over a data axis) is unsupported: "
+                    "the per-leaf scalar reductions would need per-leaf "
+                    "axis sets. Use optim='adam'/'sgd' for EP"
+                )
+            adafactor_check_sharding(params, self.param_specs)
+            model_axes = tuple(a for a in self.mesh.axis_names
+                               if a not in self._agg_axes)
+            self._update_fn = functools.partial(
+                adafactor_update,
+                scalar_mean=lambda s: lax.pmean(s, model_axes),
             )
         if self._model_parallel and mode == "leader":
             for p, sp in zip(jax.tree.leaves(params), self._spec_leaves):
@@ -1032,6 +1053,12 @@ class MPI_PS:
             )
         if not self._model_parallel:
             return P()
+        if isinstance(self.opt_state, AdafactorState):
+            # factored moments are NOT param-shaped: v_row/v_col carry
+            # the leaf's spec minus the deleted (unsharded) factored
+            # dim — a replicated spec here broadcasts global state
+            # against shard-local updates (shape corruption)
+            return adafactor_state_specs(self.params, self.param_specs)
         ptd = jax.tree.structure(self.params)
         pshapes = [x.shape for x in jax.tree.leaves(self.params)]
 
@@ -1808,10 +1835,14 @@ class Adafactor(MPI_PS):
     reference's SGD/Adam family: factored second moments make the
     optimizer state sublinear in params (``optim.py::adafactor_update``,
     optax-pinned), freeing the ~2x-params Adam state for batch size.
-    Composes with codecs and accumulation on the replicated-param DP
-    wires; leader/ZeRO-1 and model-parallel ``param_specs`` are
-    rejected loudly — factored moments are shape-dependent and need a
-    dedicated sharded design (see the constructor guard)."""
+    Composes with codecs, accumulation, and model-parallel
+    ``param_specs`` whose sharded axes avoid the factored (two
+    largest) dims — the leading-stack-axis TP/PP convention — where
+    the step is exactly shard-local-decomposable (row/col means stay
+    local; the two per-leaf scalar reductions pmean over the model
+    axes; oracle-equality proven in ``tests/test_ps_model_parallel``).
+    Leader/ZeRO-1, factored-dim sharding, and EP layouts are rejected
+    loudly (see the constructor guards)."""
 
     def __init__(self, params, **kwargs):
         kwargs.setdefault("optim", "adafactor")

@@ -210,13 +210,14 @@ def test_adafactor_state_is_sublinear_and_trains(mesh8):
     assert losses[-1] < 0.5 * losses[0]
 
 
-def test_adafactor_sharded_layouts_rejected(mesh8):
+def test_adafactor_sharding_guards(mesh8):
     """Factored moments depend on each leaf's GLOBAL 2-D shape: ZeRO-1
-    flattens leaves to 1-D shards, and param_specs leaves factor over
-    shard-local axes (review-verified shape corruption) — BOTH must be
-    rejected loudly, never silently re-semanticized."""
+    (1-D flat shards) and specs that shard a FACTORED dim are rejected
+    loudly; a leading stack-axis shard (factored dims unsharded) is the
+    supported model-parallel form (oracle-equality proven in
+    test_ps_model_parallel.py)."""
     import pytest
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import Mesh, PartitionSpec as P
     from pytorch_ps_mpi_tpu import MPI_PS
 
     params = {"w": jnp.zeros((256, 128))}
@@ -224,10 +225,15 @@ def test_adafactor_sharded_layouts_rejected(mesh8):
         MPI_PS(params, mesh=mesh8, optim="adafactor", mode="leader")
 
     import numpy as npo
-    from jax.sharding import Mesh
     devs = npo.asarray(jax.devices()[:8]).reshape(2, 4)
     mesh2d = Mesh(devs, ("data", "model"))
-    sharded = {"w": jnp.zeros((4, 256, 128))}
-    with pytest.raises(NotImplementedError, match="[Aa]dafactor"):
-        MPI_PS(sharded, mesh=mesh2d, optim="adafactor",
+    with pytest.raises(NotImplementedError, match="factor"):
+        # 2-D leaf sharded on dim 0 = a FACTORED dim spans devices
+        MPI_PS({"w": jnp.zeros((256, 160))}, mesh=mesh2d,
+               axis_name="data", optim="adafactor",
                param_specs={"w": P("model")})
+
+    # leading stack-axis shard: accepted (construction succeeds)
+    MPI_PS({"w": jnp.zeros((4, 256, 160))}, mesh=mesh2d,
+           axis_name="data", optim="adafactor",
+           param_specs={"w": P("model")})

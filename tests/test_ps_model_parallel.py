@@ -779,3 +779,67 @@ def test_mpips_dp_pp_matches_sequential_dense():
                                        rtol=1e-4, atol=1e-6)
     assert float(jnp.isfinite(loss))
     assert "pipe" in str(opt.params["w1"].sharding.spec)
+
+
+def test_adafactor_tp_matches_global_oracle(mesh_dp_tp):
+    """Model-parallel Adafactor (factored dims unsharded; scalar
+    reductions pmean'd over the model axes) must equal the plain
+    single-device adafactor_update on the GLOBAL stacked leaves, step
+    for step — the exact-decomposability claim, proven."""
+    from pytorch_ps_mpi_tpu.optim import (
+        AdafactorHyper,
+        adafactor_update,
+        init_adafactor_state,
+    )
+
+    N, M = 256, 160  # both >= the factoring threshold
+    kp = jax.random.key(0)
+    params = {
+        "w": jax.random.normal(kp, (TP, N, M)) * 0.1,       # P('model')
+        "b": jax.random.normal(jax.random.fold_in(kp, 1), (TP, M)) * 0.1,
+    }
+    specs = {"w": P("model"), "b": P("model")}
+    x = jax.random.normal(jax.random.key(1), (GB, N))
+    y = jax.random.normal(jax.random.key(2), (GB, TP, M))
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        i = jax.lax.axis_index("model")
+        feat = xb @ p["w"][0] + p["b"][0]          # local column block
+        yi = jax.lax.dynamic_index_in_dim(yb, i, axis=1, keepdims=False)
+        # local loss, STATIC global normalizer (sum-over-data semantics)
+        return ((feat - yi) ** 2).sum() / (GB * TP * M)
+
+    lr = 0.02
+    opt = MPI_PS(params, mesh=mesh_dp_tp, axis_name="data",
+                 param_specs=specs, optim="adafactor", lr=lr)
+    for _ in range(3):
+        opt.step(loss_fn=loss_fn, batch=(x, y))
+
+    # oracle: full-batch gradient of the same global computation, plain
+    # (unsharded) adafactor_update on the global stacked leaves
+    def global_loss(p):
+        feats = jnp.einsum("bn,tnm->btm", x, p["w"]) + p["b"][None]
+        return ((feats - y) ** 2).sum() / (GB * TP * M)
+
+    p_ref = params
+    st = init_adafactor_state(p_ref)
+    h = AdafactorHyper(lr=lr)
+    for _ in range(3):
+        g = jax.grad(global_loss)(p_ref)
+        p_ref, st = adafactor_update(p_ref, g, st, h)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-7),
+        opt.params, p_ref,
+    )
+
+
+def test_adafactor_sharded_factored_dim_rejected(mesh_dp_tp):
+    """A leaf whose FACTORED (largest) dims are sharded must be
+    rejected: those row/col means would span devices."""
+    params = {"w": jnp.zeros((256, 160))}
+    with pytest.raises(NotImplementedError, match="factor"):
+        MPI_PS(params, mesh=mesh_dp_tp, axis_name="data",
+               param_specs={"w": P("model")}, optim="adafactor")
