@@ -313,3 +313,25 @@ def test_bf16_logits_loss_matches_f32():
     # gradients flow and are finite through the bf16 head
     g = jax.grad(lambda pr: causal_lm_loss(mbf.apply(pr, toks), toks))(p)
     assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
+
+
+def test_target_log_likelihood_gradient_matches_log_softmax():
+    """The stop-gradient-max logsumexp must be GRADIENT-equivalent to
+    plain log_softmax+gather for f32 inputs (the max term's gradient
+    contribution cancels analytically; stop_gradient just prevents
+    spurious max-index routing)."""
+    from pytorch_ps_mpi_tpu.models.bert import target_log_likelihood
+
+    logits = jax.random.normal(jax.random.key(0), (3, 8, 32)) * 4.0
+    tgt = jax.random.randint(jax.random.key(1), (3, 8), 0, 32)
+
+    def ours(lg):
+        return jnp.sum(target_log_likelihood(lg, tgt))
+
+    def ref(lg):
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return jnp.sum(jnp.take_along_axis(lp, tgt[..., None], -1))
+
+    g1, g2 = jax.grad(ours)(logits), jax.grad(ref)(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=1e-6, rtol=1e-5)
