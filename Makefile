@@ -5,6 +5,12 @@
 test:
 	python -m pytest tests/ -q
 
+# Recorder-overhead gate: short CPU trainer, recorder off vs on in
+# interleaved blocks; writes smoke.jsonl + report.txt and FAILS if the
+# enabled recorder costs >5% of the disabled step time
+telemetry-smoke:
+	python tools/telemetry_smoke.py
+
 bench:
 	python bench.py
 
@@ -27,4 +33,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke

@@ -362,8 +362,44 @@ def run_train_bench(dtype=jnp.float32, cpu_anchor=True):
     return step_s, scan_step_s, flops, cpu_s
 
 
+def _telemetry_dir():
+    """``BENCH_TELEMETRY_DIR=dir python bench.py`` arms the run-wide
+    FlightRecorder (bench takes no CLI args by design — the env var is
+    the flag): each bench phase records a span, and the run drops
+    ``bench.jsonl`` + a per-phase ``report.txt`` in the directory."""
+    import os
+
+    tdir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if not tdir:
+        return None
+    os.makedirs(tdir, exist_ok=True)
+    from pytorch_ps_mpi_tpu import telemetry
+
+    telemetry.configure(worker="bench")
+    return tdir
+
+
+def _telemetry_flush(tdir):
+    if not tdir:
+        return
+    import os
+
+    from pytorch_ps_mpi_tpu import telemetry
+    from tools.telemetry_report import format_table, summarize
+
+    rec = telemetry.get_recorder()
+    path = rec.dump_jsonl(os.path.join(tdir, "bench.jsonl"))
+    report = format_table(summarize([path]))
+    with open(os.path.join(tdir, "report.txt"), "w") as f:
+        f.write(report + "\n")
+    print(f"telemetry: {path} + report.txt", flush=True)
+
+
 def main():
     global REPS, SCAN_K
+    tdir = _telemetry_dir()
+    from pytorch_ps_mpi_tpu.telemetry import span
+
     live = ensure_live_backend()
     replay_lines = []
     if jax.default_backend() == "cpu":
@@ -383,14 +419,17 @@ def main():
         )
         for rec in replay_lines:
             print(json.dumps(rec), flush=True)
-    smoke = pallas_mosaic_smoke()
+    with span("bench.pallas_smoke"):
+        smoke = pallas_mosaic_smoke()
 
     structs = param_structs()
     shapes = [s.shape for s in jax.tree.leaves(structs)]
     n_params = sum(int(np.prod(s)) for s in shapes)
 
-    ref_s = run_reference_baseline(shapes)
-    ours_wall_s, ours_dev_s = run_ours(structs)
+    with span("bench.reference_baseline"):
+        ref_s = run_reference_baseline(shapes)
+    with span("bench.aggregation_update"):
+        ours_wall_s, ours_dev_s = run_ours(structs)
     from pytorch_ps_mpi_tpu.utils.devtime import scan_pass_runs
 
     if scan_pass_runs():
@@ -420,7 +459,8 @@ def main():
         + method,
     )
 
-    step_wall_s, step_dev_s, flops, cpu_s = run_train_bench()
+    with span("bench.train_step_f32"):
+        step_wall_s, step_dev_s, flops, cpu_s = run_train_bench()
     peak = peak_flops_for(device_kind())
     mfu = safe_ratio(flops, step_dev_s * peak) if peak > 0 else 0.0
     if jax.default_backend() == "cpu":
@@ -449,7 +489,9 @@ def main():
     # Line 3 (accelerator only): the TPU-first configuration — bf16
     # compute (f32 params), the MXU's native precision
     if jax.default_backend() != "cpu":
-        bw, bd, bflops, _ = run_train_bench(jnp.bfloat16, cpu_anchor=False)
+        with span("bench.train_step_bf16"):
+            bw, bd, bflops, _ = run_train_bench(jnp.bfloat16,
+                                                cpu_anchor=False)
         bmfu = safe_ratio(bflops, bd * peak) if peak > 0 else 0.0
         emit(
             f"resnet18_train_step_b{TRAIN_BATCH}_bf16_steps_per_sec",
@@ -472,7 +514,8 @@ def main():
         # failure (e.g. an attention-kernel lowering regression) must
         # not cost the ResNet lines already emitted.
         try:
-            bert_line(live)
+            with span("bench.bert_mlm"):
+                bert_line(live)
         except Exception as e:
             # same naming scheme as the success record (param count
             # unknown here) so metric-joins see an errored row, not a
@@ -495,6 +538,7 @@ def main():
         tail = fallback_record_lines(os.path.dirname(os.path.abspath(__file__)))
         if tail:
             print(json.dumps(tail[-1]), flush=True)
+    _telemetry_flush(tdir)
 
 
 BERT_BATCH, BERT_SEQ = 16, 128

@@ -118,7 +118,13 @@ def main(argv=None):
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--optim", choices=["sgd", "adam", "adafactor"],
                     default="sgd")
-    ap.add_argument("--lr", type=float, default=0.01)
+    # default=None is the explicit-lr sentinel: sniffing sys.argv for the
+    # literal "--lr" missed --lr=0.05 and argparse prefix forms and
+    # silently discarded the user's rate on the adafactor path
+    ap.add_argument("--lr", type=float, default=None,
+                    help="learning rate (default 0.01; adafactor with no "
+                         "explicit --lr and no schedule uses the paper's "
+                         "relative step size)")
     ap.add_argument("--lr-schedule", choices=["constant", "warmup_cosine",
                                               "step_decay"], default=None,
                     help="in-program lr schedule over --lr (evaluated on "
@@ -158,7 +164,21 @@ def main(argv=None):
     ap.add_argument("--instrument", action="store_true",
                     help="per-stage timing metrics")
     ap.add_argument("--log-every", type=int, default=5)
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="enable the run-wide FlightRecorder; dumps "
+                         "train.jsonl (tools/telemetry_report.py reads it) "
+                         "into this directory at exit")
     args = ap.parse_args(argv)
+    explicit_lr = args.lr is not None
+    if args.lr is None:
+        args.lr = 0.01
+    if args.telemetry_dir:
+        import os
+
+        from pytorch_ps_mpi_tpu import telemetry
+
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        telemetry.configure(worker="trainer")
     if args.adamw:
         if args.optim != "adam":
             ap.error("--adamw requires --optim adam")
@@ -211,7 +231,7 @@ def main(argv=None):
     if args.adamw:
         hyper["decoupled_weight_decay"] = True
     if args.optim == "adafactor" and args.lr_schedule is None \
-            and "--lr" not in (argv if argv is not None else sys.argv):
+            and not explicit_lr:
         # no explicit lr and no schedule: the paper's relative step size
         hyper["lr"] = None
     opt = MPI_PS(
@@ -230,6 +250,16 @@ def main(argv=None):
     if resumed:
         print(f"resumed from step {trainer.step_count}")
     summary = trainer.fit(data, args.steps, log_every=args.log_every)
+    if args.telemetry_dir:
+        import os
+
+        from pytorch_ps_mpi_tpu import telemetry
+
+        path = telemetry.get_recorder().dump_jsonl(
+            os.path.join(args.telemetry_dir, "train.jsonl")
+        )
+        print(f"telemetry: {path} (summarize with "
+              "tools/telemetry_report.py)")
     print(json.dumps({k: round(float(v), 6) for k, v in summary.items()}))
 
 

@@ -66,6 +66,16 @@ def main(argv=None):
     ap.add_argument("--checkpoint-every", type=int, default=50)
     ap.add_argument("--resume", action="store_true",
                     help="restore the latest PS checkpoint before serving")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="ONE flag, full telemetry: FlightRecorder JSONL "
+                         "from the server and every worker, a Prometheus "
+                         "/metrics endpoint (tcp transport; port in the "
+                         "final metrics line), a merged host+device "
+                         "Perfetto trace (trace.json), and a per-phase "
+                         "report — all dropped in this directory")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="tcp transport: serve Prometheus /metrics on this "
+                         "port (0 = auto; implied =0 by --telemetry-dir)")
     args = ap.parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir")
@@ -88,6 +98,20 @@ def main(argv=None):
         cfg["codec"] = args.codec
     if args.straggler_ms:
         cfg["slow_ms"] = {str(args.workers - 1): args.straggler_ms}
+    if args.telemetry_dir:
+        import glob
+
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        # a reused dir must not leak a previous run's files into this
+        # run's merged trace/report (worker counts can differ)
+        for stale in glob.glob(os.path.join(args.telemetry_dir, "*.jsonl")) \
+                + glob.glob(os.path.join(args.telemetry_dir, "trace.json")):
+            os.remove(stale)
+        cfg["telemetry_dir"] = args.telemetry_dir
+        if args.metrics_port is None:
+            args.metrics_port = 0
+    if args.metrics_port is not None:
+        cfg["metrics_port"] = args.metrics_port
 
     code = None
     if args.codec:
@@ -114,6 +138,17 @@ def main(argv=None):
         )
     total = args.workers * args.steps
     procs = []
+    device_trace_dir = device_t0_wall = None
+    if args.telemetry_dir:
+        # device-side half of the merged timeline: trace the server
+        # process's XLA programs (the jitted decode+update+publish path)
+        # while serve() runs; workers are separate processes — their
+        # host-side story arrives through their JSONLs
+        import time as _time
+
+        device_trace_dir = os.path.join(args.telemetry_dir, "device-trace")
+        jax.profiler.start_trace(device_trace_dir)
+        device_t0_wall = _time.time()
     try:
         procs = [spawn_worker(name, i, cfg) for i in range(args.workers)]
         params, metrics = serve(
@@ -127,14 +162,50 @@ def main(argv=None):
             if rc != 0:
                 raise SystemExit(f"worker exited {rc}")
     finally:
+        if device_trace_dir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # a profiler write error must never
+                # skip the server close / orphan-worker kill below
+                print(f"device trace capture failed: {e}", file=sys.stderr)
+                device_trace_dir = None
         server.close()
         for p in procs:  # never leave orphan workers if serve() raised
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=10)
 
+    if args.telemetry_dir:
+        metrics.update(_export_telemetry(
+            args.telemetry_dir, device_trace_dir, device_t0_wall
+        ))
     print(json.dumps(metrics, default=str))
     return metrics
+
+
+def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
+    """Merge every process's JSONL (+ the server's device trace) into
+    trace.json, print the per-phase report, return artifact paths."""
+    import glob
+
+    from pytorch_ps_mpi_tpu.telemetry import export_chrome_trace, load_jsonl
+    from tools.telemetry_report import format_table, summarize
+
+    files = sorted(glob.glob(os.path.join(tdir, "*.jsonl")))
+    events = []
+    for f in files:
+        events.extend(load_jsonl(f)[1])
+    trace_path, counts = export_chrome_trace(
+        os.path.join(tdir, "trace.json"), events,
+        device_trace_dir=device_trace_dir, device_t0_wall=device_t0_wall,
+    )
+    print(format_table(summarize(files, by_worker=False)))
+    return {
+        "telemetry_trace": trace_path,
+        "telemetry_trace_host_events": counts["host"],
+        "telemetry_trace_device_events": counts["device"],
+        "telemetry_files": files,
+    }
 
 
 if __name__ == "__main__":

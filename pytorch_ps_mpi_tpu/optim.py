@@ -202,7 +202,17 @@ def adam_update(
 # paper's memory-efficient default; Adam covers the momentum niche).
 # Semantics mirror optax.adafactor leaf-for-leaf (factoring over the
 # two LARGEST dims, clip-by-block-rms, optional parameter-scale
-# multiply) and are pinned to it in tests/test_optim.py.
+# multiply) and are pinned to it in tests/test_optim.py — with ONE
+# deliberate divergence: ``lr=None`` here applies the paper's relative
+# step size rho_t = min(1e-2, 1/sqrt(t)) (Shazeer & Stern Alg. 4),
+# whereas ``optax.adafactor(learning_rate=None)`` simply OMITS the lr
+# scaling stage (the update magnitude then comes only from the
+# parameter scale). The paper default is the right zero-config
+# behavior for a drop-in optimizer; the two are reconciled in
+# tests/test_optim.py::
+# test_adafactor_relative_step_matches_optax_explicit_schedule, which
+# pins our lr=None path against optax given rho_t as an EXPLICIT
+# schedule.
 
 _FACTOR_MIN = 128  # fixed at init (registry inits see params only)
 

@@ -47,6 +47,7 @@ from jax import lax
 
 from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
 from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+from pytorch_ps_mpi_tpu.telemetry import get_recorder
 
 PyTree = Any
 
@@ -247,5 +248,13 @@ class AsyncPS:
         for lag in np.asarray(lags).tolist():
             self.staleness_hist[lag] = self.staleness_hist.get(lag, 0) + 1
         self.step_count += 1
-        return None, {"step_time": time.perf_counter() - t0,
+        dur = time.perf_counter() - t0
+        rec = get_recorder()
+        if rec is not None:
+            rec.event("async_ps.round", kind="span",
+                      ts=time.monotonic() - dur, dur=dur,
+                      step=self.step_count,
+                      updates_applied=self.num_workers,
+                      lags=np.asarray(lags).tolist())
+        return None, {"step_time": dur,
                       "updates_applied": float(self.num_workers)}

@@ -33,12 +33,41 @@ algorithm exists for (Lian et al. 2015, arXiv:1506.08272):
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from pytorch_ps_mpi_tpu import telemetry
+
 PyTree = Any
+
+# update/wait latency buckets (seconds): sub-ms jitted updates through
+# multi-second straggler waits
+_LATENCY_BUCKETS = (1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
+                    5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _telemetry_from_cfg(cfg: Dict[str, Any], worker: Any):
+    """The zero-cost-when-disabled switch: ``cfg["telemetry_dir"]``
+    enables the process-global FlightRecorder (server process AND every
+    spawned worker — cfg rides the spawn's JSON argv, so one flag arms
+    the whole fleet). Returns the active recorder or None."""
+    rec = telemetry.get_recorder()
+    if rec is None and cfg.get("telemetry_dir"):
+        rec = telemetry.configure(
+            capacity=int(cfg.get("telemetry_capacity", 65536)), worker=worker
+        )
+    return rec
+
+
+def _dump_recorder(cfg: Dict[str, Any], rec, filename: str) -> Optional[str]:
+    tdir = cfg.get("telemetry_dir")
+    if rec is None or not tdir:
+        return None
+    os.makedirs(tdir, exist_ok=True)
+    return rec.dump_jsonl(os.path.join(tdir, filename))
 
 
 def _model_by_name(name: str, **kw):
@@ -197,19 +226,34 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
 
         w = ShmPSWorker(name, worker_id, params0, code=code,
                         timeout=float(cfg.get("open_timeout", 60.0)))
+    rec = _telemetry_from_cfg(cfg, worker=worker_id)
     pushed = 0
     try:
         for step in range(steps):
-            params, version = w.read_params()
-            loss, grads = grad_fn(params, batch_fn(step, worker_id))
-            jax.block_until_ready(grads)
-            if slow_ms:
-                time.sleep(slow_ms / 1e3)  # deliberate straggler
-            w.push_grad(grads, version,
-                        timeout=float(cfg.get("push_timeout", 60.0)))
+            if rec is None:
+                params, version = w.read_params()
+                loss, grads = grad_fn(params, batch_fn(step, worker_id))
+                jax.block_until_ready(grads)
+                if slow_ms:
+                    time.sleep(slow_ms / 1e3)  # deliberate straggler
+                w.push_grad(grads, version,
+                            timeout=float(cfg.get("push_timeout", 60.0)))
+            else:
+                with rec.span("worker.read_params", step=step):
+                    params, version = w.read_params()
+                with rec.span("worker.grad", step=step, version=version):
+                    loss, grads = grad_fn(params, batch_fn(step, worker_id))
+                    jax.block_until_ready(grads)
+                if slow_ms:
+                    with rec.span("worker.straggle", step=step):
+                        time.sleep(slow_ms / 1e3)  # deliberate straggler
+                with rec.span("worker.push_grad", step=step, version=version):
+                    w.push_grad(grads, version,
+                                timeout=float(cfg.get("push_timeout", 60.0)))
             pushed += 1
     finally:
         w.close()
+        _dump_recorder(cfg, rec, f"worker-{worker_id}.jsonl")
     return pushed
 
 
@@ -304,6 +348,22 @@ def serve(
     (the reference's MPI job had no analog: a rank-0 death ended the
     job, SURVEY §5.4/§5.3). ``applied``/counters restart per serve call;
     the restored ``applied_total`` rides in the metrics.
+
+    Telemetry (``cfg`` keys, so one dict arms server and workers):
+
+    - ``telemetry_dir``: enables the FlightRecorder here AND in every
+      spawned worker (cfg rides the spawn argv); each process dumps its
+      JSONL into the directory at exit (``server.jsonl``,
+      ``worker-N.jsonl``) and the path rides the returned metrics as
+      ``telemetry_jsonl``. Disabled (the default), the loop pays one
+      None-check per gradient.
+    - ``metrics_port``: start the Prometheus ``/metrics`` HTTP endpoint
+      on a server that can serve one (TCP transport; 0 = auto-assign).
+      The bound port is returned as ``metrics_port`` in the metrics and
+      the endpoint stays up until ``server.close()``. Either way the
+      serve loop feeds step-latency and straggler-wait histograms into
+      ``server.scrape_registry()`` — the shm transport scrapes the same
+      registry via ``server.prometheus_text()``.
     """
     import jax
 
@@ -330,6 +390,28 @@ def serve(
                 _restore_ps_checkpoint(ckpt, params, state, checkpoint_every)
             )
 
+    rec = _telemetry_from_cfg(cfg, worker="server")
+    reg = server.scrape_registry()
+    h_update = reg.histogram(
+        "ps_update_seconds", _LATENCY_BUCKETS,
+        "optimizer update + publish wall per applied round",
+    )
+    h_wait = reg.histogram(
+        "ps_poll_wait_seconds", _LATENCY_BUCKETS,
+        "idle poll time preceding each consumed gradient (straggler wait)",
+    )
+    g_applied = reg.gauge(
+        "ps_applied_total", "gradients applied this serve call"
+    )
+    metrics_http_port = None
+    if cfg.get("metrics_port") is not None and hasattr(
+            server, "start_metrics_http"):
+        metrics_http_port = server.start_metrics_http(
+            int(cfg["metrics_port"])
+        )
+        print(f"prometheus /metrics on port {metrics_http_port}",
+              flush=True)
+
     loss0 = float(eval_loss(params, eval_batch))
     server.publish(params)
     applied = 0
@@ -353,28 +435,44 @@ def serve(
             return server.grads_received < total_received
         return applied < total_grads
 
+    wait_t0 = time.perf_counter()
     while keep_going() and time.perf_counter() < deadline:
         item = server.poll_grad()
         if item is None:
             time.sleep(0.0005)
             continue
-        wid, _, grad = item
+        wid, grad_version, grad = item
+        h_wait.observe(time.perf_counter() - wait_t0)
+        if rec is not None:
+            rec.event("serve.grad", worker=wid,
+                      staleness=max(0, server.version - grad_version),
+                      step=applied, version=grad_version)
         if sync_barrier:
             # synchronous oracle: a round completes when every worker has
             # at least one queued gradient; one per worker is consumed
             pending[wid].append(grad)
             if sum(1 for q in pending.values() if q) < n_workers:
+                wait_t0 = time.perf_counter()
                 continue
+            up_t0 = time.perf_counter()
             batch_grads = [pending[w].popleft() for w in range(n_workers)]
             summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
             params, state = update(params, summed, state)
             applied += n_workers
         else:
+            up_t0 = time.perf_counter()
             params, state = update(params, grad, state)
             applied += 1
         server.publish(jax.tree.map(np.asarray, params))
+        up_dur = time.perf_counter() - up_t0
+        h_update.observe(up_dur)
+        g_applied.set(float(applied))
+        if rec is not None:
+            rec.event("serve.update", kind="span", ts=up_t0, dur=up_dur,
+                      step=applied, version=server.version)
         if cadence:
             cadence.maybe_save(params, state, server, applied_before + applied)
+        wait_t0 = time.perf_counter()
     wall = time.perf_counter() - t0
     if cadence:  # final state always captured, whatever the stop reason
         cadence.final_save(params, state, server, applied_before + applied)
@@ -388,6 +486,11 @@ def serve(
         loss_final=float(eval_loss(params, eval_batch)),
         staleness_hist={int(k): int(v) for k, v in server.staleness_seen.items()},
     )
+    if metrics_http_port is not None:
+        m["metrics_port"] = metrics_http_port
+    jsonl = _dump_recorder(cfg, rec, "server.jsonl")
+    if jsonl is not None:
+        m["telemetry_jsonl"] = jsonl
     return params, m
 
 

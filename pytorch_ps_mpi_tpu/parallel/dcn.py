@@ -23,6 +23,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from pytorch_ps_mpi_tpu.telemetry import PSServerTelemetry
+
 PyTree = Any
 
 _lib: Optional[ctypes.CDLL] = None
@@ -200,11 +202,18 @@ class CodecWire:
         )
 
 
-class ShmPSServer:
+class ShmPSServer(PSServerTelemetry):
     """Owns params; publishes snapshots, consumes gradients in arrival
     order (the PS side of the reference's rank-0 loop, README.md:61-77).
     With ``code=`` the mailboxes carry encoded payload bytes (see
-    :class:`CodecWire`) and the server decodes on receive."""
+    :class:`CodecWire`) and the server decodes on receive.
+
+    Telemetry (:class:`PSServerTelemetry`): ``metrics()`` returns the
+    canonical schema shared with ``TcpPSServer`` — the reference's
+    ``msg_bytes``/``packaged_bytes`` pair (``ps.py:135-136``) measured
+    on the live async path — and ``prometheus_text()`` is the shm
+    transport's scrape method (no socket to serve HTTP over; the TCP
+    server exposes the same registry at ``/metrics``)."""
 
     def __init__(self, name: str, num_workers: int, template: PyTree,
                  max_staleness: int = 4, code=None):
@@ -234,22 +243,6 @@ class ShmPSServer:
         # §5.3: MPI aborted the whole job; here the server observes)
         self.last_seen: Dict[int, float] = {}
         self._t0 = time.time()
-
-    def metrics(self) -> Dict[str, float]:
-        """Server-side wire observability: grads consumed, payload bytes,
-        and the codec's compression ratio vs the raw f32 wire (the
-        reference's ``msg_bytes``/``packaged_bytes`` pair, ``ps.py:135-136``,
-        measured on the live async path)."""
-        raw = self.wire.raw_bytes if self.wire else _flat_size(self.template) * 4
-        wire = self.wire.wire_bytes if self.wire else raw
-        return {
-            "grads_received": float(self.grads_received),
-            "bytes_received": float(self.bytes_received),
-            "raw_bytes_per_grad": float(raw),
-            "wire_bytes_per_grad": float(wire),
-            "compression_ratio": raw / wire,
-            "stale_drops": float(self.stale_drops),
-        }
 
     def publish(self, params: PyTree) -> None:
         flat = _flatten(params)

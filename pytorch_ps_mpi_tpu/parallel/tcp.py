@@ -49,6 +49,7 @@ from pytorch_ps_mpi_tpu.parallel.dcn import (
     _u8,
     _unflatten,
 )
+from pytorch_ps_mpi_tpu.telemetry import MetricsHTTPServer, PSServerTelemetry
 
 _lib: Optional[ctypes.CDLL] = None
 
@@ -101,10 +102,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
     return _lib
 
 
-class TcpPSServer:
+class TcpPSServer(PSServerTelemetry):
     """Owns params; serves snapshots and consumes gradients arriving over
     TCP in arrival order. Same role/surface as ``ShmPSServer``; pass
-    ``port=0`` to auto-assign (read back via ``.port`` for workers)."""
+    ``port=0`` to auto-assign (read back via ``.port`` for workers).
+
+    Telemetry (:class:`PSServerTelemetry`): ``metrics()`` returns the
+    canonical schema shared with ``ShmPSServer``, and
+    :meth:`start_metrics_http` serves the same registry as a
+    Prometheus-text ``/metrics`` HTTP endpoint — the deployment shape
+    where a scraper on another host watches the PS. There is no
+    transport-drop counter in the schema: an acknowledged push is never
+    discarded (a full queue back-pressures the pushing worker via its
+    withheld ack), so ``stale_drops`` is the only way a consumed
+    gradient can fail to be applied."""
 
     def __init__(self, port: int, num_workers: int, template: PyTree,
                  max_staleness: int = 4, code=None):
@@ -135,23 +146,19 @@ class TcpPSServer:
         self.bytes_received = 0
         self.last_seen: Dict[int, float] = {}
         self._t0 = time.time()
+        self._metrics_http: Optional[MetricsHTTPServer] = None
 
-    def metrics(self) -> Dict[str, float]:
-        """Wire observability, same schema as ``ShmPSServer.metrics``.
-        There is no transport-drop counter: an acknowledged push is never
-        discarded (a full queue back-pressures the pushing worker via its
-        withheld ack instead), so ``stale_drops`` is the only way a
-        consumed gradient can fail to be applied."""
-        raw = self.wire.raw_bytes if self.wire else _flat_size(self.template) * 4
-        wire = self.wire.wire_bytes if self.wire else raw
-        return {
-            "grads_received": float(self.grads_received),
-            "bytes_received": float(self.bytes_received),
-            "raw_bytes_per_grad": float(raw),
-            "wire_bytes_per_grad": float(wire),
-            "compression_ratio": raw / wire,
-            "stale_drops": float(self.stale_drops),
-        }
+    def start_metrics_http(self, port: int = 0,
+                           host: str = "0.0.0.0") -> int:
+        """Serve ``prometheus_text()`` at ``http://host:port/metrics`` on
+        a daemon thread (``port=0`` auto-assigns). Returns the bound
+        port; idempotent — a second call returns the live endpoint's
+        port. Torn down by :meth:`close`."""
+        if self._metrics_http is None:
+            self._metrics_http = MetricsHTTPServer(
+                self.prometheus_text, port=port, host=host
+            )
+        return self._metrics_http.port
 
     def publish(self, params: PyTree) -> None:
         flat = _flatten(params)
@@ -243,6 +250,9 @@ class TcpPSServer:
         return out
 
     def close(self):
+        if self._metrics_http is not None:
+            self._metrics_http.close()
+            self._metrics_http = None
         if self._h:
             self._lib.tps_server_close(self._h)
             self._h = None

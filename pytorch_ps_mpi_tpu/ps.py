@@ -55,6 +55,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from pytorch_ps_mpi_tpu import comms
 from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
+from pytorch_ps_mpi_tpu.telemetry import get_recorder
 from pytorch_ps_mpi_tpu.mesh import DATA_AXIS, make_mesh
 from pytorch_ps_mpi_tpu.optim import (
     OPTIMIZERS,
@@ -1477,6 +1478,7 @@ class MPI_PS:
             )
             self._step_count += 1
             data["step_time"] = time.perf_counter() - t0
+            self._record_step("ps.step_accumulate", data)
             return loss, data
         key = ("accum", _fn_cache_key(loss_fn), accum_steps)
         if key not in self._compiled:
@@ -1496,6 +1498,7 @@ class MPI_PS:
         jax.block_until_ready(self.params)
         self._step_count += 1
         data["step_time"] = time.perf_counter() - t0
+        self._record_step("ps.step_accumulate", data)
         return loss, data
 
     def _build_grads_only_step(self):
@@ -1546,6 +1549,22 @@ class MPI_PS:
             "wire_lowering": lowering,
             "wire_bytes_per_worker": wire_bytes,
         }
+
+    def _record_step(self, name: str, data: Dict[str, float]) -> None:
+        """Mirror one step's metrics dict into the run-wide
+        FlightRecorder as a span ending now — the reference's returned-
+        timings contract joining the unified timeline. Disabled
+        telemetry costs exactly this method's None-check."""
+        rec = get_recorder()
+        if rec is None:
+            return
+        dur = float(data.get("step_time", 0.0))
+        rec.event(
+            name, kind="span", ts=time.monotonic() - dur, dur=dur,
+            step=self._step_count,
+            **{k: v for k, v in data.items()
+               if isinstance(v, (int, float, str))},
+        )
 
     # -- public API --------------------------------------------------------
     def step(
@@ -1608,6 +1627,7 @@ class MPI_PS:
                 loss = closure()
             data["step_time"] = time.perf_counter() - t0
             self._step_count += 1
+            self._record_step("ps.step", data)
             return loss, data
 
         if loss_fn is not None:
@@ -1666,6 +1686,7 @@ class MPI_PS:
         # mode) fills the remaining per-stage keys with host wall times.
         data["step_time"] = time.perf_counter() - t0
         self._step_count += 1
+        self._record_step("ps.step", data)
         return loss, data
 
     def _profiled_call(self, call, data: Dict[str, float]):
@@ -1807,11 +1828,19 @@ class MPI_PS:
         n_steps = int(jax.tree.leaves(batches)[0].shape[0])
         self._step_count += n_steps
         wall = time.perf_counter() - t0
-        return losses, {
+        data = {
             "step_time": wall / n_steps,
             "steps_per_sec": n_steps / wall,
             "n_steps": float(n_steps),
         }
+        rec = get_recorder()
+        if rec is not None:
+            # ONE span for the fused scan (there are no separable
+            # per-step host walls inside one XLA program)
+            rec.event("ps.run_steps", kind="span",
+                      ts=time.monotonic() - wall, dur=wall,
+                      step=self._step_count, **data)
+        return losses, data
 
 
 class SGD(MPI_PS):

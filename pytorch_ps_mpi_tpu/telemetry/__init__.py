@@ -1,0 +1,72 @@
+"""Unified run-wide telemetry: flight recorder, metrics registry, exports.
+
+The observability layer the reference never had (its only surface was the
+wall-clock dict every ``step`` returned, ``ps.py:116-148``) and this repo
+previously scattered across per-module shims (``utils/metrics.py``
+timers, ``utils/tracing.py`` profiler wrappers, per-server ``metrics()``
+dicts). One system, three faces:
+
+- :class:`FlightRecorder` — bounded, thread-safe structured event/span
+  log (monotonic timestamps, worker id, step, staleness) with JSONL
+  export. A process-global recorder is installed with :func:`configure`;
+  every instrumented call site guards on :func:`get_recorder` returning
+  ``None``, so a disabled recorder costs one attribute read per step.
+- :class:`MetricsRegistry` — counters, gauges, bucketed histograms with
+  a Prometheus text rendering; :class:`PSServerTelemetry` gives the shm
+  and TCP parameter servers one canonical metric schema, and
+  :class:`MetricsHTTPServer` serves it at ``/metrics``.
+- :mod:`trace export <.trace_export>` — merges host-side recorder spans
+  with ``jax.profiler`` device traces into one Chrome/Perfetto timeline.
+
+``tools/telemetry_report.py`` turns a recorded JSONL into the per-phase
+summary table; ``make telemetry-smoke`` bounds the enabled-recorder
+overhead against the disabled path.
+"""
+
+from pytorch_ps_mpi_tpu.telemetry.recorder import (
+    FlightRecorder,
+    configure,
+    disable,
+    get_recorder,
+    install,
+    load_jsonl,
+    record_event,
+    span,
+)
+from pytorch_ps_mpi_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    PS_SERVER_METRIC_KEYS,
+    PSServerTelemetry,
+    ps_server_metrics,
+    ps_server_registry,
+)
+from pytorch_ps_mpi_tpu.telemetry.http_server import MetricsHTTPServer
+from pytorch_ps_mpi_tpu.telemetry.trace_export import (
+    export_chrome_trace,
+    merged_trace_events,
+)
+
+__all__ = [
+    "FlightRecorder",
+    "configure",
+    "disable",
+    "get_recorder",
+    "install",
+    "load_jsonl",
+    "record_event",
+    "span",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "PS_SERVER_METRIC_KEYS",
+    "PSServerTelemetry",
+    "ps_server_metrics",
+    "ps_server_registry",
+    "MetricsHTTPServer",
+    "export_chrome_trace",
+    "merged_trace_events",
+]

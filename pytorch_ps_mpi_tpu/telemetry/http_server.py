@@ -1,0 +1,76 @@
+"""Prometheus-text ``/metrics`` HTTP endpoint (stdlib only).
+
+Serves whatever a render callable returns — typically
+``registry.prometheus_text`` — on a daemon thread, so the PS serve loop
+is never blocked by a scraper. One scrape is one GET; the registry's
+collectors refresh instrument values from live server state at render
+time, so there is no per-gradient bookkeeping behind this endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsHTTPServer:
+    """``GET /metrics`` → the render callable's text; anything else 404.
+
+    ``port=0`` auto-assigns (read back via ``.port``). ``close()`` shuts
+    the listener down; the object is also a context manager.
+    """
+
+    def __init__(self, render: Callable[[], str], port: int = 0,
+                 host: str = "0.0.0.0"):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.rstrip("/") not in ("/metrics", ""):
+                    self.send_error(404)
+                    return
+                try:
+                    body = outer._render().encode()
+                except Exception as e:  # a scrape must never kill serving
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", _CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are not stdout news
+                pass
+
+        self._render = render
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name=f"metrics-http:{self.port}",
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

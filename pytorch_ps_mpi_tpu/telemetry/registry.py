@@ -1,0 +1,336 @@
+"""MetricsRegistry: counters, gauges, bucketed histograms, Prometheus text.
+
+One canonical metric schema for every PS server (shm and TCP emit
+*identical* keys — enforced by ``tests/test_telemetry.py``), rendered in
+the Prometheus text exposition format so a stock scraper reads the TCP
+server's ``/metrics`` endpoint (:class:`.http_server.MetricsHTTPServer`)
+and the shm server's :meth:`PSServerTelemetry.prometheus_text` scrape
+method without translation.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+_INF = float("inf")
+
+
+def _fmt(v: float) -> str:
+    """Prometheus float formatting: integers without the trailing .0,
+    +Inf spelled the Prometheus way."""
+    if v == _INF:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotonic counter."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        self.name, self.help, self.labels = name, help, dict(labels or {})
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease by {n}")
+        with self._lock:
+            self.value += n
+
+    def set(self, v: float) -> None:
+        """Mirror an externally-tracked monotonic count (the scrape-time
+        collector path: servers keep their own counters, the registry
+        reflects them)."""
+        with self._lock:
+            self.value = float(v)
+
+    def render(self) -> List[str]:
+        return [f"{self.name}{_labels_text(self.labels)} {_fmt(self.value)}"]
+
+
+class Gauge:
+    """Point-in-time value."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        self.name, self.help, self.labels = name, help, dict(labels or {})
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+    def render(self) -> List[str]:
+        return [f"{self.name}{_labels_text(self.labels)} {_fmt(self.value)}"]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its upper bound; +Inf is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, buckets: Sequence[float], help: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        self.name, self.help, self.labels = name, help, dict(labels or {})
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            i = 0
+            while i < len(self.bounds) and v > self.bounds[i]:
+                i += 1
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def load(self, value_counts: Dict[Any, int]) -> None:
+        """Mirror an externally-kept ``{value: count}`` histogram (e.g. a
+        server's ``staleness_seen``) — replaces current contents. Built
+        locally and swapped under ONE lock acquisition so concurrent
+        scrapes (ThreadingHTTPServer runs collectors per request) can
+        never interleave a reset with another scrape's adds."""
+        counts = [0] * (len(self.bounds) + 1)
+        total_sum, total_n = 0.0, 0
+        for v, n in value_counts.items():
+            v, n = float(v), int(n)
+            i = 0
+            while i < len(self.bounds) and v > self.bounds[i]:
+                i += 1
+            counts[i] += n
+            total_sum += v * n
+            total_n += n
+        with self._lock:
+            self.counts = counts
+            self.sum = total_sum
+            self.count = total_n
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile (upper bound of the bucket the
+        q-quantile observation falls in) — good enough for the report
+        table; exact values live in the flight recorder."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        if self.count == 0:
+            return math.nan
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= target and c:
+                return self.bounds[i] if i < len(self.bounds) else _INF
+        return _INF
+
+    def render(self) -> List[str]:
+        out = []
+        cum = 0
+        for b, c in zip(self.bounds + [_INF], self.counts):
+            cum += c
+            labels = dict(self.labels)
+            labels["le"] = _fmt(b)
+            out.append(f"{self.name}_bucket{_labels_text(labels)} {cum}")
+        lt = _labels_text(self.labels)
+        out.append(f"{self.name}_sum{lt} {_fmt(self.sum)}")
+        out.append(f"{self.name}_count{lt} {self.count}")
+        return out
+
+
+class MetricsRegistry:
+    """Named instruments + scrape-time collectors, rendered as Prometheus
+    text. ``counter``/``gauge``/``histogram`` are get-or-create (same
+    name returns the same instrument; a kind clash raises)."""
+
+    def __init__(self) -> None:
+        self._metrics: "Dict[str, Any]" = {}
+        self._collectors: List[Callable[["MetricsRegistry"], None]] = []
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, *args, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "", **kw) -> Counter:
+        return self._get_or_create(Counter, name, help, **kw)
+
+    def gauge(self, name: str, help: str = "", **kw) -> Gauge:
+        return self._get_or_create(Gauge, name, help, **kw)
+
+    def histogram(self, name: str, buckets: Sequence[float],
+                  help: str = "", **kw) -> Histogram:
+        return self._get_or_create(Histogram, name, buckets, help, **kw)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def add_collector(
+        self, fn: Callable[["MetricsRegistry"], None]
+    ) -> None:
+        """Register a scrape-time callback that refreshes instruments
+        from external state (server counters) before each render."""
+        self._collectors.append(fn)
+
+    def collect(self) -> None:
+        for fn in list(self._collectors):
+            fn(self)
+
+    def to_dict(self) -> Dict[str, float]:
+        """Flat snapshot for tests/JSON: counters+gauges by name,
+        histograms as ``name_sum``/``name_count``."""
+        self.collect()
+        out: Dict[str, float] = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                out[f"{m.name}_sum"] = m.sum
+                out[f"{m.name}_count"] = float(m.count)
+            else:
+                out[m.name] = m.value
+        return out
+
+    def prometheus_text(self) -> str:
+        self.collect()
+        lines: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Canonical PS-server schema (shm + TCP emit IDENTICAL keys/types)
+# ---------------------------------------------------------------------------
+
+#: The canonical ``metrics()`` dict keys every PS server emits, all float
+#: (the reference's msg/packaged-bytes accounting, ``ps.py:135-136``,
+#: plus the async protocol's staleness drop counter).
+PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
+    "grads_received",
+    "bytes_received",
+    "raw_bytes_per_grad",
+    "wire_bytes_per_grad",
+    "compression_ratio",
+    "stale_drops",
+)
+
+
+def ps_server_metrics(server) -> Dict[str, float]:
+    """The ONE implementation of the canonical server ``metrics()`` dict
+    (both transports call this — the schema cannot fork again)."""
+    if server.wire is not None:
+        raw = float(server.wire.raw_bytes)
+        wire = float(server.wire.wire_bytes)
+    else:
+        from pytorch_ps_mpi_tpu.parallel.dcn import _flat_size
+
+        raw = wire = float(_flat_size(server.template) * 4)
+    return {
+        "grads_received": float(server.grads_received),
+        "bytes_received": float(server.bytes_received),
+        "raw_bytes_per_grad": raw,
+        "wire_bytes_per_grad": wire,
+        "compression_ratio": raw / wire,
+        "stale_drops": float(server.stale_drops),
+    }
+
+
+def ps_server_registry(
+    server, registry: Optional[MetricsRegistry] = None
+) -> MetricsRegistry:
+    """Attach a scrape-time collector mirroring ``server``'s state into
+    Prometheus instruments (counters, gauges, and the staleness
+    histogram). Scraping reads live server attributes — no hot-path
+    bookkeeping is added to the serve loop."""
+    reg = registry if registry is not None else MetricsRegistry()
+    # per-unit buckets up to the bound, CAPPED: max_staleness can be huge
+    # (tests use 10**9 to disable dropping) and bucket count must not
+    # scale with it — beyond 32 the bound itself is the one extra edge
+    ms = int(server.max_staleness)
+    stale_buckets = sorted(set(list(range(min(ms, 32) + 2)) + [ms]))
+
+    def collect(r: MetricsRegistry) -> None:
+        m = ps_server_metrics(server)
+        r.counter("ps_grads_received_total",
+                  "gradients consumed by the server").set(m["grads_received"])
+        r.counter("ps_wire_bytes_received_total",
+                  "payload bytes consumed").set(m["bytes_received"])
+        r.counter("ps_stale_drops_total",
+                  "gradients dropped for exceeding max_staleness").set(
+                      m["stale_drops"])
+        r.gauge("ps_raw_bytes_per_grad",
+                "dense f32 bytes of one gradient").set(m["raw_bytes_per_grad"])
+        r.gauge("ps_wire_bytes_per_grad",
+                "encoded payload bytes of one gradient").set(
+                    m["wire_bytes_per_grad"])
+        r.gauge("ps_compression_ratio",
+                "raw/wire bytes").set(m["compression_ratio"])
+        r.gauge("ps_publish_version",
+                "latest published snapshot version").set(float(server.version))
+        r.gauge("ps_num_workers", "configured worker count").set(
+            float(server.num_workers))
+        r.histogram("ps_staleness", stale_buckets,
+                    "observed gradient staleness (versions)").load(
+                        server.staleness_seen)
+
+    reg.add_collector(collect)
+    return reg
+
+
+class PSServerTelemetry:
+    """Mixin giving a PS server the canonical telemetry surface:
+    ``metrics()`` (the canonical dict), ``scrape_registry()`` (a
+    :class:`MetricsRegistry` that reads live server state at scrape
+    time), and ``prometheus_text()`` (the shm server's scrape method;
+    the TCP server additionally serves it over HTTP)."""
+
+    _telemetry_registry: Optional[MetricsRegistry] = None
+
+    def metrics(self) -> Dict[str, float]:
+        """Canonical wire-observability schema, identical across
+        transports (see :data:`PS_SERVER_METRIC_KEYS`)."""
+        return ps_server_metrics(self)
+
+    def scrape_registry(self) -> MetricsRegistry:
+        if self._telemetry_registry is None:
+            self._telemetry_registry = ps_server_registry(self)
+        return self._telemetry_registry
+
+    def prometheus_text(self) -> str:
+        return self.scrape_registry().prometheus_text()

@@ -1,0 +1,143 @@
+"""Merged host+device Chrome/Perfetto trace export.
+
+Host side: :class:`~.recorder.FlightRecorder` spans/events (from live
+recorders or their JSONL dumps — several processes' files merge into one
+timeline on the ``wall`` clock each record carries). Device side: the
+``*.xplane.pb`` files a ``jax.profiler`` trace directory holds, read
+through :func:`pytorch_ps_mpi_tpu.utils.tracing._iter_hlo_events` — the
+same event source the comm/compute split uses.
+
+Clock honesty: host rows are placed by their ``wall`` timestamps (one
+clock across processes, NTP-grade alignment); device ops only carry the
+profiler's own timebase, so they are placed relative to the wall time at
+which the trace capture started (``device_t0_wall``, recorded by the
+caller at ``start_trace``; defaults to the host timeline's start). The
+alignment is therefore approximate at the ~ms level — good for "which
+step was the device idle in", not for ns-level attribution.
+
+Output is standard Chrome ``traceEvents`` JSON: load it at
+``ui.perfetto.dev`` or ``chrome://tracing``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+HOST_PID = 1
+DEVICE_PID_BASE = 1000
+
+
+def _host_events(
+    events: Iterable[Dict[str, Any]], t0_wall: float
+) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    tids = {}
+    for e in events:
+        wall = e.get("wall")
+        if wall is None:
+            continue
+        worker = e.get("worker", "host")
+        tid = tids.setdefault(worker, len(tids) + 1)
+        args = dict(e.get("attrs") or {})
+        for k in ("step", "staleness", "worker"):
+            if k in e:
+                args[k] = e[k]
+        ts_us = (wall - t0_wall) * 1e6
+        if e.get("kind") == "span":
+            # span rows stamp their START time (every producer passes
+            # ts=t0 to FlightRecorder.event; the span() context manager
+            # does so itself)
+            out.append({
+                "ph": "X", "name": e["name"], "cat": "host",
+                "pid": HOST_PID, "tid": tid,
+                "ts": ts_us, "dur": float(e.get("dur", 0.0)) * 1e6,
+                "args": args,
+            })
+        else:
+            out.append({
+                "ph": "i", "s": "t", "name": e["name"], "cat": "host",
+                "pid": HOST_PID, "tid": tid, "ts": ts_us, "args": args,
+            })
+    for worker, tid in tids.items():
+        out.append({
+            "ph": "M", "name": "thread_name", "pid": HOST_PID, "tid": tid,
+            "args": {"name": f"worker {worker}"},
+        })
+    out.append({
+        "ph": "M", "name": "process_name", "pid": HOST_PID,
+        "args": {"name": "host (FlightRecorder)"},
+    })
+    return out
+
+
+def _device_events(
+    trace_dir: str, t0_wall: float, device_t0_wall: Optional[float],
+    host_t0_wall: float,
+) -> List[Dict[str, Any]]:
+    from pytorch_ps_mpi_tpu.utils.tracing import _iter_hlo_events
+
+    raw = list(_iter_hlo_events(trace_dir))
+    if not raw:
+        return []
+    min_ns = min(start for _, _, start, _ in raw)
+    anchor = device_t0_wall if device_t0_wall is not None else host_t0_wall
+    base_us = (anchor - t0_wall) * 1e6
+    out: List[Dict[str, Any]] = []
+    pids: Dict[Any, int] = {}
+    for dev, name, start_ns, dur_ns in raw:
+        pid = pids.setdefault(dev, DEVICE_PID_BASE + len(pids))
+        out.append({
+            "ph": "X", "name": name, "cat": "device",
+            "pid": pid, "tid": 1,
+            "ts": base_us + (start_ns - min_ns) / 1e3,
+            "dur": dur_ns / 1e3,
+        })
+    for dev, pid in pids.items():
+        out.append({
+            "ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": f"device {dev} (jax.profiler)"},
+        })
+    return out
+
+
+def merged_trace_events(
+    host_events: Iterable[Dict[str, Any]],
+    device_trace_dir: Optional[str] = None,
+    device_t0_wall: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """FlightRecorder records (+ optional jax trace dir) → Chrome
+    ``traceEvents`` list, all timestamps relative to the earliest host
+    record."""
+    host_events = list(host_events)
+    walls = [e["wall"] for e in host_events if "wall" in e]
+    t0_wall = min(walls) if walls else (device_t0_wall or 0.0)
+    out = _host_events(host_events, t0_wall)
+    if device_trace_dir is not None:
+        out.extend(_device_events(
+            device_trace_dir, t0_wall, device_t0_wall, t0_wall
+        ))
+    return out
+
+
+def export_chrome_trace(
+    path: str,
+    host_events: Iterable[Dict[str, Any]],
+    device_trace_dir: Optional[str] = None,
+    device_t0_wall: Optional[float] = None,
+) -> Tuple[str, Dict[str, int]]:
+    """Write the merged timeline to ``path``; returns ``(path, {"host":
+    n, "device": m})`` so callers can assert both sides actually landed
+    in the artifact."""
+    events = merged_trace_events(
+        host_events, device_trace_dir, device_t0_wall
+    )
+    counts = {
+        "host": sum(1 for e in events
+                    if e.get("cat") == "host" and e["ph"] != "M"),
+        "device": sum(1 for e in events
+                      if e.get("cat") == "device" and e["ph"] != "M"),
+    }
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path, counts

@@ -1,0 +1,51 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current ``jax.shard_map(f, mesh=..., in_specs=...,
+out_specs=..., check_vma=...)`` API. Older jax builds (<= 0.4.x, e.g. the
+0.4.37 in some CI containers) only ship
+``jax.experimental.shard_map.shard_map`` with the same semantics under the
+pre-rename ``check_rep`` flag. Installing the alias here keeps every call
+site on the one modern spelling instead of scattering try/excepts.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ensure_shard_map() -> None:
+    """Install ``jax.shard_map`` on jax builds that predate the alias.
+    No-op when the real thing exists (never shadows it)."""
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None and "check_rep" not in kw:
+            kw["check_rep"] = check_vma  # pre-rename spelling
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+def ensure_axis_size() -> None:
+    """Install ``jax.lax.axis_size`` (static mapped-axis size; newer-jax
+    API) on builds that predate it — ``jax.core.axis_frame(name)``
+    returns the same static int there."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return
+    import jax.core as core
+
+    def axis_size(axis_name):
+        names = (axis_name if isinstance(axis_name, (tuple, list))
+                 else (axis_name,))
+        out = 1
+        for n in names:
+            out *= int(core.axis_frame(n))
+        return out
+
+    lax.axis_size = axis_size

@@ -21,6 +21,12 @@ class StepTimer:
     >>> with t("comm_wait"): ...
     >>> t.data
     {'comm_wait': 0.0123}
+
+    Subsumed by the telemetry FlightRecorder's span API: when the
+    run-wide recorder is enabled, every segment is ALSO recorded as a
+    span there, so legacy StepTimer call sites join the unified
+    timeline for free. The dict contract stays (the reference's
+    returned-timings schema rides on it).
     """
 
     def __init__(self):
@@ -29,10 +35,17 @@ class StepTimer:
     @contextlib.contextmanager
     def __call__(self, name: str) -> Iterator[None]:
         t0 = time.perf_counter()
+        t0_mono = time.monotonic()  # recorder spans stamp their START
         try:
             yield
         finally:
-            self.data[name] = self.data.get(name, 0.0) + time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.data[name] = self.data.get(name, 0.0) + dt
+            from pytorch_ps_mpi_tpu.telemetry import get_recorder
+
+            rec = get_recorder()
+            if rec is not None:
+                rec.event(name, kind="span", ts=t0_mono, dur=dt)
 
 
 def print_summary(obj, _depth: int = 0) -> str:

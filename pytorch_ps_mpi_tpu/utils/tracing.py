@@ -76,8 +76,21 @@ def _interval_intersection_len(a, b):
 
 def _iter_hlo_events(trace_dir: str):
     """Yield ``(device, name, start_ns, dur_ns)`` for every device op
-    execution (events carrying an ``hlo_op`` stat) in a trace dir."""
+    execution (events carrying an ``hlo_op`` stat) in a trace dir.
+
+    Reader selection: ``jax.profiler.ProfileData`` where the jax build
+    ships it; otherwise the dependency-free wire-format fallback in
+    ``utils/xplane.py`` (older jax writes the same ``xplane.pb`` files
+    but provides no reader)."""
     for f in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True):
+        if not hasattr(jax.profiler, "ProfileData"):
+            from pytorch_ps_mpi_tpu.utils import xplane
+
+            try:
+                yield from xplane.iter_hlo_events(f)
+            except Exception:
+                pass
+            continue
         try:
             pd = jax.profiler.ProfileData.from_file(f)
         except Exception:

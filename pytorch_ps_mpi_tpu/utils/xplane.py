@@ -1,0 +1,179 @@
+"""Minimal XSpace (``*.xplane.pb``) reader — no proto deps.
+
+``jax.profiler.ProfileData`` (the supported xplane reader) only exists
+on newer jax builds; older ones (<= 0.4.x) write the same ``xplane.pb``
+files but give you nothing to read them with, and this container's
+tensorboard profile plugin ships no python xplane proto either. The
+format is stable protobuf wire encoding of the XSpace schema
+(tsl/profiler/protobuf/xplane.proto), and the subset observability needs
+— planes → lines → events, plus the event/stat metadata string tables —
+is small enough to decode by hand:
+
+  XSpace.planes=1
+  XPlane{ id=1 name=2 lines=3 event_metadata=4 stat_metadata=5 }
+  XLine{ id=1 name=2 timestamp_ns=3 events=4 }
+  XEvent{ metadata_id=1 offset_ps=2 duration_ps=3 stats=4 }
+  XStat{ metadata_id=1 double=2 uint64=3 int64=4 str=5 bytes=6 ref=7 }
+  X*Metadata{ id=1 name=2 }
+
+Used as the fallback behind ``utils.tracing._iter_hlo_events`` (device
+comm/compute split, merged Perfetto export). Unknown fields are skipped
+by wire type, so schema growth does not break the reader.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Tuple
+
+_WT_VARINT, _WT_I64, _WT_LEN, _WT_I32 = 0, 1, 2, 5
+
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _skip(buf: bytes, i: int, wt: int) -> int:
+    if wt == _WT_VARINT:
+        return _varint(buf, i)[1]
+    if wt == _WT_I64:
+        return i + 8
+    if wt == _WT_LEN:
+        n, i = _varint(buf, i)
+        return i + n
+    if wt == _WT_I32:
+        return i + 4
+    raise ValueError(f"unsupported wire type {wt}")
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """Yield (field_number, wire_type, value) over one message's bytes;
+    LEN fields yield raw bytes, varints ints, fixed widths raw bytes."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        tag, i = _varint(buf, i)
+        fno, wt = tag >> 3, tag & 7
+        if wt == _WT_VARINT:
+            v, i = _varint(buf, i)
+            yield fno, wt, v
+        elif wt == _WT_LEN:
+            ln, i = _varint(buf, i)
+            yield fno, wt, buf[i:i + ln]
+            i += ln
+        else:
+            j = _skip(buf, i, wt)
+            yield fno, wt, buf[i:j]
+            i = j
+
+
+def _metadata_names(entries: List[bytes]) -> Dict[int, str]:
+    """map<int64, X{Event,Stat}Metadata> → {id: name}. Each entry is a
+    MapEntry{ key=1, value=2 } whose value holds { id=1, name=2 }."""
+    out: Dict[int, str] = {}
+    for entry in entries:
+        key, name = 0, ""
+        for fno, wt, v in _fields(entry):
+            if fno == 1 and wt == _WT_VARINT:
+                key = v
+            elif fno == 2 and wt == _WT_LEN:
+                for f2, w2, v2 in _fields(v):
+                    if f2 == 2 and w2 == _WT_LEN:
+                        name = v2.decode("utf-8", "replace")
+        out[key] = name
+    return out
+
+
+def _event_stats(ev_stats: List[bytes],
+                 stat_names: Dict[int, str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for raw in ev_stats:
+        mid, val = 0, None
+        for fno, wt, v in _fields(raw):
+            if fno == 1 and wt == _WT_VARINT:
+                mid = v
+            elif fno in (3, 4, 7) and wt == _WT_VARINT:
+                # uint64 / int64 / ref (ref resolves through the same
+                # stat-name table — the profiler interns hlo names there)
+                val = stat_names.get(v, v) if fno == 7 else v
+            elif fno == 5 and wt == _WT_LEN:
+                val = v.decode("utf-8", "replace")
+            elif fno == 2:
+                import struct
+
+                val = struct.unpack("<d", v)[0] if len(v) == 8 else None
+        name = stat_names.get(mid)
+        if name:
+            out[name] = val
+    return out
+
+
+def iter_plane_events(
+    path: str,
+) -> Iterator[Tuple[str, str, float, float, Dict[str, Any]]]:
+    """Yield ``(plane_name, event_name, start_ns, dur_ns, stats)`` for
+    every event in every plane of one ``xplane.pb`` file."""
+    with open(path, "rb") as f:
+        space = f.read()
+    for fno, wt, plane_buf in _fields(space):
+        if fno != 1 or wt != _WT_LEN:
+            continue
+        plane_name = ""
+        lines: List[bytes] = []
+        emd_raw: List[bytes] = []
+        smd_raw: List[bytes] = []
+        for pf, pw, pv in _fields(plane_buf):
+            if pf == 2 and pw == _WT_LEN:
+                plane_name = pv.decode("utf-8", "replace")
+            elif pf == 3 and pw == _WT_LEN:
+                lines.append(pv)
+            elif pf == 4 and pw == _WT_LEN:
+                emd_raw.append(pv)
+            elif pf == 5 and pw == _WT_LEN:
+                smd_raw.append(pv)
+        event_names = _metadata_names(emd_raw)
+        stat_names = _metadata_names(smd_raw)
+        for line_buf in lines:
+            t0_ns = 0
+            events: List[bytes] = []
+            for lf, lw, lv in _fields(line_buf):
+                if lf == 3 and lw == _WT_VARINT:
+                    t0_ns = lv
+                elif lf == 4 and lw == _WT_LEN:
+                    events.append(lv)
+            for ev_buf in events:
+                mid = offset_ps = dur_ps = 0
+                ev_stats: List[bytes] = []
+                for ef, ew, evv in _fields(ev_buf):
+                    if ef == 1 and ew == _WT_VARINT:
+                        mid = evv
+                    elif ef == 2 and ew == _WT_VARINT:
+                        offset_ps = evv
+                    elif ef == 3 and ew == _WT_VARINT:
+                        dur_ps = evv
+                    elif ef == 4 and ew == _WT_LEN:
+                        ev_stats.append(evv)
+                yield (
+                    plane_name,
+                    event_names.get(mid, str(mid)),
+                    t0_ns + offset_ps / 1e3,
+                    dur_ps / 1e3,
+                    _event_stats(ev_stats, stat_names),
+                )
+
+
+def iter_hlo_events(path: str):
+    """The ``_iter_hlo_events`` contract from one file: ``(device, name,
+    start_ns, dur_ns)`` for device op executions (events carrying an
+    ``hlo_op`` stat)."""
+    for plane, name, start_ns, dur_ns, stats in iter_plane_events(path):
+        if dur_ns <= 0 or "hlo_op" not in stats:
+            continue
+        dev = stats.get("device_ordinal", plane)
+        yield dev, name, float(start_ns), float(dur_ns)

@@ -177,6 +177,45 @@ def test_adafactor_matches_optax():
             p_mine, p_ox)
 
 
+def test_adafactor_relative_step_matches_optax_explicit_schedule():
+    """The documented lr=None divergence (optim.py): our lr=None applies
+    Shazeer & Stern Alg. 4's relative step rho_t = min(1e-2, 1/sqrt(t)),
+    while optax.adafactor(learning_rate=None) omits the lr stage
+    entirely. Reconcile by handing optax rho_t as an EXPLICIT schedule:
+    the two must then agree leaf-for-leaf over several steps (optax
+    schedules see count = completed updates, i.e. t - 1)."""
+    import optax
+    from pytorch_ps_mpi_tpu.optim import (
+        AdafactorHyper, adafactor_update, init_adafactor_state)
+
+    key = jax.random.key(3)
+    params = {
+        "big": jax.random.normal(jax.random.fold_in(key, 0), (256, 160)),
+        "small": jax.random.normal(jax.random.fold_in(key, 1), (16, 8)),
+        "vec": jax.random.normal(jax.random.fold_in(key, 2), (64,)),
+    }
+    h = AdafactorHyper(lr=None, multiply_by_parameter_scale=True)
+    state = init_adafactor_state(params)
+
+    rho = lambda count: jnp.minimum(1e-2, 1.0 / jnp.sqrt(count + 1.0))
+    ox = optax.adafactor(learning_rate=rho, momentum=None,
+                         weight_decay_rate=None)
+    ox_state = ox.init(params)
+    p_mine, p_ox = params, params
+    for i in range(4):
+        grads = jax.tree.map(
+            lambda p, j=i: jax.random.normal(
+                jax.random.fold_in(key, 200 + j), p.shape) * 0.1,
+            p_mine)
+        p_mine, state = adafactor_update(p_mine, grads, state, h)
+        upd, ox_state = ox.update(grads, ox_state, p_ox)
+        p_ox = optax.apply_updates(p_ox, upd)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5),
+            p_mine, p_ox)
+
+
 def test_adafactor_state_is_sublinear_and_trains(mesh8):
     """The memory claim and the end-to-end claim: factored state is a
     tiny fraction of a params copy, and MPI_PS(optim='adafactor')

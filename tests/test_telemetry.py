@@ -1,0 +1,254 @@
+"""Unified telemetry subsystem: canonical server schema (shm == TCP),
+Prometheus ``/metrics`` HTTP scrape, FlightRecorder JSONL round-trip,
+merged trace export, and the report CLI's aggregation."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu import telemetry
+from pytorch_ps_mpi_tpu.telemetry import (
+    PS_SERVER_METRIC_KEYS,
+    FlightRecorder,
+    MetricsHTTPServer,
+    MetricsRegistry,
+    export_chrome_trace,
+    load_jsonl,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_global_recorder():
+    """Tests must not leak a process-global recorder into each other."""
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _template(n=6):
+    return {"w": np.zeros((n,), np.float32)}
+
+
+def _make_server(transport, template, **kw):
+    if transport == "shm":
+        from pytorch_ps_mpi_tpu.parallel import dcn
+
+        if dcn.get_lib() is None:
+            pytest.skip("native toolchain unavailable")
+        return dcn.ShmPSServer(f"/psq_tel_{os.getpid()}_{transport}",
+                               num_workers=1, template=template, **kw)
+    from pytorch_ps_mpi_tpu.parallel import tcp
+
+    if tcp.get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    return tcp.TcpPSServer(0, num_workers=1, template=template, **kw)
+
+
+# -- canonical server schema ------------------------------------------------
+
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
+def test_server_metrics_canonical_schema(transport):
+    """Every PS server emits exactly the canonical keys, all floats —
+    the schema is one shared implementation, not per-transport dicts."""
+    server = _make_server(transport, _template())
+    try:
+        m = server.metrics()
+        assert tuple(sorted(m)) == tuple(sorted(PS_SERVER_METRIC_KEYS))
+        assert all(type(v) is float for v in m.values()), m
+    finally:
+        server.close()
+
+
+def test_server_metrics_identical_across_transports():
+    """Same template, same codec config → byte-for-byte identical
+    metrics dicts from the shm and TCP servers."""
+    tpl = _template()
+    s1 = _make_server("shm", tpl)
+    s2 = _make_server("tcp", tpl)
+    try:
+        assert s1.metrics() == s2.metrics()
+    finally:
+        s1.close()
+        s2.close()
+
+
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
+def test_server_prometheus_scrape_method(transport):
+    """Both transports expose the same registry as Prometheus text; the
+    staleness histogram mirrors ``staleness_seen`` at scrape time."""
+    server = _make_server(transport, _template(), max_staleness=4)
+    try:
+        server.staleness_seen.update({0: 3, 2: 1})
+        server.grads_received = 4
+        text = server.prometheus_text()
+        assert "ps_grads_received_total 4" in text
+        assert "ps_staleness_count 4" in text
+        assert 'ps_staleness_bucket{le="0"} 3' in text
+        assert 'ps_staleness_bucket{le="2"} 4' in text
+    finally:
+        server.close()
+
+
+def test_huge_max_staleness_does_not_explode_buckets():
+    """max_staleness=10**9 (the disable-drops idiom) must produce a
+    bounded bucket list, not a billion-entry range."""
+    server = _make_server("shm", _template(), max_staleness=10**9)
+    try:
+        hist = server.scrape_registry().get("ps_staleness")
+        assert hist is None or True  # registry builds lazily
+        text = server.prometheus_text()
+        assert text.count("ps_staleness_bucket") < 64
+    finally:
+        server.close()
+
+
+def test_tcp_metrics_http_endpoint():
+    """A stock HTTP GET of /metrics returns the Prometheus text; any
+    other path 404s; the port survives until close()."""
+    server = _make_server("tcp", _template())
+    try:
+        port = server.start_metrics_http(0, host="127.0.0.1")
+        assert port == server.start_metrics_http(0)  # idempotent
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "# TYPE ps_grads_received_total counter" in body
+        assert "ps_publish_version 0" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        server.close()
+
+
+# -- FlightRecorder ---------------------------------------------------------
+
+def test_flight_recorder_jsonl_roundtrip(tmp_path):
+    rec = FlightRecorder(capacity=128, worker=3)
+    with rec.span("phase.compute", step=1, note="hi"):
+        pass
+    rec.event("grad", step=2, staleness=1, bytes=4096)
+    path = rec.dump_jsonl(str(tmp_path / "r.jsonl"))
+    meta, events = load_jsonl(path)
+    assert meta["dropped"] == 0 and meta["n_events"] == 2
+    assert meta["worker"] == 3
+    span, ev = events
+    assert span["name"] == "phase.compute" and span["kind"] == "span"
+    assert span["dur"] >= 0 and span["step"] == 1
+    assert span["attrs"] == {"note": "hi"}
+    assert ev["name"] == "grad" and ev["staleness"] == 1
+    assert ev["worker"] == 3  # recorder default rides every record
+    assert ev["attrs"]["bytes"] == 4096
+    # wall/monotonic clocks describe the same instants, in order
+    assert span["ts"] <= ev["ts"] and span["wall"] <= ev["wall"]
+
+
+def test_flight_recorder_bounded_and_counts_drops(tmp_path):
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.event("e", step=i)
+    assert len(rec) == 4 and rec.dropped == 6
+    meta, events = load_jsonl(rec.dump_jsonl(str(tmp_path / "r.jsonl")))
+    assert meta["dropped"] == 6
+    assert [e["step"] for e in events] == [6, 7, 8, 9]  # newest kept
+
+
+def test_global_recorder_zero_cost_guard():
+    assert telemetry.get_recorder() is None
+    telemetry.record_event("ignored")  # no-op, must not raise
+    with telemetry.span("ignored.span"):
+        pass
+    rec = telemetry.configure(capacity=16, worker="t")
+    with telemetry.span("live.span"):
+        pass
+    telemetry.record_event("live.event")
+    assert [e["name"] for e in rec.events()] == ["live.span", "live.event"]
+    telemetry.disable()
+    assert telemetry.get_recorder() is None
+
+
+# -- registry primitives ----------------------------------------------------
+
+def test_registry_prometheus_text_and_types():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "help").inc(2)
+    reg.gauge("g").set(1.5)
+    h = reg.histogram("h_seconds", [0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.prometheus_text()
+    assert "# TYPE c_total counter" in text
+    assert "# TYPE h_seconds histogram" in text
+    assert 'h_seconds_bucket{le="0.1"} 1' in text
+    assert 'h_seconds_bucket{le="1"} 2' in text
+    assert 'h_seconds_bucket{le="+Inf"} 3' in text
+    assert "h_seconds_count 3" in text
+    with pytest.raises(ValueError):
+        reg.gauge("c_total")  # kind clash must not silently alias
+    with pytest.raises(ValueError):
+        reg.counter("c_total").inc(-1)
+
+
+def test_histogram_quantile_and_load():
+    from pytorch_ps_mpi_tpu.telemetry import Histogram
+
+    h = Histogram("x", buckets=[1, 2, 4, 8])
+    h.load({1: 50, 4: 45, 8: 5})
+    assert h.count == 100
+    assert h.quantile(0.5) == 1
+    assert h.quantile(0.95) == 4
+    assert h.quantile(1.0) == 8
+
+
+# -- trace export + report --------------------------------------------------
+
+def test_chrome_trace_export_merges_processes(tmp_path):
+    r1 = FlightRecorder(worker="server")
+    with r1.span("serve.update", step=1):
+        pass
+    r2 = FlightRecorder(worker=0)
+    r2.event("worker.push", step=1)
+    events = r1.events() + r2.events()
+    path, counts = export_chrome_trace(str(tmp_path / "t.json"), events)
+    assert counts == {"host": 2, "device": 0}
+    trace = json.load(open(path))
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "serve.update" in names and "worker.push" in names
+    # anchored at the earliest record (a few µs of float slack: wall
+    # epochs are ~1.7e9 s, where float64 granularity is sub-µs)
+    assert all(e["ts"] >= -5.0 for e in xs)
+    # distinct workers land on distinct tracks
+    tids = {e.get("tid") for e in trace["traceEvents"] if e["ph"] != "M"}
+    assert len(tids) == 2
+
+
+def test_report_summarize_by_worker(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.telemetry_report import format_table, summarize
+
+    paths = []
+    for w, dur in ((0, 0.01), (1, 0.03)):
+        rec = FlightRecorder(worker=w)
+        rec.event("worker.grad", kind="span", dur=dur, step=0)
+        rec.event("worker.grad", kind="span", dur=dur, step=1)
+        rec.event("crash", step=1)
+        paths.append(rec.dump_jsonl(str(tmp_path / f"w{w}.jsonl")))
+
+    merged = summarize(paths)
+    (row,) = [r for r in merged["spans"] if r["name"] == "worker.grad"]
+    assert row["count"] == 4
+    assert abs(row["total_s"] - 0.08) < 1e-9
+
+    per = summarize(paths, by_worker=True)
+    rows = {r["worker"]: r for r in per["spans"]}
+    assert rows[0]["count"] == 2 and rows[1]["count"] == 2
+    assert rows[1]["mean_ms"] > rows[0]["mean_ms"]  # the straggler view
+    table = format_table(per)
+    assert "worker.grad" in table and "crash" in table

@@ -1,0 +1,151 @@
+"""Summarize FlightRecorder JSONL dumps into a per-phase table.
+
+Usage:
+  python tools/telemetry_report.py RUN_DIR_OR_JSONL [more ...] [--json]
+      [--by-worker]
+
+Accepts recorder JSONL files and/or directories containing them (a
+``--telemetry-dir`` run drops ``server.jsonl`` + ``worker-N.jsonl`` +
+``trace.json`` in one directory; every ``*.jsonl`` inside is merged).
+Spans aggregate into count / total / mean / p50 / p95 / max wall time
+per name; point events are counted. ``--by-worker`` splits rows per
+worker id — the straggler view. ``--json`` emits the same summary as a
+machine-readable dict (what ``bench.py`` embeds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_ps_mpi_tpu.telemetry import load_jsonl
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def collect_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+        else:
+            out.append(p)
+    if not out:
+        raise SystemExit(f"no .jsonl files found under {paths}")
+    return out
+
+
+def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
+    """Merged summary over every file: per-span-name stats, event counts,
+    and recorder meta (dropped counts make truncation visible)."""
+    spans: Dict[Any, List[float]] = {}
+    events: Dict[Any, int] = {}
+    meta: List[Dict[str, Any]] = []
+    for path in files:
+        m, rows = load_jsonl(path)
+        if m:
+            meta.append({"file": os.path.basename(path),
+                         "worker": m.get("worker"),
+                         "n_events": m.get("n_events"),
+                         "dropped": m.get("dropped", 0)})
+        for r in rows:
+            key = ((r["name"], r.get("worker")) if by_worker
+                   else (r["name"], None))
+            if r.get("kind") == "span":
+                spans.setdefault(key, []).append(float(r.get("dur", 0.0)))
+            else:
+                events[key] = events.get(key, 0) + 1
+
+    def row(key, durs):
+        durs = sorted(durs)
+        name, worker = key
+        return {
+            "name": name,
+            "worker": worker,
+            "count": len(durs),
+            "total_s": sum(durs),
+            "mean_ms": 1e3 * sum(durs) / len(durs),
+            "p50_ms": 1e3 * _percentile(durs, 0.50),
+            "p95_ms": 1e3 * _percentile(durs, 0.95),
+            "max_ms": 1e3 * durs[-1],
+        }
+
+    return {
+        "files": meta,
+        "spans": sorted(
+            (row(k, v) for k, v in spans.items()),
+            key=lambda r: -r["total_s"],
+        ),
+        "events": [
+            {"name": k[0], "worker": k[1], "count": n}
+            for k, n in sorted(events.items(), key=lambda kv: -kv[1])
+        ],
+        "dropped_total": sum(m.get("dropped") or 0 for m in meta),
+    }
+
+
+def format_table(summary: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    has_worker = any(r["worker"] is not None for r in summary["spans"])
+    cols = (["phase"] + (["worker"] if has_worker else [])
+            + ["count", "total s", "mean ms", "p50 ms", "p95 ms", "max ms"])
+    rows = []
+    for r in summary["spans"]:
+        row = [r["name"]] + ([str(r["worker"])] if has_worker else []) + [
+            str(r["count"]), f"{r['total_s']:.3f}", f"{r['mean_ms']:.2f}",
+            f"{r['p50_ms']:.2f}", f"{r['p95_ms']:.2f}", f"{r['max_ms']:.2f}",
+        ]
+        rows.append(row)
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    fmt = "  ".join(f"{{:<{w}}}" if i == 0 else f"{{:>{w}}}"
+                    for i, w in enumerate(widths))
+    lines.append(fmt.format(*cols))
+    lines.append("  ".join("-" * w for w in widths))
+    for r in rows:
+        lines.append(fmt.format(*r))
+    if summary["events"]:
+        lines.append("")
+        lines.append("events:")
+        for e in summary["events"]:
+            who = f" [worker {e['worker']}]" if e["worker"] is not None else ""
+            lines.append(f"  {e['name']}{who}: {e['count']}")
+    if summary["dropped_total"]:
+        lines.append("")
+        lines.append(
+            f"WARNING: {summary['dropped_total']} records evicted by the "
+            "bounded buffer — raise the recorder capacity for a complete log"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> Dict[str, Any]:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+",
+                    help="recorder .jsonl files and/or directories of them")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    ap.add_argument("--by-worker", action="store_true",
+                    help="split span rows per worker id (straggler view)")
+    args = ap.parse_args(argv)
+    summary = summarize(collect_files(args.paths), by_worker=args.by_worker)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(format_table(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()
