@@ -2,8 +2,17 @@
 # `mpirun -n 2 py.test -s`); here multi-chip is an 8-device virtual CPU
 # mesh set up by tests/conftest.py — no cluster, no MPI.
 
+# Default test path includes the bucketing parity + launch-count suite
+# (tests/test_bucketing.py); `make bucket-smoke` runs just that gate.
 test:
 	python -m pytest tests/ -q
+
+# Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
+# steps (identity/cast codecs, both topologies) plus the CPU-backend
+# launch-count assertion (bucketed step lowers to >=5x fewer collective
+# ops than per-leaf), and the serialization wire-format tests.
+bucket-smoke:
+	python -m pytest tests/test_bucketing.py tests/test_utils.py -q
 
 # Recorder-overhead gate: short CPU trainer, recorder off vs on in
 # interleaved blocks; writes smoke.jsonl + report.txt and FAILS if the
@@ -33,4 +42,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke

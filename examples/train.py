@@ -146,6 +146,10 @@ def main(argv=None):
                          "powersgd|threshold|ef")
     ap.add_argument("--codec-arg", action="append", default=[],
                     help="k=v passed to the codec (repeatable)")
+    ap.add_argument("--bucket-mb", type=float, default=0.0,
+                    help="fuse per-leaf collectives into ~N MB "
+                         "dtype-grouped flat buckets (0 = per-leaf; see "
+                         "docs/OPERATIONS.md 'Gradient bucketing')")
     ap.add_argument("--bf16-comm", action="store_true",
                     help="bfloat16 gradient collectives")
     ap.add_argument("--donate", action="store_true",
@@ -238,7 +242,8 @@ def main(argv=None):
         params, optim=args.optim, code=code, mode=args.mode,
         average=True, instrument=args.instrument,
         comm_dtype=jnp.bfloat16 if args.bf16_comm else None,
-        donate_buffers=args.donate, clip_norm=args.clip_norm, **hyper,
+        donate_buffers=args.donate, clip_norm=args.clip_norm,
+        bucket_mb=args.bucket_mb, **hyper,
     )
     print(f"config={args.config} devices={jax.device_count()} "
           f"world={opt.size} codec={args.codec or 'identity'}")

@@ -49,6 +49,12 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=0.01)
     ap.add_argument("--codec", default=None,
                     help="codec registry name (e.g. sign, int8, threshold)")
+    ap.add_argument("--bucket-mb", type=float, default=0.0,
+                    help="with --codec (a bucketable one): ship dtype-"
+                         "grouped ~N MB flat bucket payloads per push "
+                         "instead of per-leaf fragments; one flag "
+                         "configures server AND workers (the wire "
+                         "agreement has a single source)")
     ap.add_argument("--max-staleness", type=int, default=4)
     ap.add_argument("--straggler-ms", type=float, default=0.0,
                     help="inject this delay into the last worker's loop")
@@ -96,6 +102,8 @@ def main(argv=None):
     }
     if args.codec:
         cfg["codec"] = args.codec
+        if args.bucket_mb:
+            cfg["bucket_mb"] = args.bucket_mb
     if args.straggler_ms:
         cfg["slow_ms"] = {str(args.workers - 1): args.straggler_ms}
     if args.telemetry_dir:
@@ -127,6 +135,7 @@ def main(argv=None):
         server = tcp.TcpPSServer(
             args.port, num_workers=args.workers, template=params0,
             max_staleness=args.max_staleness, code=code,
+            bucket_mb=cfg.get("bucket_mb", 0.0),
         )
         name = f"127.0.0.1:{server.port}"
         print(f"tcp PS listening on {name}")
@@ -135,6 +144,7 @@ def main(argv=None):
         server = dcn.ShmPSServer(
             name, num_workers=args.workers, template=params0,
             max_staleness=args.max_staleness, code=code,
+            bucket_mb=cfg.get("bucket_mb", 0.0),
         )
     total = args.workers * args.steps
     procs = []

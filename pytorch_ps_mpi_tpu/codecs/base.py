@@ -45,6 +45,19 @@ class Codec:
     #: codecs that consume randomness (random-k, QSGD) set this so the
     #: train step threads a per-worker PRNG key in.
     needs_rng: bool = False
+    #: shape-agnostic AND stateless codecs set this so flat-bucket
+    #: aggregation (``bucketing.BucketPlan``) may encode one dtype-uniform
+    #: ~MB-scale bucket instead of hundreds of per-leaf fragments.
+    #: Contract: ``init_state`` returns ``()`` (per-bucket state has no
+    #: home — bucket boundaries are a transport detail, not a training
+    #: one) and ``encode``/``decode``/``decode_sum`` treat the input as an
+    #: opaque flat array (any per-input statistic — sign's mean|g|, int8's
+    #: absmax — is then computed per bucket instead of per tensor, a
+    #: documented semantics change for those lossy codecs). Per-tensor
+    #: codecs (PowerSGD's 2-D factorization, top-k's per-tensor selection,
+    #: stateful error feedback) leave this False and keep the per-leaf
+    #: path even when bucketing is on.
+    bucketable: bool = False
     #: codecs whose aggregation IS a collective protocol (PowerSGD's
     #: two-psum shared-Q form) set this and implement
     #: ``fused_allreduce(grad, state, axis_name, comm_dtype=None) ->

@@ -27,6 +27,9 @@ from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
 @register_codec("bf16")
 class Bf16Codec(Codec):
     supports_psum = True
+    # a cast is elementwise: casting one flat bucket == casting each leaf
+    # (bit-exact), so bucketed aggregation is lossless relative to per-leaf
+    bucketable = True
 
     wire_dtype = jnp.bfloat16
 

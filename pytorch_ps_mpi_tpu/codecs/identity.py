@@ -16,6 +16,7 @@ from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
 @register_codec("identity")
 class IdentityCodec(Codec):
     supports_psum = True
+    bucketable = True  # trivially shape-agnostic and stateless
 
     def encode(self, grad, state=(), rng=None):
         return grad, state

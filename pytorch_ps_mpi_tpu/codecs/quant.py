@@ -28,6 +28,10 @@ class Int8Codec(Codec):
     to XLA's fusion. The kernel stays available for layout experiments.
     """
 
+    # shape-agnostic + stateless: bucketed aggregation quantizes with a
+    # per-BUCKET absmax scale instead of per-tensor (coarser scale group)
+    bucketable = True
+
     def __init__(self, use_pallas: bool = False):
         self.use_pallas = use_pallas
 
@@ -71,6 +75,8 @@ class QSGDCodec(Codec):
     ``levels`` buckets of the normalized magnitude; unbiased."""
 
     needs_rng = True
+    # per-bucket norm instead of per-tensor under bucketing; still unbiased
+    bucketable = True
 
     def __init__(self, levels: int = 16):
         # levels must fit the int8 payload: encode stores q in [-levels,

@@ -26,6 +26,18 @@ from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
 class RandomKCodec(Codec):
     needs_rng = True
 
+    @property
+    def bucketable(self):
+        # Only the FRACTION form is bucket-safe: k scales with the unit's
+        # size, so keeping fraction·n coordinates of each bucket equals
+        # keeping fraction·n of each leaf (stratum boundaries move, the
+        # estimator stays exactly unbiased per coordinate, total kept
+        # count is unchanged). An ABSOLUTE k is per-UNIT by definition —
+        # bucketing would silently shrink the kept set by ~leaves/buckets
+        # (an unconfigured compression increase), so that form keeps the
+        # per-leaf path.
+        return self.fraction > 0
+
     def __init__(self, k: int = 0, fraction: float = 0.0, unbiased: bool = True):
         if (k <= 0) == (fraction <= 0.0):
             raise ValueError("give exactly one of k>0 or 0<fraction<=1")

@@ -28,6 +28,11 @@ class SignCodec(Codec):
     within one codec configuration, which is all the aggregation pipeline
     needs (every worker runs the same codec)."""
 
+    # shape-agnostic + stateless: under flat-bucket aggregation the scale
+    # becomes a per-BUCKET mean|g| instead of per-tensor (same estimator
+    # family, coarser normalization group — documented semantics change)
+    bucketable = True
+
     def __init__(self, use_pallas: bool = True):
         self.use_pallas = use_pallas
 

@@ -30,6 +30,9 @@ def _packed_len(n: int) -> int:
 @register_codec("terngrad")
 class TernGradCodec(Codec):
     needs_rng = True
+    # per-bucket max|g| scale instead of per-tensor under bucketing;
+    # unbiasedness is preserved (scale is shared, Bernoulli stays exact)
+    bucketable = True
 
     def encode(self, grad, state=(), rng=None):
         assert rng is not None, "TernGradCodec needs a PRNG key"

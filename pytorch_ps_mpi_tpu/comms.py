@@ -59,6 +59,25 @@ def allreduce_sum_tree(tree: PyTree, axis_name: str) -> PyTree:
     return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
 
 
+def allreduce_sum_buckets(
+    buckets, axis_name, wire_dtype=None
+) -> list:
+    """One ``psum`` per flat dtype-grouped bucket (``bucketing.BucketPlan``
+    output) — the launch-fused form of :func:`allreduce_sum_tree`: a
+    BERT-size tree goes from hundreds of per-leaf collectives to a handful
+    of ~MB-scale ones. ``wire_dtype`` narrows each bucket on the wire and
+    casts back (same contract as ``MPI_PS(comm_dtype=...)``; applied
+    unconditionally so numerics match the per-leaf psum path bit for
+    bit)."""
+    out = []
+    for b in buckets:
+        if wire_dtype is not None:
+            out.append(lax.psum(b.astype(wire_dtype), axis_name).astype(b.dtype))
+        else:
+            out.append(lax.psum(b, axis_name))
+    return out
+
+
 def all_gather(x: jax.Array, axis_name: str) -> jax.Array:
     """Every rank receives every rank's ``x``, stacked on a new leading
     axis — the reference's ``Iallgatherv`` (``mpi_comms.py:160-163``) minus

@@ -220,12 +220,14 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
 
         host, port = name.rsplit(":", 1)
         w = TcpPSWorker(host, int(port), worker_id, params0, code=code,
-                        timeout=float(cfg.get("open_timeout", 60.0)))
+                        timeout=float(cfg.get("open_timeout", 60.0)),
+                        bucket_mb=float(cfg.get("bucket_mb", 0.0)))
     else:
         from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSWorker
 
         w = ShmPSWorker(name, worker_id, params0, code=code,
-                        timeout=float(cfg.get("open_timeout", 60.0)))
+                        timeout=float(cfg.get("open_timeout", 60.0)),
+                        bucket_mb=float(cfg.get("bucket_mb", 0.0)))
     rec = _telemetry_from_cfg(cfg, worker=worker_id)
     pushed = 0
     try:

@@ -108,20 +108,57 @@ class CodecWire:
     receive (``ps.py:94,166``) — applied to the async PS path: the worker
     encodes on device and ships the payload *bytes*; the server decodes
     back to a gradient. Because payload shapes are static, the wire spec
-    (leaf shapes/dtypes/order) is fixed at construction — the reference's
+    (unit shapes/dtypes/order) is fixed at construction — the reference's
     per-message two-phase size exchange (``mpi_comms.py:144-174``)
     collapses to a one-time agreement, and the mailbox slot is sized to
     the spec exactly (no ``max_bytes`` high-water growth).
+
+    ``bucket_mb > 0`` with a ``Codec.bucketable`` codec makes the wire
+    UNIT a dtype-grouped flat bucket (``bucketing.BucketPlan``) instead
+    of a pytree leaf: one push then ships a handful of contiguous
+    ~MB-scale payload buffers instead of hundreds of per-leaf fragments
+    (fewer per-unit scale/index sidecars on the wire, one big memcpy per
+    unit on each end). Worker and server MUST agree on ``bucket_mb`` —
+    it joins the codec config in the one-time wire agreement and should
+    come from the same config source on both ends (``async_train`` plumbs
+    ``cfg["bucket_mb"]`` to server and workers alike). The ``poll_grad``
+    size check catches a mismatch whenever it changes total wire bytes
+    (any codec with per-unit sidecars); like a same-size codec-config
+    disagreement, a mismatch that preserves the byte count (identity
+    codec over a mixed-dtype tree) is NOT detectable from the frame
+    alone — single-source the config.
+
+    The byte packing itself is double-buffered and chunked:
+    ``encode_to_bytes`` first starts ASYNC device→host transfers for
+    every payload array, then packs them into one of two preallocated
+    ping-pong wire buffers — the DMA of payload *k+1* overlaps the host
+    memcpy of payload *k* (serialization overlapping I/O), and the
+    ping-pong lets a transport still draining buffer A (kernel socket
+    buffer, shm seqlock reader) coexist with the next step encoding into
+    buffer B. No ``b"".join`` double copy anywhere on the path.
     """
 
-    def __init__(self, code, template: PyTree, seed: int = 0):
+    def __init__(self, code, template: PyTree, seed: int = 0,
+                 bucket_mb: float = 0.0):
         import jax
         import jax.numpy as jnp
 
+        from pytorch_ps_mpi_tpu.bucketing import plan_buckets
+
         self.code = code
         leaves, self.treedef = jax.tree.flatten(template)
-        self.shapes = [tuple(np.shape(l)) for l in leaves]
-        self.dtypes = [np.asarray(l).dtype for l in leaves]
+        self.plan = (
+            plan_buckets(template, bucket_mb)
+            if (bucket_mb > 0 and getattr(code, "bucketable", False))
+            else None
+        )
+        if self.plan is not None:
+            # wire units are flat dtype-grouped buckets
+            self.shapes = [(b.size,) for b in self.plan.buckets]
+            self.dtypes = [np.dtype(b.dtype) for b in self.plan.buckets]
+        else:
+            self.shapes = [tuple(np.shape(l)) for l in leaves]
+            self.dtypes = [np.asarray(l).dtype for l in leaves]
 
         def one_struct(shape, dtype):
             return jax.eval_shape(
@@ -149,10 +186,21 @@ class CodecWire:
             code.init_state(s, d) for s, d in zip(self.shapes, self.dtypes)
         ]
         self._rng = jax.random.key(seed)
+        # ping-pong wire buffers, preallocated once to the exact spec
+        self._send_bufs = [
+            np.empty(self.wire_bytes, np.uint8),
+            np.empty(self.wire_bytes, np.uint8),
+        ]
+        self._send_idx = 0
+        plan = self.plan
 
         def enc_all(grad_leaves, states, keys):
+            units = (
+                plan.pack_leaves(grad_leaves) if plan is not None
+                else grad_leaves
+            )
             payloads, new_states = [], []
-            for i, (g, st) in enumerate(zip(grad_leaves, states)):
+            for i, (g, st) in enumerate(zip(units, states)):
                 k = keys[i] if keys is not None else None
                 p, s2 = code.encode(g, st, k)
                 payloads.append(p)
@@ -160,35 +208,58 @@ class CodecWire:
             return payloads, new_states
 
         def dec_all(payloads):
-            return [
+            units = [
                 code.decode(p, s, d)
                 for p, s, d in zip(payloads, self.shapes, self.dtypes)
             ]
+            return (
+                plan.unpack_leaves(units) if plan is not None else units
+            )
 
         self._enc = jax.jit(enc_all)
         self._dec = jax.jit(dec_all)
 
-    def encode_to_bytes(self, grad_tree: PyTree) -> bytes:
+    def encode_to_bytes(self, grad_tree: PyTree) -> np.ndarray:
+        """Encode + pack into one contiguous preallocated wire buffer
+        (a uint8 ndarray of exactly ``wire_bytes``; bytes-like for every
+        transport). The returned buffer stays valid until the NEXT-next
+        call (two-deep ping-pong)."""
         import jax
 
         grad_leaves = self.treedef.flatten_up_to(grad_tree)
         keys = None
         if self.code.needs_rng:
             self._rng, sub = jax.random.split(self._rng)
-            keys = list(jax.random.split(sub, len(grad_leaves)))
+            keys = list(jax.random.split(sub, len(self.shapes)))
         payloads, self._states = self._enc(grad_leaves, self._states, keys)
-        return b"".join(
-            np.asarray(x).tobytes() for p in payloads for x in jax.tree.leaves(p)
-        )
+        flat = [x for p in payloads for x in jax.tree.leaves(p)]
+        # start all device->host DMAs before touching any bytes: the
+        # transfer of payload k+1 overlaps the memcpy of payload k below
+        for x in flat:
+            copy_async = getattr(x, "copy_to_host_async", None)
+            if copy_async is not None:
+                try:
+                    copy_async()
+                except Exception:
+                    pass  # backend without async host copies
+        from pytorch_ps_mpi_tpu.utils.serialization import pack_arrays_into
 
-    def decode_from_bytes(self, buf: bytes) -> PyTree:
+        buf = self._send_bufs[self._send_idx]
+        self._send_idx ^= 1
+        pack_arrays_into(buf, flat)
+        return buf
+
+    def decode_from_bytes(self, buf) -> PyTree:
+        """Decode a wire buffer (``bytes``, ``bytearray``, ``memoryview``
+        or uint8 ndarray) back into the template-structured gradient tree.
+        Payload arrays are zero-copy views through one ``memoryview`` —
+        the device transfer inside the jitted decode is the only copy.
+        A buffer shorter than the wire spec raises a clear ValueError."""
         import jax
 
-        arrays, off = [], 0
-        for shape, dtype in self._flat_specs:
-            n = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
-            arrays.append(np.frombuffer(buf[off:off + n], dtype).reshape(shape))
-            off += n
+        from pytorch_ps_mpi_tpu.utils.serialization import read_arrays
+
+        arrays = read_arrays(buf, self._flat_specs, copy=False)
         payloads, i = [], 0
         for ps in self._payload_structs:
             struct = jax.tree.structure(ps)
@@ -216,7 +287,7 @@ class ShmPSServer(PSServerTelemetry):
     server exposes the same registry at ``/metrics``)."""
 
     def __init__(self, name: str, num_workers: int, template: PyTree,
-                 max_staleness: int = 4, code=None):
+                 max_staleness: int = 4, code=None, bucket_mb: float = 0.0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -224,7 +295,13 @@ class ShmPSServer(PSServerTelemetry):
         self.template = template
         self.num_workers = num_workers
         self.max_staleness = max_staleness
-        self.wire = CodecWire(code, template) if code is not None else None
+        # bucket_mb is part of the one-time wire agreement: every worker
+        # must be constructed with the same value (the poll-side size
+        # check catches disagreement loudly)
+        self.wire = (
+            CodecWire(code, template, bucket_mb=bucket_mb)
+            if code is not None else None
+        )
         nbytes = _flat_size(template) * 4
         grad_slot = self.wire.wire_bytes if self.wire else nbytes
         self._h = lib.psq_create(name.encode(), num_workers, nbytes, grad_slot)
@@ -297,9 +374,9 @@ class ShmPSServer(PSServerTelemetry):
                 "and server codec configs disagree"
             )
         if self.wire:
-            grad = self.wire.decode_from_bytes(
-                self._grad_buf[:n].tobytes()
-            )
+            # zero-copy: decode reads the receive buffer through a
+            # memoryview; the jitted decode's device transfer is the copy
+            grad = self.wire.decode_from_bytes(self._grad_buf[:n])
         else:
             flat = self._grad_buf[: n // 4].copy()
             grad = _unflatten(flat, self.template)
@@ -356,7 +433,8 @@ class ShmPSWorker:
     gradients (the worker side of AsySG-InCon's inconsistent reads)."""
 
     def __init__(self, name: str, worker_id: int, template: PyTree,
-                 timeout: float = 30.0, code=None, seed: int = 0):
+                 timeout: float = 30.0, code=None, seed: int = 0,
+                 bucket_mb: float = 0.0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -373,10 +451,11 @@ class ShmPSWorker:
             raise TimeoutError(f"psq_open({name}) timed out")
         self.worker_id = worker_id
         self.template = template
-        # worker's wire must agree with the server's (same codec config);
-        # stochastic codecs get a per-worker PRNG stream
+        # worker's wire must agree with the server's (same codec config
+        # AND bucket_mb); stochastic codecs get a per-worker PRNG stream
         self.wire = (
-            CodecWire(code, template, seed=seed + worker_id)
+            CodecWire(code, template, seed=seed + worker_id,
+                      bucket_mb=bucket_mb)
             if code is not None else None
         )
         self._param_buf = np.empty(_flat_size(template), np.float32)
@@ -413,8 +492,10 @@ class ShmPSWorker:
                   timeout: float = 30.0) -> None:
         if self.wire:
             # encode-before-send (reference ps.py:94): only payload bytes
-            # ever enter the mailbox
-            flat = np.frombuffer(self.wire.encode_to_bytes(grad), np.uint8).copy()
+            # ever enter the mailbox. encode_to_bytes hands back its
+            # preallocated ping-pong buffer — valid through this push's
+            # retry loop, no defensive copy needed.
+            flat = self.wire.encode_to_bytes(grad)
         else:
             flat = _flatten(grad)
         deadline = time.time() + timeout

@@ -15,11 +15,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pytorch_ps_mpi_tpu.bucketing import plan_buckets
 from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
 from pytorch_ps_mpi_tpu.mesh import DATA_AXIS
 from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
 from pytorch_ps_mpi_tpu.ps import (
     aggregate,
+    bucketed_aggregate,
     encode_tree,
     fused_allreduce_tree,
     leader_init_state,
@@ -42,6 +44,7 @@ def make_sync_train_step(
     mode: str = "allgather",
     average: bool = False,
     donate: bool = True,
+    bucket_mb: float = 0.0,
     **hyper,
 ):
     """Build ``(init_fn, step_fn)``.
@@ -50,6 +53,13 @@ def make_sync_train_step(
     ``step_fn(params, opt_state, codec_state, batch, rng) ->
     (params, opt_state, codec_state, loss)`` — one fused XLA program,
     batch sharded over ``axis_name``, params replicated.
+
+    ``bucket_mb > 0`` fuses the aggregation collectives into dtype-grouped
+    flat buckets (``bucketing.BucketPlan``) for ``mode='allgather'`` with a
+    bucketable codec — bit-exact for identity/cast, one launch per bucket.
+    The functional leader mode keeps the per-leaf path (its ZeRO-1 state
+    layout is built by ``init_fn`` per leaf); use ``MPI_PS(mode='leader',
+    bucket_mb=...)`` for bucket-sharded ZeRO-1.
     """
     code = code if code is not None else IdentityCodec()
     hyper_cls, init_state, update_fn = OPTIMIZERS[optim]
@@ -69,9 +79,31 @@ def make_sync_train_step(
             return leader_init_state(params, init_state, size), codec_state
         return init_state(params), codec_state
 
+    bucketed = (
+        bucket_mb > 0 and mode == "allgather"
+        and code.bucketable and not code.supports_fused_allreduce
+    )
+    if bucketed and jax.tree.leaves(code.init_state((1,), jnp.float32)):
+        # same contract MPI_PS enforces: a bucketable codec must be
+        # stateless, or the bucketed branch would silently freeze its
+        # state (see codecs.base.Codec.bucketable)
+        raise TypeError(
+            f"{type(code).__name__}.bucketable=True but init_state is "
+            "non-empty — bucketable codecs must be stateless"
+        )
+
     def spmd(params, opt_state, codec_state, batch, rng):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         loss = lax.pmean(loss, axis_name)
+        if bucketed:
+            # one collective per dtype-grouped flat bucket; the codec is
+            # stateless by the bucketable contract, state passes through
+            plan = plan_buckets(grads, bucket_mb)
+            summed = bucketed_aggregate(
+                code, grads, plan, axis_name, average, size, rng=rng
+            )
+            new_params, new_opt_state = update_fn(params, summed, opt_state, h)
+            return new_params, new_opt_state, codec_state, loss
         if code.supports_fused_allreduce:
             # collective-protocol codec (PowerSGD two-psum): aggregation
             # IS the codec — same lowering as MPI_PS's fused step

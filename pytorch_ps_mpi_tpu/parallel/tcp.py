@@ -118,7 +118,7 @@ class TcpPSServer(PSServerTelemetry):
     gradient can fail to be applied."""
 
     def __init__(self, port: int, num_workers: int, template: PyTree,
-                 max_staleness: int = 4, code=None):
+                 max_staleness: int = 4, code=None, bucket_mb: float = 0.0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native tcpps unavailable (no g++?)")
@@ -126,7 +126,12 @@ class TcpPSServer(PSServerTelemetry):
         self.template = template
         self.num_workers = num_workers
         self.max_staleness = max_staleness
-        self.wire = CodecWire(code, template) if code is not None else None
+        # bucket_mb joins the one-time wire agreement (same value on
+        # every worker; the per-frame size check catches disagreement)
+        self.wire = (
+            CodecWire(code, template, bucket_mb=bucket_mb)
+            if code is not None else None
+        )
         nbytes = _flat_size(template) * 4
         grad_bytes = self.wire.wire_bytes if self.wire else nbytes
         # one frame must fit the larger of a snapshot or a payload
@@ -215,7 +220,8 @@ class TcpPSServer(PSServerTelemetry):
                 break
             self.stale_drops += 1
         if self.wire:
-            grad = self.wire.decode_from_bytes(self._grad_buf[:n].tobytes())
+            # zero-copy: decode reads the receive buffer via memoryview
+            grad = self.wire.decode_from_bytes(self._grad_buf[:n])
         else:
             flat = self._grad_buf[: n // 4].copy()
             grad = _unflatten(flat, self.template)
@@ -270,7 +276,8 @@ class TcpPSWorker:
     gradients. Same surface as ``ShmPSWorker``."""
 
     def __init__(self, host: str, port: int, worker_id: int, template: PyTree,
-                 timeout: float = 30.0, code=None, seed: int = 0):
+                 timeout: float = 30.0, code=None, seed: int = 0,
+                 bucket_mb: float = 0.0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native tcpps unavailable (no g++?)")
@@ -293,7 +300,8 @@ class TcpPSWorker:
         self.worker_id = worker_id
         self.template = template
         self.wire = (
-            CodecWire(code, template, seed=seed + worker_id)
+            CodecWire(code, template, seed=seed + worker_id,
+                      bucket_mb=bucket_mb)
             if code is not None else None
         )
         self._param_buf = np.empty(_flat_size(template), np.float32)
@@ -325,7 +333,10 @@ class TcpPSWorker:
     def push_grad(self, grad: PyTree, version: int,
                   timeout: float = 30.0) -> None:
         if self.wire:
-            flat = np.frombuffer(self.wire.encode_to_bytes(grad), np.uint8).copy()
+            # encode_to_bytes returns its preallocated ping-pong wire
+            # buffer (one contiguous bucket payload per push) — the native
+            # send consumes it synchronously, no defensive copy
+            flat = self.wire.encode_to_bytes(grad)
         else:
             flat = _flatten(grad)
         rc = self._lib.tps_worker_push_grad(

@@ -54,6 +54,12 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from pytorch_ps_mpi_tpu import comms
+from pytorch_ps_mpi_tpu.bucketing import (
+    BucketPlan,
+    flatten_into_buckets,
+    plan_buckets,
+    unflatten_from_buckets,
+)
 from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
 from pytorch_ps_mpi_tpu.telemetry import get_recorder
 from pytorch_ps_mpi_tpu.mesh import DATA_AXIS, make_mesh
@@ -482,6 +488,64 @@ def aggregate(
     return jax.tree.unflatten(treedef, summed_leaves)
 
 
+def _encode_buckets(code: Codec, buckets, rng, axis_name):
+    """Per-worker, per-bucket codec encode (stateless by the
+    ``bucketable`` contract): ONE rng-derivation for every bucketed
+    lowering, so the allgather and leader dense_scatter paths can never
+    drift onto different randomness."""
+    keys = None
+    if code.needs_rng:
+        worker_rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+        keys = list(jax.random.split(worker_rng, len(buckets)))
+    return [
+        code.encode(b, (), keys[i] if keys is not None else None)[0]
+        for i, b in enumerate(buckets)
+    ]
+
+
+def bucketed_aggregate(
+    code: Codec,
+    grads: PyTree,
+    plan: BucketPlan,
+    axis_name,
+    average: bool,
+    size: int,
+    comm_dtype=None,
+    rng=None,
+) -> PyTree:
+    """Flat-bucket form of :func:`aggregate` (mode='allgather' and the
+    leader payload-gather lowering): flatten the gradient tree into
+    dtype-grouped buckets, run ONE collective per bucket instead of one
+    per leaf, and unflatten the summed buckets back to the tree. Runs
+    inside shard_map.
+
+    psum-capable codecs psum each bucket (wire-narrowed exactly as the
+    per-leaf path would be, so numerics are bit-identical — a bucket is a
+    permutation-into-concatenation of the leaves and psum is elementwise).
+    Non-psum ``bucketable`` codecs encode each bucket as if it were one
+    large leaf (stateless by the ``bucketable`` contract), all-gather the
+    per-bucket payloads, and decode_sum per bucket — per-input statistics
+    (sign's mean|g|, int8's absmax) then apply per bucket, the documented
+    semantics shift for those lossy codecs."""
+    buckets = flatten_into_buckets(plan, grads)
+    if code.supports_psum:
+        wire = comm_dtype if comm_dtype is not None else getattr(
+            code, "wire_dtype", None
+        )
+        summed_b = comms.allreduce_sum_buckets(buckets, axis_name, wire)
+    else:
+        payloads = _encode_buckets(code, buckets, rng, axis_name)
+        summed_b = []
+        for b, payload in zip(buckets, payloads):
+            gathered = jax.tree.map(
+                lambda x: lax.all_gather(x, axis_name), payload
+            )
+            summed_b.append(code.decode_sum(gathered, b.shape, b.dtype))
+    if average:
+        summed_b = [x / size for x in summed_b]
+    return unflatten_from_buckets(plan, summed_b)
+
+
 def fused_allreduce_tree(
     code: Codec, grads: PyTree, codec_state: PyTree, axis_name,
     average: bool, size: int, comm_dtype=None,
@@ -564,6 +628,18 @@ class MPI_PS:
         gradient-sum semantics — a pmean would deflate it by the world
         size). Default None: fully-replicated params (pure DP, the
         reference's regime, ``ps.py:54-59``).
+      bucket_mb: if > 0, fuse per-leaf collectives into dtype-grouped
+        flat buckets of about this many megabytes (``bucketing.BucketPlan``)
+        — one psum (allgather mode) / psum_scatter (leader mode, each
+        worker owning a contiguous bucket shard) per BUCKET instead of
+        per leaf, cutting a BERT-size tree's collective launch count by
+        an order of magnitude. Bit-exact vs. the per-leaf path for
+        identity/cast codecs; shape-agnostic stateless codecs
+        (``Codec.bucketable``: sign, int8, qsgd, terngrad, and randomk's
+        fraction form) encode per bucket (their per-input statistics
+        then apply per bucket); per-tensor codecs (PowerSGD, top-k,
+        absolute-k randomk) keep the per-leaf path automatically. ``0`` (default) preserves per-leaf behavior
+        exactly. Requires pure-DP layouts (no ``param_specs``).
       batch_spec: optional PartitionSpec for the batch pytree's leaves
         (default ``P(axis_name)``: leading dim split over the data
         axis). With model parallelism e.g. ``P('data')`` replicates the
@@ -603,6 +679,7 @@ class MPI_PS:
         seed: int = 0,
         donate_buffers: bool = False,
         clip_norm: float = 0.0,
+        bucket_mb: float = 0.0,
         param_specs: Optional[PyTree] = None,
         batch_spec=None,
         loss_reduction: Optional[str] = None,
@@ -719,6 +796,34 @@ class MPI_PS:
                         "the leading-shard-axis convention (spec P(axis) on "
                         f"dim 0 only); got {sp} for shape {p.shape}"
                     )
+        # -- flat-bucket aggregation (bucket_mb) --------------------------
+        if bucket_mb < 0:
+            raise ValueError(f"bucket_mb must be >= 0, got {bucket_mb}")
+        self.bucket_mb = float(bucket_mb)
+        self._bucket_plan: Optional[BucketPlan] = None
+        if self.bucket_mb > 0:
+            if self._model_parallel or not self._uniform_agg:
+                raise NotImplementedError(
+                    "bucket_mb > 0 requires pure-DP layouts: model-sharded "
+                    "or expert-parallel leaves aggregate over per-leaf axis "
+                    "sets that one flat bucket cannot represent. Drop "
+                    "param_specs or set bucket_mb=0"
+                )
+            if (self.code.bucketable
+                    and not self.code.supports_fused_allreduce):
+                if jax.tree.leaves(self.code.init_state((1,), jnp.float32)):
+                    raise TypeError(
+                        f"{type(self.code).__name__}.bucketable=True but "
+                        "init_state is non-empty — bucketable codecs must "
+                        "be stateless (see codecs.base.Codec.bucketable)"
+                    )
+                self._bucket_plan = plan_buckets(params, self.bucket_mb)
+            # else: per-tensor codec — keep the per-leaf path (the
+            # documented Codec.bucketable opt-out), no error
+        self._bucket_templates = (
+            self._bucket_plan.bucket_templates()
+            if self._bucket_plan is not None else None
+        )
         self.batch_spec = batch_spec if batch_spec is not None else P(axis_name)
         if self._model_parallel and instrument:
             raise NotImplementedError(
@@ -747,7 +852,18 @@ class MPI_PS:
             # stack — a host-side build-then-reshard would transiently use
             # world× the sharded memory, defeating ZeRO-1's point at the
             # model scales it targets.
+            #
+            # With a bucket plan the master copy is kept in BUCKET form:
+            # LeaderState.param_shards leaves are per-bucket [world, ss]
+            # stacks, so the step's psum_scatter of a flat bucket lands
+            # directly on the shard the optimizer owns — no re-slicing
+            # between the wire layout and the state layout. The update is
+            # elementwise (SGD/Adam; adafactor is rejected in leader mode
+            # above), so per-bucket state is numerically identical to
+            # per-leaf state, and dtype grouping preserves leaf dtypes.
             def build(p):
+                if self._bucket_plan is not None:
+                    p = flatten_into_buckets(self._bucket_plan, p)
                 return leader_init_state(
                     p, init_state, self.size, specs_arg, self.mesh
                 )
@@ -766,18 +882,39 @@ class MPI_PS:
         self.aux_state = None  # mutable model state (e.g. BN batch_stats)
         self._compiled: Dict[Any, Callable] = {}
         self._step_count = 0
-        self._payload_bytes = float(sum(
+        self._payload_bytes_per_leaf = float(sum(
             self.code.payload_bits(
                 _local_shape(p.shape, sp, self.mesh), p.dtype
             ) // 8
             for p, sp in zip(jax.tree.leaves(params), self._spec_leaves)
         ))
+        if self._bucket_plan is not None:
+            # encode (when used) runs per BUCKET: the payload accounting
+            # must match or packaged_bytes would overstate per-leaf
+            # overheads (e.g. sign's one scale scalar per unit). The
+            # per-leaf figure is kept for the staged instrument pipeline,
+            # whose encode/gather stages stay per-leaf.
+            self._payload_bytes = float(sum(
+                self.code.payload_bits((b.size,), b.dtype) // 8
+                for b in self._bucket_plan.buckets
+            ))
+        else:
+            self._payload_bytes = self._payload_bytes_per_leaf
         self._local_param_bytes = float(sum(
             int(np.prod(_local_shape(p.shape, sp, self.mesh)) if p.shape else 1)
             * jnp.dtype(p.dtype).itemsize
             for p, sp in zip(jax.tree.leaves(params), self._spec_leaves)
         ))
         self._init_wire_accounting()
+        # static per-step launch accounting for the metrics dict / trace:
+        # aggregation units = buckets when a plan is active, leaves
+        # otherwise (the quantity bucketing exists to shrink)
+        if self._bucket_plan is not None:
+            self._agg_units = self._bucket_plan.num_buckets
+            self._bucket_bytes_total = float(self._bucket_plan.total_bytes)
+        else:
+            self._agg_units = len(self._spec_leaves)
+            self._bucket_bytes_total = 0.0
 
     # -- codec state: per-worker, stored host-side stacked on a leading
     #    [world] axis so shard_map can scatter/gather it. Model-sharded
@@ -867,12 +1004,80 @@ class MPI_PS:
             # Every rank already holds the full summed gradient (non-psum
             # codec decode path, or the instrumented stages); slice out
             # each leaf's local shard and run the sharded step.
+            if self._bucket_plan is not None:
+                # bucket-sharded state: slice each worker's contiguous
+                # BUCKET shard (the layout the opt state was built in)
+                buckets = flatten_into_buckets(self._bucket_plan, summed)
+                shards = leader_slice_shards(buckets, self.axis_name, self.size)
+                return self._leader_bucket_update(opt_state, shards)
             grad_shards = leader_slice_shards(summed, self.axis_name, self.size)
             return leader_shard_update(
                 params, opt_state, grad_shards, self._update_fn, self.hyper,
                 self.axis_name,
             )
         return self._update_fn(params, summed, opt_state, self.hyper)
+
+    def _leader_bucket_update(self, opt_state, bucket_shards):
+        """Shard-local optimizer step on contiguous bucket shards +
+        all_gather + unflatten back to replicated params (the bucketed
+        leader/ZeRO-1 lowering: opt state and master params live per
+        bucket, see ``__init__``). Runs inside shard_map."""
+        new_bucket_params, new_opt_state = leader_shard_update(
+            self._bucket_templates, opt_state, bucket_shards,
+            self._update_fn, self.hyper, self.axis_name,
+        )
+        new_params = unflatten_from_buckets(self._bucket_plan, new_bucket_params)
+        return new_params, new_opt_state
+
+    def _bucketed_encode_aggregate_update(self, params, opt_state,
+                                          codec_state, grads, rng):
+        """Flat-bucket lowering of the encode → aggregate → update seam
+        (``_bucket_plan`` is set: bucketable codec, pure-DP layout). The
+        codec is stateless by the ``bucketable`` contract, so
+        ``codec_state`` passes through untouched."""
+        plan = self._bucket_plan
+        lowering = self._leader_lowering()
+        if lowering in ("psum_scatter", "dense_scatter"):
+            if lowering == "psum_scatter":
+                to_scatter = flatten_into_buckets(plan, grads)
+                wire = self.comm_dtype if self.comm_dtype is not None else (
+                    getattr(self.code, "wire_dtype", None)
+                )
+            else:
+                # decode the own-bucket payload to the codec-filtered
+                # dense bucket, then reduce_scatter that (numerics match
+                # the gather form exactly as in the per-leaf path)
+                buckets = flatten_into_buckets(plan, grads)
+                payloads = _encode_buckets(
+                    self.code, buckets, rng, self.axis_name
+                )
+                to_scatter = [
+                    self.code.decode(p, b.shape, b.dtype)
+                    for b, p in zip(buckets, payloads)
+                ]
+                wire = self.comm_dtype
+            grad_shards = leader_scatter_shards(
+                to_scatter, self.axis_name, self.size, wire, self.average
+            )
+            if self.clip_norm:
+                # bucket shards partition the aggregated gradient exactly
+                # as leaf shards do (padding is zeros): same global norm
+                grad_shards = clip_by_global_norm(
+                    grad_shards, self.clip_norm, self.axis_name
+                )
+            new_params, new_opt_state = self._leader_bucket_update(
+                opt_state, grad_shards
+            )
+            return new_params, new_opt_state, codec_state
+        # allgather mode, or the leader payload_gather lowering (strongly
+        # compressing codec): bucketed collective + decode, then the
+        # shared update path (which re-buckets for the leader slice)
+        summed = bucketed_aggregate(
+            self.code, grads, plan, self.axis_name, self.average, self.size,
+            self.comm_dtype, rng,
+        )
+        new_params, new_opt_state = self._update(params, opt_state, summed)
+        return new_params, new_opt_state, codec_state
 
     def _tree_wire_bytes(self, wire_dtype) -> float:
         """Dense gradient bytes at the collective's wire dtype (per-leaf
@@ -1036,6 +1241,10 @@ class MPI_PS:
             )
             new_params, new_opt_state = self._update(params, opt_state, summed)
             return new_params, new_opt_state, new_codec_state
+        if self._bucket_plan is not None:
+            return self._bucketed_encode_aggregate_update(
+                params, opt_state, codec_state, grads, rng
+            )
         payloads, new_codec_state = self._encode_tree(grads, codec_state, rng)
         new_params, new_opt_state = self._aggregate_update(
             params, opt_state, grads, payloads
@@ -1150,6 +1359,13 @@ class MPI_PS:
 
         def sum_spmd(grads_stacked):
             grads = jax.tree.map(lambda x: x[0], grads_stacked)
+            if self._bucket_plan is not None:
+                # measure the same launch-fused collective topology the
+                # fused step runs (one psum per bucket, not per leaf)
+                return bucketed_aggregate(
+                    self.code, grads, self._bucket_plan, axis, False,
+                    self.size, self.comm_dtype,
+                )
             return aggregate(
                 self.code, grads, None, axis, False, self.size, self.comm_dtype
             )
@@ -1249,8 +1465,17 @@ class MPI_PS:
                 wire_dt
             )
         else:
+            # the staged encode/gather stages run PER LEAF even when a
+            # bucket plan is active (only the psum stage is bucketed), so
+            # the reported bytes/launches must describe the per-leaf
+            # topology actually measured — not the fused step's buckets
             data["wire_lowering"] = "payload_gather_staged"
-            data["wire_bytes_per_worker"] = (w - 1) * self._payload_bytes
+            data["wire_bytes_per_worker"] = (
+                (w - 1) * self._payload_bytes_per_leaf
+            )
+            data["packaged_bytes"] = self._payload_bytes_per_leaf
+            data["bucket_count"] = 0.0
+            data["agg_launches"] = float(len(self._spec_leaves))
         if self.mode == "leader":
             # the staged update stage all_gathers the sharded params back
             data["wire_bytes_per_worker"] += frac * n
@@ -1548,6 +1773,15 @@ class MPI_PS:
             "packaged_bytes": self._payload_bytes,
             "wire_lowering": lowering,
             "wire_bytes_per_worker": wire_bytes,
+            # flat-bucket aggregation accounting (bucketing.py): 0 buckets
+            # means the per-leaf path; agg_launches is the per-step
+            # collective launch count of the aggregation stage
+            "bucket_count": float(
+                self._bucket_plan.num_buckets
+                if self._bucket_plan is not None else 0
+            ),
+            "bucket_bytes_total": self._bucket_bytes_total,
+            "agg_launches": float(self._agg_units),
         }
 
     def _record_step(self, name: str, data: Dict[str, float]) -> None:

@@ -248,6 +248,12 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "wire_bytes_per_grad",
     "compression_ratio",
     "stale_drops",
+    # flat-bucket wire accounting (bucketing.BucketPlan on the CodecWire):
+    # bucket_count == 0 means the per-leaf wire; wire_units_per_push is
+    # the number of contiguous payload buffers one gradient push ships
+    # (buckets when bucketing, leaves otherwise)
+    "bucket_count",
+    "wire_units_per_push",
 )
 
 
@@ -257,10 +263,21 @@ def ps_server_metrics(server) -> Dict[str, float]:
     if server.wire is not None:
         raw = float(server.wire.raw_bytes)
         wire = float(server.wire.wire_bytes)
+        plan = getattr(server.wire, "plan", None)
+        buckets = float(plan.num_buckets) if plan is not None else 0.0
+        units = float(
+            plan.num_buckets if plan is not None
+            else len(server.wire.shapes)
+        )
     else:
+        import jax
+
         from pytorch_ps_mpi_tpu.parallel.dcn import _flat_size
 
         raw = wire = float(_flat_size(server.template) * 4)
+        buckets = 0.0
+        # the no-codec wire ships ONE concatenated f32 buffer per push
+        units = 1.0 if jax.tree.leaves(server.template) else 0.0
     return {
         "grads_received": float(server.grads_received),
         "bytes_received": float(server.bytes_received),
@@ -268,6 +285,8 @@ def ps_server_metrics(server) -> Dict[str, float]:
         "wire_bytes_per_grad": wire,
         "compression_ratio": raw / wire,
         "stale_drops": float(server.stale_drops),
+        "bucket_count": buckets,
+        "wire_units_per_push": units,
     }
 
 
@@ -301,6 +320,13 @@ def ps_server_registry(
                     m["wire_bytes_per_grad"])
         r.gauge("ps_compression_ratio",
                 "raw/wire bytes").set(m["compression_ratio"])
+        r.gauge("ps_bucket_count",
+                "flat dtype-grouped buckets per gradient push "
+                "(0 = per-leaf wire)").set(m["bucket_count"])
+        r.gauge("ps_wire_units_per_push",
+                "contiguous payload buffers one push ships "
+                "(buckets when bucketing, leaves otherwise)").set(
+                    m["wire_units_per_push"])
         r.gauge("ps_publish_version",
                 "latest published snapshot version").set(float(server.version))
         r.gauge("ps_num_workers", "configured worker count").set(

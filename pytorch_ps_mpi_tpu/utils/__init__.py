@@ -8,7 +8,9 @@ checkpoint/resume (absent in the reference; provided here via Orbax).
 
 from pytorch_ps_mpi_tpu.utils.metrics import StepTimer, MetricsAccumulator
 from pytorch_ps_mpi_tpu.utils.serialization import (
+    pack_arrays_into,
     pack_pytree,
+    read_arrays,
     unpack_pytree,
     save_pytree,
     load_pytree,
@@ -17,7 +19,9 @@ from pytorch_ps_mpi_tpu.utils.serialization import (
 __all__ = [
     "StepTimer",
     "MetricsAccumulator",
+    "pack_arrays_into",
     "pack_pytree",
+    "read_arrays",
     "unpack_pytree",
     "save_pytree",
     "load_pytree",

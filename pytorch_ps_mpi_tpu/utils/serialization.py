@@ -24,30 +24,95 @@ def _spec_of(leaves: List[np.ndarray]) -> List[dict]:
     return [{"shape": list(x.shape), "dtype": str(x.dtype)} for x in leaves]
 
 
-def pack_pytree(tree: PyTree) -> Tuple[bytes, str]:
+def pack_arrays_into(out_u8: np.ndarray, arrays, offset: int = 0) -> int:
+    """Copy each array's bytes into ``out_u8`` (a uint8 buffer view) at
+    sequential offsets — ONE memcpy per array, no intermediate bytes
+    objects. Returns the end offset. The one packing loop shared by
+    :func:`pack_pytree` and the codec wire (``parallel/dcn.CodecWire``)."""
+    for x in arrays:
+        x = np.asarray(x)
+        n = x.nbytes
+        out_u8[offset:offset + n] = (
+            np.ascontiguousarray(x).reshape(-1).view(np.uint8)
+        )
+        offset += n
+    return offset
+
+
+def read_arrays(buf, specs, copy: bool = True, offset: int = 0):
+    """Read ``[(shape, dtype), ...]`` sequentially from a bytes-like
+    buffer through one ``memoryview`` (no per-item slice copies).
+    ``copy=False`` returns zero-copy views valid only while ``buf``
+    lives. Raises :class:`ValueError` naming both sizes when the buffer
+    is shorter than the specs demand. Shared by :func:`unpack_pytree`
+    and the codec wire."""
+    dims = []
+    needed = offset
+    for shape, dtype in specs:
+        dtype = np.dtype(dtype)
+        shape = tuple(shape)
+        count = int(np.prod(shape)) if shape else 1
+        dims.append((dtype, shape, count))
+        needed += count * dtype.itemsize
+    mv = memoryview(buf)
+    if mv.nbytes < needed:
+        raise ValueError(
+            f"truncated buffer: specs describe {needed} bytes "
+            f"({len(dims)} arrays), got {mv.nbytes}"
+        )
+    out = []
+    for dtype, shape, count in dims:
+        arr = np.frombuffer(mv, dtype=dtype, count=count,
+                            offset=offset).reshape(shape)
+        out.append(arr.copy() if copy else arr)
+        offset += count * dtype.itemsize
+    return out
+
+
+def pack_pytree(tree: PyTree) -> Tuple[bytearray, str]:
     """Flatten a pytree of arrays into one contiguous byte buffer plus a
-    JSON spec (shapes/dtypes + treedef). Inverse: :func:`unpack_pytree`."""
+    JSON spec (shapes/dtypes + treedef). Inverse: :func:`unpack_pytree`.
+
+    The buffer is built in ONE preallocated ``bytearray`` with each leaf
+    copied exactly once into its final offset — the old
+    ``b"".join(tobytes())`` form copied every leaf twice (tobytes
+    materializes a per-leaf bytes object, the join copies again), which at
+    checkpoint scale doubles both the transient memory and the memcpy
+    traffic. The returned bytearray is bytes-like everywhere a wire/file
+    API wants one; call ``bytes(buf)`` only if immutability is required.
+    """
     leaves, treedef = jax.tree.flatten(tree)
     np_leaves = [np.asarray(x) for x in leaves]
-    buf = b"".join(x.tobytes() for x in np_leaves)
+    total = sum(x.nbytes for x in np_leaves)
+    buf = bytearray(total)
     spec = json.dumps({"leaves": _spec_of(np_leaves), "treedef": str(treedef)})
+    if total:
+        pack_arrays_into(np.frombuffer(buf, np.uint8), np_leaves)
     return buf, spec
 
 
-def unpack_pytree(buf: bytes, spec: str, treedef=None, template: PyTree = None):
+def unpack_pytree(buf, spec: str, treedef=None, template: PyTree = None,
+                  copy: bool = True):
     """Rebuild arrays from :func:`pack_pytree` output. Pass either the
-    ``treedef`` or a ``template`` pytree with the target structure."""
+    ``treedef`` or a ``template`` pytree with the target structure.
+
+    Reads through a single ``memoryview`` — no per-leaf
+    ``buf[offset:offset+n]`` slice copies. ``copy=True`` (default) returns
+    independent writable arrays; ``copy=False`` returns zero-copy views
+    into ``buf`` (read-only when ``buf`` is immutable ``bytes``) — the
+    checkpoint-load fast path, valid only while ``buf`` is kept alive and
+    unmodified.
+
+    A buffer shorter than the spec demands raises :class:`ValueError`
+    naming both sizes (previously it surfaced as an opaque downstream
+    ``reshape`` failure).
+    """
     meta = json.loads(spec)
-    leaves = []
-    offset = 0
-    for leaf_meta in meta["leaves"]:
-        dtype = np.dtype(leaf_meta["dtype"])
-        shape = tuple(leaf_meta["shape"])
-        nbytes = int(np.prod(shape)) * dtype.itemsize if shape else dtype.itemsize
-        n = max(nbytes, dtype.itemsize)
-        arr = np.frombuffer(buf[offset : offset + n], dtype=dtype).reshape(shape)
-        leaves.append(arr)
-        offset += n
+    leaves = read_arrays(
+        buf,
+        [(m["shape"], m["dtype"]) for m in meta["leaves"]],
+        copy=copy,
+    )
     if treedef is None:
         if template is None:
             raise ValueError("need treedef or template")

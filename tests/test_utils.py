@@ -37,6 +37,35 @@ def test_pack_unpack_roundtrip():
     buf, spec = pack_pytree(t)
     out = unpack_pytree(buf, spec, template=t)
     assert_tree_equal(t, out)
+    # immutable-bytes input (the old return type) still unpacks
+    assert_tree_equal(t, unpack_pytree(bytes(buf), spec, template=t))
+
+
+def test_unpack_truncated_buffer_raises_clearly():
+    # a short buffer must fail with a ValueError naming both sizes, not
+    # an opaque downstream reshape error
+    t = tree()
+    buf, spec = pack_pytree(t)
+    with pytest.raises(ValueError, match="truncated buffer"):
+        unpack_pytree(buf[: len(buf) - 8], spec, template=t)
+    with pytest.raises(ValueError, match="truncated buffer"):
+        unpack_pytree(b"", spec, template=t)
+
+
+def test_unpack_copy_modes():
+    t = tree()
+    buf, spec = pack_pytree(t)
+    # default: independent writable copies
+    out = unpack_pytree(buf, spec, template=t)
+    out["w"][0, 0] = 99.0
+    assert np.asarray(unpack_pytree(buf, spec, template=t)["w"])[0, 0] == 0.0
+    # copy=False: zero-copy views into the buffer (checkpoint-load fast
+    # path) — mutating the buffer is visible through the view
+    views = unpack_pytree(buf, spec, template=t, copy=False)
+    assert views["w"].base is not None
+    assert views["w"][1, 1] == 5.0
+    buf[:] = bytes(len(buf))  # zero the backing buffer
+    assert views["w"][1, 1] == 0.0
 
 
 def test_save_load_roundtrip(tmp_path):

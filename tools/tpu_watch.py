@@ -49,6 +49,10 @@ STAGES = [
     # window's 128x128-tile rows showed flash LOSING to einsum at
     # s1024/s2048; flash_tune says the 512x1024 tiles cut attention
     # 4.9x — this A/B decides the model-level verdict)
+    # flat-bucket aggregation: no TPU rows yet — launch-count sweep is
+    # instant (lowering only); resnet18 step timing shows whether fewer,
+    # larger collectives move the headline aggregation number on real ICI
+    ("bucket_bench", [sys.executable, "benchmarks/bucket_bench.py"], 900),
     ("gpt_bench", [sys.executable, "benchmarks/gpt_bench.py"], 1800),
     # train lines ONLY (codec table split into its own stage below:
     # table-first burned the whole 2400s budget on 2026-08-01 and the
