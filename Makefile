@@ -20,6 +20,15 @@ bucket-smoke:
 telemetry-smoke:
 	python tools/telemetry_smoke.py
 
+# Resilience gate (in the default `make test` path via
+# tests/test_resilience.py; this target is the full double-run): a
+# supervised 2-worker async job under a canned fault plan (worker crash,
+# server crash, corrupted frame, drop/delay/duplicate) must complete
+# with the loss improved, all recovery counters nonzero in /metrics, and
+# an identical injected-event log on replay of the same plan + seed
+chaos-smoke:
+	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
 bench:
 	python bench.py
 
@@ -42,4 +51,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke

@@ -31,6 +31,7 @@ jax.config.update("jax_platforms", "cpu")  # server process: host backend
 
 from pytorch_ps_mpi_tpu.parallel import dcn
 from pytorch_ps_mpi_tpu.parallel.async_train import (
+    join_workers,
     make_problem,
     serve,
     spawn_worker,
@@ -82,9 +83,54 @@ def main(argv=None):
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="tcp transport: serve Prometheus /metrics on this "
                          "port (0 = auto; implied =0 by --telemetry-dir)")
+    ap.add_argument("--no-frame-check", action="store_true",
+                    help="disable the self-verifying wire frames (CRC + "
+                         "config fingerprint on every push; on by default "
+                         "— one cfg configures both ends, so the frame "
+                         "header is part of the wire agreement)")
+    ap.add_argument("--resilient", action="store_true",
+                    help="workers retry/backoff on timeouts and reconnect "
+                         "on EOF instead of dying (survives a server "
+                         "restart-from-checkpoint)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run under the resilience Supervisor: dead "
+                         "workers are respawned, a crashed server is "
+                         "restarted with --resume from --checkpoint-dir; "
+                         "implies --resilient")
+    ap.add_argument("--fault-plan", default=None,
+                    help="deterministic chaos: a JSON fault-plan list, or "
+                         "@path/to/plan.json (entries "
+                         "{at_step, worker, kind}; kinds drop/delay/"
+                         "duplicate/corrupt/crash_worker/crash_server)")
+    ap.add_argument("--fault-seed", type=int, default=0,
+                    help="seed for fault randomness (corrupt byte "
+                         "positions, backoff jitter): same plan + seed = "
+                         "same injected-event log")
+    ap.add_argument("--fault-log-dir", default=None,
+                    help="directory for per-process injected-fault JSONLs "
+                         "(defaults to --telemetry-dir when set)")
     args = ap.parse_args(argv)
     if args.resume and not args.checkpoint_dir:
         ap.error("--resume requires --checkpoint-dir")
+    if args.supervise:
+        args.resilient = True
+    fault_plan = None
+    if args.fault_plan:
+        try:  # parse ONCE; validation and cfg use the same object
+            fault_plan = _parse_fault_plan(args.fault_plan)
+        except (ValueError, OSError) as e:
+            ap.error(f"--fault-plan is not valid JSON (or @file): {e}")
+        if not args.supervise:
+            # the plain serve path stops on a FIXED received count, which
+            # drop/corrupt faults make unreachable (600 s hang) and
+            # crash_worker turns into a dead fleet member nobody respawns
+            # — only the supervisor's workers-done stop condition
+            # tolerates a fault plan
+            ap.error("--fault-plan requires --supervise")
+        if any(f.get("kind") == "crash_server" for f in fault_plan
+               ) and not args.checkpoint_dir:
+            ap.error("a crash_server fault needs --checkpoint-dir to be "
+                     "survivable")
 
     in_shape = (8,) if args.model == "mlp" else (32, 32, 3)
     cfg = {
@@ -106,6 +152,32 @@ def main(argv=None):
             cfg["bucket_mb"] = args.bucket_mb
     if args.straggler_ms:
         cfg["slow_ms"] = {str(args.workers - 1): args.straggler_ms}
+    # one flag, both ends: the frame header joins the wire agreement the
+    # way the codec config and bucket_mb already do
+    cfg["frame_check"] = not args.no_frame_check
+    if args.resilient:
+        cfg["resilient"] = True
+        # resilient workers need SHORT op timeouts — the retry/backoff
+        # loop supplies the patience, and a failover is only detected
+        # when a push times out (a push into a dead server's orphaned
+        # mailbox blocks the full timeout before the reconnect fires)
+        cfg["push_timeout"] = min(float(args.timeout), 10.0)
+    if fault_plan is not None:
+        cfg["fault_plan"] = fault_plan
+        cfg["fault_seed"] = args.fault_seed
+        fault_log = args.fault_log_dir or args.telemetry_dir
+        if fault_log:
+            import glob
+
+            os.makedirs(fault_log, exist_ok=True)
+            # fault logs APPEND (respawned workers must extend, not
+            # clobber, their generation-0 rows) — so a reused dir must
+            # be cleared at RUN start or the identical-replay comparison
+            # sees the previous run's rows too
+            for stale in glob.glob(os.path.join(fault_log,
+                                                "faults-*.jsonl")):
+                os.remove(stale)
+            cfg["fault_log_dir"] = fault_log
     if args.telemetry_dir:
         import glob
 
@@ -120,6 +192,31 @@ def main(argv=None):
             args.metrics_port = 0
     if args.metrics_port is not None:
         cfg["metrics_port"] = args.metrics_port
+
+    if args.supervise:
+        from pytorch_ps_mpi_tpu.resilience import Supervisor
+
+        if args.transport == "tcp":
+            cfg["transport"] = "tcp"
+        cfg["max_staleness"] = args.max_staleness
+        if args.resume:
+            cfg["resume"] = True
+        sup = Supervisor(
+            cfg, args.workers, port=args.port,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            sync_barrier=args.sync_barrier, timeout=args.timeout,
+        )
+        params, metrics = sup.run()
+        if args.telemetry_dir:
+            # merged trace + report from the per-process JSONLs (no
+            # device trace on the supervised path: the server process
+            # restarts across phases, so there is no single profiler
+            # session to capture)
+            metrics.update(_export_telemetry(args.telemetry_dir,
+                                             None, None))
+        print(json.dumps(metrics, default=str))
+        return metrics
 
     code = None
     if args.codec:
@@ -136,6 +233,7 @@ def main(argv=None):
             args.port, num_workers=args.workers, template=params0,
             max_staleness=args.max_staleness, code=code,
             bucket_mb=cfg.get("bucket_mb", 0.0),
+            frame=cfg["frame_check"],
         )
         name = f"127.0.0.1:{server.port}"
         print(f"tcp PS listening on {name}")
@@ -145,6 +243,7 @@ def main(argv=None):
             name, num_workers=args.workers, template=params0,
             max_staleness=args.max_staleness, code=code,
             bucket_mb=cfg.get("bucket_mb", 0.0),
+            frame=cfg["frame_check"],
         )
     total = args.workers * args.steps
     procs = []
@@ -167,8 +266,7 @@ def main(argv=None):
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every, resume=args.resume,
         )
-        for p in procs:
-            rc = p.wait(timeout=args.timeout)
+        for rc in join_workers(procs, timeout=args.timeout):
             if rc != 0:
                 raise SystemExit(f"worker exited {rc}")
     finally:
@@ -180,10 +278,8 @@ def main(argv=None):
                 print(f"device trace capture failed: {e}", file=sys.stderr)
                 device_trace_dir = None
         server.close()
-        for p in procs:  # never leave orphan workers if serve() raised
-            if p.poll() is None:
-                p.kill()
-                p.wait(timeout=10)
+        # never leave orphan workers if serve() raised: terminate + reap
+        join_workers(procs, timeout=5.0)
 
     if args.telemetry_dir:
         metrics.update(_export_telemetry(
@@ -191,6 +287,14 @@ def main(argv=None):
         ))
     print(json.dumps(metrics, default=str))
     return metrics
+
+
+def _parse_fault_plan(spec: str):
+    """A fault plan from the CLI: inline JSON, or ``@file.json``."""
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return json.load(f)
+    return json.loads(spec)
 
 
 def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
@@ -201,7 +305,10 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     from pytorch_ps_mpi_tpu.telemetry import export_chrome_trace, load_jsonl
     from tools.telemetry_report import format_table, summarize
 
-    files = sorted(glob.glob(os.path.join(tdir, "*.jsonl")))
+    # faults-*.jsonl are injected-fault logs (resilience layer), not
+    # flight-recorder files — exclude them from the merged trace
+    files = sorted(f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
+                   if not os.path.basename(f).startswith("faults-"))
     events = []
     for f in files:
         events.extend(load_jsonl(f)[1])

@@ -201,7 +201,23 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     ``cfg["transport"]`` selects the wire: ``"shm"`` (default, co-hosted
     processes, ``dcn.py``) or ``"tcp"`` (cross-host DCN role, ``tcp.py``
     — ``name`` then carries ``"host:port"``). The compute path is
-    identical either way: no gradient is ever produced outside jit."""
+    identical either way: no gradient is ever produced outside jit.
+
+    Resilience knobs (all off by default — the legacy fail-fast worker):
+
+    - ``cfg["frame_check"]``: seal every push in a self-verifying frame
+      (CRC + config fingerprint, ``resilience.frames``) — must match the
+      server's setting, like the codec config it fingerprints.
+    - ``cfg["resilient"]``: wrap the transport in
+      :class:`~pytorch_ps_mpi_tpu.resilience.worker.ResilientWorker` —
+      backoff+retry on timeouts, full reconnect on EOF — so a server
+      restart-from-checkpoint is survived instead of raised on
+      (``cfg["resilience_kw"]`` forwards tuning knobs).
+    - ``cfg["fault_plan"]``: consult a deterministic
+      :class:`~pytorch_ps_mpi_tpu.resilience.faults.FaultInjector` for
+      this worker id at every step (drop/delay/duplicate/corrupt/
+      crash_worker kinds).
+    """
     import jax
 
     code = None
@@ -214,32 +230,92 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     grad_fn = jax.jit(jax.value_and_grad(loss_fn))  # ONLY grad source
 
     slow_ms, steps = worker_cfg(cfg, worker_id)
+    frame = bool(cfg.get("frame_check"))
 
-    if cfg.get("transport", "shm") == "tcp":
-        from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSWorker
+    def make_transport():
+        if cfg.get("transport", "shm") == "tcp":
+            from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSWorker
 
-        host, port = name.rsplit(":", 1)
-        w = TcpPSWorker(host, int(port), worker_id, params0, code=code,
-                        timeout=float(cfg.get("open_timeout", 60.0)),
-                        bucket_mb=float(cfg.get("bucket_mb", 0.0)))
-    else:
+            host, port = name.rsplit(":", 1)
+            return TcpPSWorker(host, int(port), worker_id, params0,
+                               code=code,
+                               timeout=float(cfg.get("open_timeout", 60.0)),
+                               bucket_mb=float(cfg.get("bucket_mb", 0.0)),
+                               frame=frame)
         from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSWorker
 
-        w = ShmPSWorker(name, worker_id, params0, code=code,
-                        timeout=float(cfg.get("open_timeout", 60.0)),
-                        bucket_mb=float(cfg.get("bucket_mb", 0.0)))
+        return ShmPSWorker(name, worker_id, params0, code=code,
+                           timeout=float(cfg.get("open_timeout", 60.0)),
+                           bucket_mb=float(cfg.get("bucket_mb", 0.0)),
+                           frame=frame)
+
     rec = _telemetry_from_cfg(cfg, worker=worker_id)
+    if cfg.get("resilient"):
+        from pytorch_ps_mpi_tpu.resilience.worker import ResilientWorker
+
+        w = ResilientWorker(make_transport, worker_id=worker_id,
+                            seed=int(cfg.get("fault_seed",
+                                             cfg.get("seed", 0))),
+                            **cfg.get("resilience_kw", {}))
+    else:
+        w = make_transport()
+
+    from pytorch_ps_mpi_tpu.resilience.faults import (
+        CRASH_EXIT_CODE,
+        FaultInjector,
+    )
+
+    inj = FaultInjector.from_cfg(cfg, role=worker_id)
+    push_timeout = float(cfg.get("push_timeout", 60.0))
     pushed = 0
     try:
         for step in range(steps):
+            drop = duplicate = False
+            if inj is not None:
+                for f in inj.faults_at(step):
+                    kind = f["kind"]
+                    if kind == "crash_worker":
+                        # fired (and fault-logged) BEFORE dying; os._exit
+                        # skips every finally — the closest an injector
+                        # gets to SIGKILL from inside the process
+                        inj.fire(f)
+                        _dump_recorder(cfg, rec, f"worker-{worker_id}.jsonl")
+                        os._exit(CRASH_EXIT_CODE)
+                    elif kind == "delay":
+                        inj.fire(f)
+                        time.sleep(float(f.get("delay_ms", 100.0)) / 1e3)
+                    elif kind == "drop":
+                        inj.fire(f)
+                        drop = True
+                    elif kind == "duplicate":
+                        inj.fire(f)
+                        duplicate = True
+                    elif kind == "corrupt":
+                        # fires when the tampered push actually happens
+                        tamper = inj.make_tamper(f)
+                        if hasattr(w, "set_tamper"):
+                            w.set_tamper(tamper)
+                        else:
+                            w._tamper = tamper
+            if drop:
+                # a dropped push cannot also be corrupted: disarm any
+                # tamper armed this step, or it would leak onto the NEXT
+                # step's push (logged under the wrong step) — the fault
+                # deterministically never fires instead
+                if hasattr(w, "set_tamper"):
+                    w.set_tamper(None)
+                else:
+                    w._tamper = None
             if rec is None:
                 params, version = w.read_params()
                 loss, grads = grad_fn(params, batch_fn(step, worker_id))
                 jax.block_until_ready(grads)
                 if slow_ms:
                     time.sleep(slow_ms / 1e3)  # deliberate straggler
-                w.push_grad(grads, version,
-                            timeout=float(cfg.get("push_timeout", 60.0)))
+                if not drop:
+                    w.push_grad(grads, version, timeout=push_timeout)
+                    if duplicate:
+                        w.push_grad(grads, version, timeout=push_timeout)
             else:
                 with rec.span("worker.read_params", step=step):
                     params, version = w.read_params()
@@ -249,10 +325,16 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                 if slow_ms:
                     with rec.span("worker.straggle", step=step):
                         time.sleep(slow_ms / 1e3)  # deliberate straggler
-                with rec.span("worker.push_grad", step=step, version=version):
-                    w.push_grad(grads, version,
-                                timeout=float(cfg.get("push_timeout", 60.0)))
+                if not drop:
+                    with rec.span("worker.push_grad", step=step,
+                                  version=version):
+                        w.push_grad(grads, version, timeout=push_timeout)
+                        if duplicate:
+                            w.push_grad(grads, version, timeout=push_timeout)
             pushed += 1
+        if rec is not None and hasattr(w, "reconnects"):
+            rec.event("resilience.summary", worker=worker_id,
+                      retries=w.retries, reconnects=w.reconnects)
     finally:
         w.close()
         _dump_recorder(cfg, rec, f"worker-{worker_id}.jsonl")
@@ -329,6 +411,8 @@ def serve(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
     resume: bool = False,
+    on_tick=None,
+    stop_when=None,
 ) -> Tuple[PyTree, Dict[str, float]]:
     """Server body: poll → (decode) → jitted optimizer update → publish.
 
@@ -366,6 +450,38 @@ def serve(
       serve loop feeds step-latency and straggler-wait histograms into
       ``server.scrape_registry()`` — the shm transport scrapes the same
       registry via ``server.prometheus_text()``.
+
+    Resilience hooks:
+
+    - ``on_tick``: called from INSIDE the loop (same thread as every
+      native-transport call — a supervisor's watchdog never races a
+      pump) at most every ``cfg["tick_interval"]`` seconds (default
+      0.2); used to respawn dead workers.
+    - ``stop_when``: extra stop predicate, checked at tick cadence; once
+      true the loop drains the already-queued gradients and returns.
+      The supervisor's "every worker exited cleanly" condition — exact
+      push counts are unknowable under drop/duplicate/corrupt faults.
+    - ``cfg["fault_plan"]``: server-targeted faults
+      (``worker: "server"``) fire when the APPLIED count crosses their
+      ``at_step`` — ``crash_server`` raises
+      :class:`~pytorch_ps_mpi_tpu.resilience.faults.InjectedServerCrash`
+      out of the loop WITHOUT the final checkpoint save (a crash doesn't
+      get one; the periodic cadence is the resume point).
+    - ``sync_barrier`` degraded rounds: when a round has been waiting
+      longer than ``cfg["degraded_round_after"]`` seconds (default 5),
+      workers that are transport-dead (no socket / flagged straggler)
+      and have nothing queued are excluded and the round completes over
+      the surviving workers — counted in ``degraded_rounds`` and
+      ``ps_degraded_rounds_total`` instead of hanging forever. A dead
+      worker that comes back (elastic replacement) rejoins the barrier
+      the moment its next gradient arrives. Caveat for the shm
+      transport: silence is its only death signal, so a LIVE worker
+      whose healthy round legitimately exceeds the window is
+      indistinguishable from a dead one and gets temporarily excluded
+      (its late gradients still apply — it rejoins on arrival, nothing
+      is lost) — size ``degraded_round_after`` above the slowest
+      expected round. TCP uses the open socket as a positive liveness
+      signal and does not have this ambiguity.
     """
     import jax
 
@@ -414,9 +530,18 @@ def serve(
         print(f"prometheus /metrics on port {metrics_http_port}",
               flush=True)
 
+    from pytorch_ps_mpi_tpu.resilience.faults import (
+        FaultInjector,
+        InjectedServerCrash,
+    )
+
+    inj = FaultInjector.from_cfg(cfg, role="server")
+
     loss0 = float(eval_loss(params, eval_batch))
     server.publish(params)
     applied = 0
+    degraded_rounds = 0
+    last_applied_total = applied_before
     cadence = (_PSCheckpointCadence(ckpt, checkpoint_every, applied_before)
                if ckpt else None)
     n_workers = server.num_workers
@@ -429,6 +554,14 @@ def serve(
     import collections
 
     pending: Dict[int, Any] = collections.defaultdict(collections.deque)
+    dead_workers: set = set()
+    c_degraded = reg.counter(
+        "ps_degraded_rounds_total",
+        "sync-barrier rounds completed over a partial fleet "
+        "(transport-dead workers excluded)",
+    )
+    degrade_after = float(cfg.get("degraded_round_after", 5.0))
+    tick_interval = float(cfg.get("tick_interval", 0.2))
     t0 = time.perf_counter()
     deadline = t0 + timeout
 
@@ -438,33 +571,31 @@ def serve(
         return applied < total_grads
 
     wait_t0 = time.perf_counter()
-    while keep_going() and time.perf_counter() < deadline:
-        item = server.poll_grad()
-        if item is None:
-            time.sleep(0.0005)
-            continue
-        wid, grad_version, grad = item
-        h_wait.observe(time.perf_counter() - wait_t0)
-        if rec is not None:
-            rec.event("serve.grad", worker=wid,
-                      staleness=max(0, server.version - grad_version),
-                      step=applied, version=grad_version)
-        if sync_barrier:
-            # synchronous oracle: a round completes when every worker has
-            # at least one queued gradient; one per worker is consumed
-            pending[wid].append(grad)
-            if sum(1 for q in pending.values() if q) < n_workers:
-                wait_t0 = time.perf_counter()
-                continue
-            up_t0 = time.perf_counter()
-            batch_grads = [pending[w].popleft() for w in range(n_workers)]
-            summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
-            params, state = update(params, summed, state)
-            applied += n_workers
-        else:
-            up_t0 = time.perf_counter()
-            params, state = update(params, grad, state)
-            applied += 1
+    round_t0 = time.perf_counter()
+    next_tick = 0.0
+    draining = False
+
+    def _fire_server_faults() -> None:
+        """Server-targeted faults fire when the global applied count
+        crosses their at_step (a sync round advances it by several at
+        once). crash_server propagates AFTER the batch's faults fired
+        and were logged."""
+        nonlocal last_applied_total
+        hi = applied_before + applied
+        if inj is None or hi == last_applied_total:
+            return
+        crash = None
+        for f in inj.faults_between(last_applied_total, hi):
+            inj.fire(f)
+            if f["kind"] == "crash_server":
+                crash = f
+            elif f["kind"] == "delay":
+                time.sleep(float(f.get("delay_ms", 100.0)) / 1e3)
+        last_applied_total = hi
+        if crash is not None:
+            raise InjectedServerCrash(crash)
+
+    def _post_update(up_t0: float) -> None:
         server.publish(jax.tree.map(np.asarray, params))
         up_dur = time.perf_counter() - up_t0
         h_update.observe(up_dur)
@@ -474,7 +605,92 @@ def serve(
                       step=applied, version=server.version)
         if cadence:
             cadence.maybe_save(params, state, server, applied_before + applied)
-        wait_t0 = time.perf_counter()
+        _fire_server_faults()
+
+    def _mark_dead_workers() -> None:
+        """Transport-level liveness sweep, consulted only once a sync
+        round has waited ``degrade_after`` seconds: TCP's ``connected``
+        is a positive dead-socket signal; shm falls back to the
+        stragglers silence window. A worker with a queued gradient is
+        never marked — its round contribution is already here. Neither
+        is a worker the server has NEVER seen: a fleet member still
+        paying its multi-second startup (jax import, first compile) is
+        slow, not dead — declaring it would silently shrink the oracle's
+        barrier at startup. Never-started workers are the supervisor's
+        problem (respawn or abandon), not the barrier's."""
+        can_connect = hasattr(server, "connected")
+        silent = None if can_connect else server.stragglers(degrade_after)
+        for w in range(n_workers):
+            if w in dead_workers or pending[w] or w not in server.last_seen:
+                continue
+            alive = server.connected(w) if can_connect else (w not in silent)
+            if not alive:
+                dead_workers.add(w)
+                if rec is not None:
+                    rec.event("serve.worker_declared_dead", worker=w)
+
+    def _try_complete_round() -> bool:
+        """Complete one sync round over the ACTIVE (not declared-dead)
+        workers if each has a queued gradient; degraded rounds (fewer
+        than n_workers contributions) are counted, never hung on."""
+        nonlocal params, state, applied, degraded_rounds, wait_t0, round_t0
+        active = [w for w in range(n_workers) if w not in dead_workers]
+        if not active or any(not pending[w] for w in active):
+            return False
+        up_t0 = time.perf_counter()
+        batch_grads = [pending[w].popleft() for w in active]
+        summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
+        params, state = update(params, summed, state)
+        applied += len(batch_grads)
+        if len(batch_grads) < n_workers:
+            degraded_rounds += 1
+            c_degraded.inc()
+            if rec is not None:
+                rec.event("serve.degraded_round", step=applied,
+                          absent=sorted(dead_workers))
+        _post_update(up_t0)
+        wait_t0 = round_t0 = time.perf_counter()
+        return True
+
+    while keep_going() and time.perf_counter() < deadline:
+        now = time.perf_counter()
+        if now >= next_tick:
+            next_tick = now + tick_interval
+            if on_tick is not None:
+                on_tick()
+            if stop_when is not None and not draining and stop_when():
+                draining = True  # consume what's queued, then return
+            if sync_barrier and now - round_t0 > degrade_after:
+                _mark_dead_workers()
+                while _try_complete_round():
+                    pass
+        item = server.poll_grad()
+        if item is None:
+            if draining:
+                break
+            time.sleep(0.0005)
+            continue
+        wid, grad_version, grad = item
+        h_wait.observe(time.perf_counter() - wait_t0)
+        if rec is not None:
+            rec.event("serve.grad", worker=wid,
+                      staleness=max(0, server.version - grad_version),
+                      step=applied, version=grad_version)
+        if sync_barrier:
+            # synchronous oracle: a round completes when every active
+            # worker has at least one queued gradient; one per worker is
+            # consumed. A gradient from a declared-dead worker proves it
+            # back alive (elastic replacement) — it rejoins the barrier.
+            dead_workers.discard(wid)
+            pending[wid].append(grad)
+            if not _try_complete_round():
+                wait_t0 = time.perf_counter()
+        else:
+            up_t0 = time.perf_counter()
+            params, state = update(params, grad, state)
+            applied += 1
+            _post_update(up_t0)
+            wait_t0 = time.perf_counter()
     wall = time.perf_counter() - t0
     if cadence:  # final state always captured, whatever the stop reason
         cadence.final_save(params, state, server, applied_before + applied)
@@ -487,6 +703,12 @@ def serve(
         loss_initial=loss0,
         loss_final=float(eval_loss(params, eval_batch)),
         staleness_hist={int(k): int(v) for k, v in server.staleness_seen.items()},
+        publish_version=float(server.version),
+        degraded_rounds=float(degraded_rounds),
+        frames_rejected_by_worker={
+            int(k): int(v)
+            for k, v in getattr(server, "frames_rejected", {}).items()
+        },
     )
     if metrics_http_port is not None:
         m["metrics_port"] = metrics_http_port
@@ -523,3 +745,45 @@ def spawn_worker(name: str, worker_id: int, cfg: Dict[str, Any],
         [sys.executable, "-c", src, name, str(worker_id), json.dumps(cfg)],
         env=e,
     )
+
+
+def join_workers(procs, timeout: float = 120.0):
+    """Reap a fleet of spawned worker processes without ever leaking one.
+
+    Waits up to ``timeout`` seconds TOTAL for the fleet, then terminates
+    (SIGTERM, escalating to SIGKILL) whatever is still running — on the
+    happy path a plain join, on every failure path (timeout, exception
+    mid-join, stuck worker) a guaranteed reap. Returns the list of exit
+    codes in ``procs`` order (negative = killed by that signal), so
+    callers can assert ``== [0, ...]`` where they used to loop
+    ``p.wait()`` — which leaked every later process when an earlier one
+    failed the assert.
+    """
+    import subprocess
+
+    codes = [None] * len(procs)
+    deadline = time.time() + timeout
+    try:
+        for i, p in enumerate(procs):
+            left = deadline - time.time()
+            if left <= 0:
+                break
+            try:
+                codes[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                pass  # reaped in finally
+    finally:
+        for i, p in enumerate(procs):
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass  # unkillable (kernel-stuck); nothing left to do
+            if codes[i] is None:
+                codes[i] = p.returncode
+    return codes

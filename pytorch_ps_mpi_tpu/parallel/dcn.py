@@ -287,7 +287,8 @@ class ShmPSServer(PSServerTelemetry):
     server exposes the same registry at ``/metrics``)."""
 
     def __init__(self, name: str, num_workers: int, template: PyTree,
-                 max_staleness: int = 4, code=None, bucket_mb: float = 0.0):
+                 max_staleness: int = 4, code=None, bucket_mb: float = 0.0,
+                 frame: bool = False):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -303,12 +304,29 @@ class ShmPSServer(PSServerTelemetry):
             if code is not None else None
         )
         nbytes = _flat_size(template) * 4
-        grad_slot = self.wire.wire_bytes if self.wire else nbytes
+        payload_bytes = self.wire.wire_bytes if self.wire else nbytes
+        self._expected_payload = payload_bytes
+        # frame=True: every push carries a self-verifying header (magic +
+        # CRC32 + config fingerprint, resilience.frames) and a bad frame
+        # becomes a counted per-worker rejection instead of a crash or a
+        # silent mis-decode. Joins the one-time wire agreement: server
+        # and every worker must agree on it (cfg["frame_check"]).
+        self.frame = bool(frame)
+        if self.frame:
+            from pytorch_ps_mpi_tpu.resilience import frames as _frames
+
+            self._frames = _frames
+            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            grad_slot = payload_bytes + _frames.HEADER_BYTES
+        else:
+            grad_slot = payload_bytes
         self._h = lib.psq_create(name.encode(), num_workers, nbytes, grad_slot)
         if not self._h:
             raise RuntimeError(f"psq_create({name}) failed")
         self.version = 0
-        if self.wire:
+        if self.frame:
+            self._grad_buf = np.empty(grad_slot, np.uint8)
+        elif self.wire:
             self._grad_buf = np.empty(self.wire.wire_bytes, np.uint8)
         else:
             self._grad_buf = np.empty(_flat_size(template), np.float32)
@@ -330,10 +348,43 @@ class ShmPSServer(PSServerTelemetry):
         if rc != 0:
             raise RuntimeError("psq_publish_params failed")
 
+    def _decode_payload(self, payload: np.ndarray) -> PyTree:
+        """Payload bytes (a view into the receive buffer) → gradient
+        tree; shared by the framed and legacy poll paths."""
+        if self.wire:
+            # zero-copy: decode reads the receive buffer through a
+            # memoryview; the jitted decode's device transfer is the copy
+            return self.wire.decode_from_bytes(payload)
+        flat = np.frombuffer(payload, np.float32).copy()
+        return _unflatten(flat, self.template)
+
+    def _poll_grad_framed(self) -> Optional[Tuple[int, int, PyTree]]:
+        """Frame-checking poll — the shared ``frames.framed_poll`` loop
+        (validate → reject-and-count → bounded staleness → decode) over
+        this transport's mailbox pop."""
+        worker = ctypes.c_uint32()
+        version = ctypes.c_uint64()
+        cursor = getattr(self, "_cursor", None)
+        if cursor is None:
+            cursor = self._cursor = ctypes.c_uint32(0)
+
+        def pop_once():
+            n = self._lib.psq_pop_grad(
+                self._h, _u8(self._grad_buf.view(np.uint8)),
+                self._grad_buf.nbytes,
+                ctypes.byref(worker), ctypes.byref(version),
+                ctypes.byref(cursor),
+            )
+            return int(n), int(worker.value), int(version.value)
+
+        return self._frames.framed_poll(self, pop_once)
+
     def poll_grad(self) -> Optional[Tuple[int, int, PyTree]]:
         """One pending gradient as (worker, version, grad_tree), or None.
         Gradients staler than max_staleness are dropped (bounded
         staleness), counted in ``stale_drops``."""
+        if self.frame:
+            return self._poll_grad_framed()
         worker = ctypes.c_uint32()
         version = ctypes.c_uint64()
         cursor = getattr(self, "_cursor", None)
@@ -434,7 +485,7 @@ class ShmPSWorker:
 
     def __init__(self, name: str, worker_id: int, template: PyTree,
                  timeout: float = 30.0, code=None, seed: int = 0,
-                 bucket_mb: float = 0.0):
+                 bucket_mb: float = 0.0, frame: bool = False):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -458,6 +509,20 @@ class ShmPSWorker:
                       bucket_mb=bucket_mb)
             if code is not None else None
         )
+        # frame must match the server's (wire agreement); the fingerprint
+        # is computed from THIS side's config — drift fails the compare
+        self.frame = bool(frame)
+        self._tamper = None  # one-shot outgoing-bytes hook (fault injection)
+        if self.frame:
+            from pytorch_ps_mpi_tpu.resilience import frames as _frames
+
+            self._frames = _frames
+            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            payload_bytes = (self.wire.wire_bytes if self.wire
+                             else _flat_size(template) * 4)
+            self._frame_buf = np.empty(
+                _frames.HEADER_BYTES + payload_bytes, np.uint8
+            )
         self._param_buf = np.empty(_flat_size(template), np.float32)
 
     def read_params(self, timeout: float = 30.0) -> Tuple[PyTree, int]:
@@ -498,6 +563,14 @@ class ShmPSWorker:
             flat = self.wire.encode_to_bytes(grad)
         else:
             flat = _flatten(grad)
+        if self.frame:
+            flat = self._frames.seal_frame(self._frame_buf, flat,
+                                           self._fingerprint)
+        if self._tamper is not None:
+            # fault injection: corrupt the outgoing bytes AFTER sealing,
+            # so the CRC no longer matches what travels
+            t, self._tamper = self._tamper, None
+            t(flat.view(np.uint8))
         deadline = time.time() + timeout
         while time.time() < deadline:
             rc = self._lib.psq_push_grad(

@@ -117,7 +117,7 @@ def server_main(shard_id: int, n_shards: int, port: int,
 
     server = TcpPSServer(port, num_workers=n_workers, template=template,
                          max_staleness=int(cfg.get("max_staleness", 4)),
-                         code=code)
+                         code=code, frame=bool(cfg.get("frame_check")))
 
     ckpt = None
     applied_before = 0
@@ -224,10 +224,27 @@ def worker_main_sharded(addrs: Sequence[str], worker_id: int,
     conns = []
     for (start, stop), addr in zip(plan, addrs):
         host, port = addr.rsplit(":", 1)
-        conns.append(TcpPSWorker(
-            host, int(port), worker_id, _slice_template(stop - start),
-            code=code, timeout=float(cfg.get("open_timeout", 60.0)),
-        ))
+        tmpl = _slice_template(stop - start)
+
+        def make_conn(host=host, port=int(port), tmpl=tmpl):
+            return TcpPSWorker(
+                host, port, worker_id, tmpl, code=code,
+                timeout=float(cfg.get("open_timeout", 60.0)),
+                frame=bool(cfg.get("frame_check")),
+            )
+
+        if cfg.get("resilient"):
+            # per-shard resilience: each connection retries/reconnects
+            # independently, so one shard's restart-from-checkpoint never
+            # takes down pushes to the healthy shards
+            from pytorch_ps_mpi_tpu.resilience.worker import ResilientWorker
+
+            conns.append(ResilientWorker(
+                make_conn, worker_id=worker_id,
+                seed=int(cfg.get("fault_seed", cfg.get("seed", 0))),
+                **cfg.get("resilience_kw", {})))
+        else:
+            conns.append(make_conn())
 
     from pytorch_ps_mpi_tpu.parallel.async_train import worker_cfg
 

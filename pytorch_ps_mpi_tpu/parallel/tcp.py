@@ -118,7 +118,8 @@ class TcpPSServer(PSServerTelemetry):
     gradient can fail to be applied."""
 
     def __init__(self, port: int, num_workers: int, template: PyTree,
-                 max_staleness: int = 4, code=None, bucket_mb: float = 0.0):
+                 max_staleness: int = 4, code=None, bucket_mb: float = 0.0,
+                 frame: bool = False):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native tcpps unavailable (no g++?)")
@@ -133,15 +134,35 @@ class TcpPSServer(PSServerTelemetry):
             if code is not None else None
         )
         nbytes = _flat_size(template) * 4
-        grad_bytes = self.wire.wire_bytes if self.wire else nbytes
+        payload_bytes = self.wire.wire_bytes if self.wire else nbytes
+        self._expected_payload = payload_bytes
+        # frame=True: self-verifying headers on every push (magic + CRC32
+        # + config fingerprint, resilience.frames); a bad frame — size
+        # mismatch from a misconfigured worker included — becomes a
+        # counted per-worker rejection instead of a RuntimeError into the
+        # serve loop. Joins the wire agreement (cfg["frame_check"]).
+        self.frame = bool(frame)
+        if self.frame:
+            from pytorch_ps_mpi_tpu.resilience import frames as _frames
+
+            self._frames = _frames
+            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            grad_bytes = payload_bytes + _frames.HEADER_BYTES
+        else:
+            grad_bytes = payload_bytes
         # one frame must fit the larger of a snapshot or a payload
-        self._h = lib.tps_server_create(port, num_workers,
-                                        max(nbytes, grad_bytes))
+        max_msg = max(nbytes, grad_bytes)
+        self._h = lib.tps_server_create(port, num_workers, max_msg)
         if not self._h:
             raise RuntimeError(f"tps_server_create(port={port}) failed")
         self.port = int(lib.tps_server_port(self._h))
         self.version = 0
-        if self.wire:
+        if self.frame:
+            # headroom to max_msg: a mismatched worker's oversized frame
+            # (still <= max_msg or the transport closes its connection)
+            # pops cleanly and is judged by the header, never a fatal -1
+            self._grad_buf = np.empty(max_msg, np.uint8)
+        elif self.wire:
             self._grad_buf = np.empty(self.wire.wire_bytes, np.uint8)
         else:
             self._grad_buf = np.empty(_flat_size(template), np.float32)
@@ -150,6 +171,7 @@ class TcpPSServer(PSServerTelemetry):
         self.grads_received = 0
         self.bytes_received = 0
         self.last_seen: Dict[int, float] = {}
+        self._ever_connected: set = set()
         self._t0 = time.time()
         self._metrics_http: Optional[MetricsHTTPServer] = None
 
@@ -175,10 +197,59 @@ class TcpPSServer(PSServerTelemetry):
             raise RuntimeError("tps_server_publish failed")
         self._lib.tps_server_pump(self._h)  # serve waiting readers promptly
 
+    def _decode_payload(self, payload: np.ndarray) -> PyTree:
+        """Payload bytes (a view into the receive buffer) → gradient
+        tree; shared by the framed and legacy poll paths."""
+        if self.wire:
+            # zero-copy: decode reads the receive buffer via memoryview
+            return self.wire.decode_from_bytes(payload)
+        flat = np.frombuffer(payload, np.float32).copy()
+        return _unflatten(flat, self.template)
+
+    def _note_connections(self) -> None:
+        """Latch first-connect times: a worker's liveness clock starts
+        when it first connects, not at server start — so ``stragglers``
+        can tell a worker that died mid-run (ages from its last sign of
+        life) from one that NEVER showed up (reported immediately)."""
+        now = time.time()
+        for w in range(self.num_workers):
+            if w in self._ever_connected:
+                continue
+            if self._lib.tps_server_connected(self._h, w):
+                self._ever_connected.add(w)
+                self.last_seen.setdefault(w, now)
+
+    def _poll_grad_framed(self) -> Optional[Tuple[int, int, PyTree]]:
+        """Frame-checking poll — the shared ``frames.framed_poll`` loop
+        (validate → reject-and-count → bounded staleness → decode, the
+        fix for one misconfigured worker's size-mismatched frame killing
+        the PS with a RuntimeError) over this transport's queue pop."""
+        worker = ctypes.c_uint32()
+        version = ctypes.c_uint64()
+        self._lib.tps_server_pump(self._h)
+
+        def pop_once():
+            n = self._lib.tps_server_pop_grad(
+                self._h, _u8(self._grad_buf.view(np.uint8)),
+                self._grad_buf.nbytes,
+                ctypes.byref(worker), ctypes.byref(version),
+            )
+            if n < 0:  # unreachable: the buffer is sized to max_msg
+                raise RuntimeError("tps_server_pop_grad: payload exceeds "
+                                   "the transport's own frame cap")
+            wid = int(worker.value)
+            if n > 0:
+                self._ever_connected.add(wid)
+            return int(n), wid, int(version.value)
+
+        return self._frames.framed_poll(self, pop_once)
+
     def poll_grad(self) -> Optional[Tuple[int, int, PyTree]]:
         """One pending gradient as (worker, version, grad_tree), or None.
         Pumps the sockets, then drains stale gradients iteratively (same
         bounded-staleness discipline as the shm server)."""
+        if self.frame:
+            return self._poll_grad_framed()
         worker = ctypes.c_uint32()
         version = ctypes.c_uint64()
         self._lib.tps_server_pump(self._h)
@@ -232,6 +303,7 @@ class TcpPSServer(PSServerTelemetry):
         exist right now? A crashed worker's connection closes (EOF/RST) —
         the positive failure signal shm can't give (SURVEY §5.3)."""
         self._lib.tps_server_pump(self._h)
+        self._note_connections()
         return bool(self._lib.tps_server_connected(self._h, worker))
 
     def stragglers(self, timeout: float) -> Dict[int, float]:
@@ -240,9 +312,14 @@ class TcpPSServer(PSServerTelemetry):
         no open connection claiming their id — so a live worker that is
         merely mid-way through one long jitted step is never flagged, and
         acting on this report (elastic replacement) only ever targets
-        dead sockets. The trade-off: a worker wedged WITH its socket open
-        is not reported; watch ``last_seen`` ages for that."""
+        dead sockets. A worker that NEVER connected has no liveness clock
+        to age (``last_seen`` is latched on first connect, not at server
+        start) and is reported immediately, whatever ``timeout`` — its
+        age is measured from server start. The trade-off: a worker wedged
+        WITH its socket open is not reported; watch ``last_seen`` ages
+        for that."""
         self._lib.tps_server_pump(self._h)
+        self._note_connections()
         now = time.time()
         out = {}
         for w in range(self.num_workers):
@@ -250,6 +327,10 @@ class TcpPSServer(PSServerTelemetry):
                 continue  # pushed, awaiting consumption: alive
             if self._lib.tps_server_connected(self._h, w) == 1:
                 continue  # open socket: alive (maybe slow), not lost
+            if w not in self._ever_connected and w not in self.last_seen:
+                # missing from the start: report NOW, no silence grace
+                out[w] = now - self._t0
+                continue
             age = now - self.last_seen.get(w, self._t0)
             if age > timeout:
                 out[w] = age
@@ -277,7 +358,7 @@ class TcpPSWorker:
 
     def __init__(self, host: str, port: int, worker_id: int, template: PyTree,
                  timeout: float = 30.0, code=None, seed: int = 0,
-                 bucket_mb: float = 0.0):
+                 bucket_mb: float = 0.0, frame: bool = False):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native tcpps unavailable (no g++?)")
@@ -304,6 +385,20 @@ class TcpPSWorker:
                       bucket_mb=bucket_mb)
             if code is not None else None
         )
+        # frame must match the server's (wire agreement); the fingerprint
+        # is computed from THIS side's config — drift fails the compare
+        self.frame = bool(frame)
+        self._tamper = None  # one-shot outgoing-bytes hook (fault injection)
+        if self.frame:
+            from pytorch_ps_mpi_tpu.resilience import frames as _frames
+
+            self._frames = _frames
+            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            payload_bytes = (self.wire.wire_bytes if self.wire
+                             else _flat_size(template) * 4)
+            self._frame_buf = np.empty(
+                _frames.HEADER_BYTES + payload_bytes, np.uint8
+            )
         self._param_buf = np.empty(_flat_size(template), np.float32)
 
     def read_params(self, timeout: float = 30.0) -> Tuple[PyTree, int]:
@@ -339,6 +434,14 @@ class TcpPSWorker:
             flat = self.wire.encode_to_bytes(grad)
         else:
             flat = _flatten(grad)
+        if self.frame:
+            flat = self._frames.seal_frame(self._frame_buf, flat,
+                                           self._fingerprint)
+        if self._tamper is not None:
+            # fault injection: corrupt the outgoing bytes AFTER sealing,
+            # so the CRC no longer matches what travels
+            t, self._tamper = self._tamper, None
+            t(flat.view(np.uint8))
         rc = self._lib.tps_worker_push_grad(
             self._h, _u8(flat.view(np.uint8)), flat.nbytes, version,
             int(timeout * 1000),

@@ -1,0 +1,71 @@
+"""Resilience layer: make every failure scenario injectable and survivable.
+
+The async PS protocol (AsySG-InCon, Lian et al. 2015) tolerates *stale*
+workers by design, but the stack — like the reference MPI job it
+reproduces — used to die on *failed* ones: a worker hitting a socket EOF
+or a timed-out ack raised and exited, a server restart stranded every
+worker, and a dead worker wedged ``sync_barrier`` rounds forever. This
+package closes that gap with four cooperating pieces:
+
+- :mod:`.faults` — a seeded, deterministic :class:`FaultInjector`. A
+  fault plan is a JSON-able list of ``{at_step, worker, kind}`` entries
+  (kinds: drop / delay / duplicate / corrupt / crash_worker /
+  crash_server) consulted by the worker loop and the serve loop, so every
+  chaos scenario is a reproducible test, not a flake: the same plan and
+  seed produce the same injected-event log, byte-for-byte.
+- :mod:`.frames` — self-verifying wire frames: a 20-byte header (magic,
+  payload length, CRC32, config fingerprint hashing codec name/kw +
+  bucket layout + template treedef) on every gradient push, so payload
+  corruption and codec/bucket config drift — documented as
+  "undetectable" by the flat-bucket wire — fail loudly as a counted,
+  per-worker rejection instead of a silent mis-decode or a PS crash.
+- :mod:`.worker` — :class:`ResilientWorker`, wrapping ``ShmPSWorker`` /
+  ``TcpPSWorker`` with exponential backoff + deterministic jitter on
+  timeouts and a full reconnect on EOF/transport errors, so a server
+  restart-from-checkpoint is survived transparently.
+- :mod:`.supervisor` — :class:`Supervisor`, the process that watches
+  ``server.stragglers()``/``connected()``, respawns dead workers via
+  ``spawn_worker``, and restarts a crashed server with ``resume=True``
+  from its checkpoint cadence, keeping the publish version monotonic.
+
+Every recovery event (retry, reconnect, respawn, rejected frame,
+degraded round, server restart) flows into the telemetry layer: flight-
+recorder events in the per-process JSONLs and counters on the PS
+``/metrics`` registry (``ps_frames_rejected_total``,
+``ps_worker_respawns_total``, ``ps_server_restarts_total``,
+``ps_worker_reconnects_total``, ``ps_degraded_rounds_total``).
+"""
+
+from pytorch_ps_mpi_tpu.resilience.faults import (
+    CRASH_EXIT_CODE,
+    FAULT_KINDS,
+    FaultInjector,
+    InjectedServerCrash,
+    load_fault_log,
+    normalize_plan,
+)
+from pytorch_ps_mpi_tpu.resilience.frames import (
+    FRAME_MAGIC,
+    HEADER_BYTES,
+    open_frame,
+    seal_frame,
+    wire_fingerprint,
+)
+from pytorch_ps_mpi_tpu.resilience.supervisor import Supervisor
+from pytorch_ps_mpi_tpu.resilience.worker import ResilientWorker
+
+__all__ = [
+    "CRASH_EXIT_CODE",
+    "FAULT_KINDS",
+    "FaultInjector",
+    "InjectedServerCrash",
+    "load_fault_log",
+    "normalize_plan",
+    "FRAME_MAGIC",
+    "HEADER_BYTES",
+    "open_frame",
+    "seal_frame",
+    "wire_fingerprint",
+    "Supervisor",
+    "ResilientWorker",
+]

@@ -1,0 +1,204 @@
+"""Seeded, deterministic fault injection for the async PS stack.
+
+A **fault plan** is a JSON-able list of entries::
+
+    {"at_step": 5, "worker": 1, "kind": "crash_worker"}
+    {"at_step": 3, "worker": 0, "kind": "corrupt"}
+    {"at_step": 20, "worker": "server", "kind": "crash_server"}
+
+- ``worker`` is a worker id (matched against the worker loop's local
+  step counter) or ``"server"`` (matched against the serve loop's
+  global applied-gradient count, so a resumed server never re-fires
+  faults behind its restored ``applied_total``).
+- ``kind`` is one of :data:`FAULT_KINDS`:
+
+  ===============  ========================================================
+  ``drop``         the worker computes but skips the push (one lost grad)
+  ``delay``        sleep ``delay_ms`` (default 100) before the push
+  ``duplicate``    push the same gradient twice with the same version tag
+  ``corrupt``      XOR-flip ``corrupt_bytes`` (default 8) payload bytes —
+                   deterministic positions from (seed, fault id); detected
+                   and rejected when frame checking is on
+  ``crash_worker`` ``os._exit`` mid-step (skips every ``finally:`` — the
+                   closest a test can get to SIGKILL from inside)
+  ``crash_server`` raise :class:`InjectedServerCrash` out of the serve
+                   loop after the matching applied update
+  ===============  ========================================================
+
+Determinism is the contract: the plan is explicit (no sampled fault
+times), the only randomness — corrupt byte positions — derives from
+``(seed, fault id)``, and every fired fault appends one stable event row
+``{id, kind, worker, at_step}`` to :attr:`FaultInjector.events` (plus a
+JSONL fault log when ``cfg["fault_log_dir"]`` is set, written *before*
+a crash kind takes the process down). Two runs with the same plan and
+seed therefore produce identical injected-event logs — the property
+``tests/test_resilience.py`` and ``tools/chaos_smoke.py`` assert. The
+log files APPEND (a respawned worker must extend its generation-0 rows,
+not clobber them), so one RUN is delimited by a fresh ``fault_log_dir``
+— use a new directory per run, or clear ``faults-*.jsonl`` at run start
+the way ``examples/train_async.py`` does.
+
+Crash faults and respawns: a respawned worker restarts its step counter
+at 0 and would re-fire its own crash fault forever. The supervisor marks
+fired crash faults in ``cfg["fault_fired"]`` (a list of fault ids) when
+it respawns/restarts, and :meth:`FaultInjector.from_cfg` excludes them —
+non-crash faults intentionally re-fire on replay so both runs of a
+deterministic pair see the same sequence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+FAULT_KINDS = ("drop", "delay", "duplicate", "corrupt", "crash_worker",
+               "crash_server")
+
+#: Exit code of an injected worker crash (``os._exit``) — distinguishable
+#: from a clean exit (0) and from real crashes in logs, treated like any
+#: other death by the supervisor.
+CRASH_EXIT_CODE = 97
+
+
+class InjectedServerCrash(RuntimeError):
+    """Raised out of the serve loop by a ``crash_server`` fault; carries
+    the fault entry so a supervisor can mark it fired before restarting
+    the server from its checkpoint."""
+
+    def __init__(self, fault: Dict[str, Any]):
+        super().__init__(
+            f"injected server crash (fault id={fault['id']} "
+            f"at applied={fault['at_step']})"
+        )
+        self.fault = fault
+
+
+def normalize_plan(plan: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Validate and normalize a fault plan: assigns each entry a stable
+    ``id`` (its index) used for fired-marking and corrupt-RNG seeding."""
+    out = []
+    for i, f in enumerate(plan):
+        kind = f.get("kind")
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"fault {i}: unknown kind {kind!r} "
+                             f"(one of {FAULT_KINDS})")
+        worker = f.get("worker", "server" if kind == "crash_server" else None)
+        if worker is None:
+            raise ValueError(f"fault {i}: missing worker")
+        if kind == "crash_server" and worker != "server":
+            raise ValueError(f"fault {i}: crash_server must target 'server'")
+        entry = dict(f)
+        entry["id"] = int(f.get("id", i))
+        entry["at_step"] = int(f["at_step"])
+        entry["worker"] = worker if worker == "server" else int(worker)
+        entry["kind"] = kind
+        out.append(entry)
+    if len({f["id"] for f in out}) != len(out):
+        raise ValueError("fault plan ids must be unique")
+    return out
+
+
+class FaultInjector:
+    """Consults a normalized fault plan for one role (a worker id or
+    ``"server"``), fires matching faults, and logs every injection."""
+
+    def __init__(self, plan: Sequence[Dict[str, Any]], seed: int = 0,
+                 role: Union[int, str] = "server",
+                 fired: Iterable[int] = (),
+                 log_path: Optional[str] = None):
+        self.plan = normalize_plan(plan)
+        self.seed = int(seed)
+        self.role = role
+        self.fired = set(int(i) for i in fired)
+        self.log_path = log_path
+        self.events: List[Dict[str, Any]] = []
+        self._mine = [f for f in self.plan if f["worker"] == role]
+
+    @classmethod
+    def from_cfg(cls, cfg: Dict[str, Any],
+                 role: Union[int, str] = "server") -> Optional["FaultInjector"]:
+        """Build from the shared job config (``fault_plan``,
+        ``fault_seed``, ``fault_fired``, ``fault_log_dir`` keys) — the
+        same dict that rides every worker spawn's argv, so one plan arms
+        the whole fleet. Returns None when no plan is configured."""
+        plan = cfg.get("fault_plan")
+        if not plan:
+            return None
+        log_path = None
+        if cfg.get("fault_log_dir"):
+            os.makedirs(cfg["fault_log_dir"], exist_ok=True)
+            log_path = os.path.join(cfg["fault_log_dir"],
+                                    f"faults-{role}.jsonl")
+        return cls(plan, seed=int(cfg.get("fault_seed", 0)), role=role,
+                   fired=cfg.get("fault_fired") or (), log_path=log_path)
+
+    def faults_at(self, step: int) -> List[Dict[str, Any]]:
+        """Unfired faults for this role scheduled exactly at ``step``."""
+        return [f for f in self._mine
+                if f["at_step"] == step and f["id"] not in self.fired]
+
+    def faults_between(self, lo: int, hi: int) -> List[Dict[str, Any]]:
+        """Unfired faults with ``lo < at_step <= hi`` — the serve loop's
+        form, where a sync-barrier round advances the applied count by
+        several at once."""
+        return [f for f in self._mine
+                if lo < f["at_step"] <= hi and f["id"] not in self.fired]
+
+    def fire(self, fault: Dict[str, Any]) -> Dict[str, Any]:
+        """Mark ``fault`` fired and log it. The event row carries only
+        deterministic fields (id/kind/worker/at_step) so event logs can
+        be compared across runs; it is appended to the in-memory list,
+        the fault log file (flushed immediately — crash kinds never get
+        a second chance), and the flight recorder when armed."""
+        self.fired.add(fault["id"])
+        event = {"id": fault["id"], "kind": fault["kind"],
+                 "worker": fault["worker"], "at_step": fault["at_step"]}
+        self.events.append(event)
+        if self.log_path:
+            with open(self.log_path, "a") as f:
+                f.write(json.dumps(event, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        from pytorch_ps_mpi_tpu import telemetry
+
+        telemetry.record_event("fault.injected", **event)
+        return event
+
+    def corrupt(self, fault: Dict[str, Any], buf: np.ndarray) -> None:
+        """XOR-flip ``corrupt_bytes`` positions of ``buf`` in place.
+        Positions derive from (seed, fault id) only — the same fault
+        corrupts the same offsets in every run."""
+        rng = np.random.RandomState(
+            (self.seed * 1_000_003 + 7919 * (fault["id"] + 1)) % 2**32
+        )
+        n = max(1, int(fault.get("corrupt_bytes", 8)))
+        idx = rng.randint(0, buf.nbytes, size=n)
+        buf[idx] ^= 0xFF
+
+    def make_tamper(self, fault: Dict[str, Any]):
+        """One-shot outgoing-frame tamper hook for the transport workers'
+        ``_tamper`` slot: fires the fault and corrupts the wire bytes of
+        the next push."""
+
+        def tamper(buf: np.ndarray) -> None:
+            self.fire(fault)
+            self.corrupt(fault, buf)
+
+        return tamper
+
+
+def load_fault_log(path: str) -> List[Dict[str, Any]]:
+    """Read one fault-log JSONL back as a list of event rows (missing
+    file = no faults fired by that role = empty list)."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

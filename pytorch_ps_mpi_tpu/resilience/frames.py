@@ -1,0 +1,190 @@
+"""Self-verifying wire frames for the async PS gradient push path.
+
+The PR 2 flat-bucket wire documented a hole: the one-time wire agreement
+is enforced only through a total-byte-count check, so a codec/bucket
+config mismatch that happens to preserve the byte count (identity codec
+over a mixed-dtype tree, same-size codec-kw drift) silently mis-decodes,
+and a size mismatch killed the PS with a ``RuntimeError`` from
+``poll_grad``. This module closes both holes with a 20-byte header
+prepended to every gradient push when frame checking is enabled
+(``frame=True`` on the servers/workers, ``cfg["frame_check"]`` on the
+async fleet):
+
+``magic u32 | payload_len u32 | crc32 u32 | fingerprint u64``
+
+- **magic** rejects garbage and framing drift (a peer without frames);
+- **payload_len** rejects truncation inside an otherwise valid slot;
+- **crc32** (of the payload bytes) rejects corruption — the chaos
+  injector's ``corrupt`` fault and any real bit-rot on the path;
+- **fingerprint** is an 8-byte BLAKE2b digest of the *wire
+  configuration*: codec class name + constructor-visible kwargs, the
+  per-unit wire layout (bucket shapes/dtypes — so ``bucket_mb`` drift is
+  caught even at equal byte counts), the flat payload specs, and the
+  template treedef. Worker and server compute it independently from
+  their own config; any drift — even byte-count-preserving — fails the
+  compare.
+
+A failed check is a **counted, per-worker rejection**
+(``PSServerTelemetry._reject_frame`` → ``ps_frames_rejected_total``),
+never a server crash: one misconfigured worker cannot take down the PS
+serving everyone else.
+
+The params path (server → worker snapshot reads) is not framed: a
+corrupted snapshot produces a bad gradient whose *push* the server then
+judges; config drift is symmetric so the push-side fingerprint already
+catches it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import time
+import zlib
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+#: Header magic ("PSF1" little-endian). Distinct from the TCP transport's
+#: outer 'TPS1' op-frame magic — this header travels INSIDE the payload of
+#: a transport frame / shm mailbox slot.
+FRAME_MAGIC = 0x31465350
+
+_HEADER = struct.Struct("<IIIQ")  # magic, payload_len, crc32, fingerprint
+HEADER_BYTES = _HEADER.size
+assert HEADER_BYTES == 20
+
+
+def _codec_desc(code) -> dict:
+    """Canonical JSON-able description of a codec's configuration: class
+    name + every public primitive-valued attribute (the constructor
+    kwargs land there). Jitted closures / arrays / PRNG state are
+    excluded — they are derived, not configuration."""
+    kw = {}
+    for k, v in vars(code).items():
+        if k.startswith("_"):
+            continue
+        if isinstance(v, (bool, int, float, str, type(None))):
+            kw[k] = v
+        elif isinstance(v, (tuple, list)) and all(
+                isinstance(x, (bool, int, float, str)) for x in v):
+            kw[k] = list(v)
+    return {"cls": type(code).__name__, "kw": kw}
+
+
+def wire_fingerprint(wire, template: PyTree) -> int:
+    """64-bit fingerprint of the wire agreement. ``wire`` is a
+    ``CodecWire`` (or None for the raw-f32 wire); ``template`` the
+    parameter pytree. Both ends compute this from their OWN config — a
+    matching fingerprint means codec name/kw, bucket layout, payload
+    specs, and tree structure all agree. Per-worker codec seeds do not
+    enter (they legitimately differ across the fleet)."""
+    import jax
+
+    if wire is None:
+        leaves, treedef = jax.tree.flatten(template)
+        desc = {
+            "codec": None,
+            "units": [[list(np.shape(l)), "float32"] for l in leaves],
+            "treedef": str(treedef),
+        }
+    else:
+        desc = {
+            "codec": _codec_desc(wire.code),
+            # unit layout: bucket sizes/dtypes when bucketing, per-leaf
+            # shapes otherwise — catches bucket_mb drift at equal bytes
+            "units": [[list(s), str(np.dtype(d))]
+                      for s, d in zip(wire.shapes, wire.dtypes)],
+            "specs": [[list(s), str(np.dtype(d))]
+                      for s, d in wire._flat_specs],
+            "treedef": str(wire.treedef),
+        }
+    blob = json.dumps(desc, sort_keys=True).encode()
+    return int.from_bytes(
+        hashlib.blake2b(blob, digest_size=8).digest(), "little"
+    )
+
+
+def seal_frame(out: np.ndarray, payload: np.ndarray,
+               fingerprint: int) -> np.ndarray:
+    """Write header + payload into the preallocated uint8 buffer ``out``
+    (sized ``HEADER_BYTES + payload.nbytes`` by the caller) and return
+    the exact-length view. One extra memcpy per push versus the unframed
+    wire — the price of the end-to-end check."""
+    if payload.dtype != np.uint8:
+        payload = payload.view(np.uint8)
+    payload = payload.reshape(-1)
+    n = payload.nbytes
+    _HEADER.pack_into(out, 0, FRAME_MAGIC, n,
+                      zlib.crc32(payload) & 0xFFFFFFFF, fingerprint)
+    out[HEADER_BYTES:HEADER_BYTES + n] = payload
+    return out[:HEADER_BYTES + n]
+
+
+def open_frame(
+    buf: np.ndarray,
+    fingerprint: int,
+    expected_payload: Optional[int] = None,
+) -> Tuple[Optional[np.ndarray], Optional[str]]:
+    """Validate a received frame. Returns ``(payload_view, None)`` on
+    success or ``(None, reason)`` where reason is one of ``"short"``
+    (no room for a header), ``"magic"``, ``"size"`` (declared/expected
+    length mismatch — the misconfigured-worker case), ``"config"``
+    (fingerprint drift), ``"corrupt"`` (CRC failure). The payload is a
+    zero-copy view into ``buf``."""
+    if buf.nbytes < HEADER_BYTES:
+        return None, "short"
+    magic, plen, crc, fp = _HEADER.unpack_from(buf)
+    if magic != FRAME_MAGIC:
+        return None, "magic"
+    if plen != buf.nbytes - HEADER_BYTES or (
+            expected_payload is not None and plen != expected_payload):
+        return None, "size"
+    if fp != fingerprint:
+        return None, "config"
+    payload = buf[HEADER_BYTES:]
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        return None, "corrupt"
+    return payload, None
+
+
+def framed_poll(
+    server, pop_once: Callable[[], Tuple[int, int, int]]
+) -> Optional[Tuple[int, int, PyTree]]:
+    """The ONE frame-checking poll loop both PS transports share (the
+    transports differ only in how a frame is popped — ``pop_once``
+    returns ``(nbytes, worker, version)`` with ``nbytes <= 0`` meaning
+    nothing pending, the frame bytes landing in ``server._grad_buf``).
+
+    Every popped frame is validated (magic, size, fingerprint, CRC)
+    BEFORE any gradient bookkeeping; a bad frame is a counted per-worker
+    rejection (``server._reject_frame``) and polling continues — one
+    corrupting or misconfigured worker can never kill the PS serving
+    everyone else. Valid frames then get the standard bounded-staleness
+    treatment (count, drop-if-over, decode via
+    ``server._decode_payload``)."""
+    while True:
+        n, wid, version = pop_once()
+        if n <= 0:
+            return None
+        # any frame — valid or not — proves the worker is alive
+        server.last_seen[wid] = time.time()
+        payload, err = open_frame(
+            server._grad_buf[:n], server._fingerprint,
+            server._expected_payload,
+        )
+        if err is not None:
+            server._reject_frame(wid, err)
+            continue
+        staleness = max(0, server.version - version)
+        server.staleness_seen[staleness] = (
+            server.staleness_seen.get(staleness, 0) + 1
+        )
+        server.grads_received += 1
+        server.bytes_received += payload.nbytes
+        if staleness <= server.max_staleness:
+            return wid, version, server._decode_payload(payload)
+        server.stale_drops += 1

@@ -1,0 +1,415 @@
+"""Supervisor: keep an async PS job alive through worker AND server death.
+
+The elastic-recovery pieces already existed as manual moves documented in
+the ops runbook — watch ``stragglers()``/``connected()``, respawn a dead
+worker with the same id (``reset_worker_slot`` first on shm), restart a
+dead server with ``resume=True`` — but nothing *performed* them. The
+:class:`Supervisor` is that missing process-level loop:
+
+- it owns the server lifecycle: builds the server from the job ``cfg``
+  (shm or TCP — the TCP port is pinned after the first bind so workers
+  can always re-reach the same address), runs :func:`serve`, and on a
+  server crash (:class:`InjectedServerCrash` from the fault injector, or
+  any crash of the serve loop itself) restarts it with ``resume=True``
+  from the checkpoint cadence — the publish version stays monotonic by
+  the existing crash-window jump;
+- it watches the worker fleet from *inside* the serve loop (the
+  ``on_tick`` hook — no second thread ever touches the native transport
+  handles): a worker process that exited nonzero is respawned via
+  ``spawn_worker`` with the same id (after ``reset_worker_slot`` on shm
+  and after marking its crash fault fired so a deterministic fault plan
+  cannot crash-loop the replacement);
+- it stops when every worker has exited cleanly and the gradient queue
+  has drained (``stop_when``), so drop/duplicate/corrupt faults — which
+  make exact push counts unknowable — can never hang the job the way a
+  fixed ``total_received`` would.
+
+Fleet-level recovery counters are mirrored into the server's scrape
+registry (they survive into ``/metrics`` text):
+``ps_worker_respawns_total``, ``ps_server_restarts_total``,
+``ps_worker_reconnects_total`` (workers seen pushing again after a
+server restart — the client-side backoff/reconnect story observed from
+the server side).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from pytorch_ps_mpi_tpu import telemetry
+from pytorch_ps_mpi_tpu.resilience.faults import (
+    FaultInjector,
+    InjectedServerCrash,
+)
+
+PyTree = Any
+
+
+class _WorkerRec:
+    __slots__ = ("wid", "proc", "spawned_at", "respawns", "done",
+                 "abandoned")
+
+    def __init__(self, wid: int, proc, now: float):
+        self.wid = wid
+        self.proc = proc
+        self.spawned_at = now
+        self.respawns = 0
+        self.done = False
+        self.abandoned = False
+
+
+class Supervisor:
+    """Run one supervised async-PS job to completion.
+
+    ``cfg`` is the shared job config (`make_problem` keys + transport /
+    codec / resilience / fault keys). The supervisor copies it and
+    maintains the ``fault_fired`` list across respawns/restarts.
+    """
+
+    def __init__(self, cfg: Dict[str, Any], n_workers: int, *,
+                 shm_name: Optional[str] = None, port: int = 0,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 10,
+                 sync_barrier: bool = False,
+                 timeout: float = 300.0,
+                 max_worker_respawns: int = 3,
+                 max_server_restarts: int = 3,
+                 straggler_timeout: float = 5.0):
+        import os
+
+        self.cfg = dict(cfg)
+        self.cfg.setdefault("fault_fired", [])
+        if self.cfg.get("resilient"):
+            # resilient workers need SHORT op timeouts: a failover is
+            # only detected when a push times out, and the retry loop —
+            # not one long blocking call — supplies the patience
+            self.cfg.setdefault("push_timeout", 10.0)
+        self.n_workers = int(n_workers)
+        self.transport = self.cfg.get("transport", "shm")
+        self.shm_name = shm_name or f"/psq_sup_{os.getpid()}"
+        self._port = int(port)  # pinned to the first bind once serving
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = int(checkpoint_every)
+        self.sync_barrier = bool(sync_barrier)
+        self.timeout = float(timeout)
+        self.max_worker_respawns = int(max_worker_respawns)
+        self.max_server_restarts = int(max_server_restarts)
+        self.straggler_timeout = float(straggler_timeout)
+
+        self.worker_respawns = 0
+        self.server_restarts = 0
+        self.worker_reconnects = 0
+        self.phase_versions: List[int] = []
+        self.final_prometheus_text: Optional[str] = None
+        self._recs: Dict[int, _WorkerRec] = {}
+        # after a server restart, each worker owes one observed reconnect
+        self._reconnect_credit: set = set()
+        # counters accumulated across server generations (a replacement
+        # server starts at zero; the run's totals must not)
+        self._frames_rejected_accum: Dict[int, int] = {}
+        self._frames_rejected_accum_total = 0
+        self._grads_received_accum = 0
+        # recovery-time measurement (tick-granularity, ~0.2 s):
+        # respawn = worker death handled → replacement's first consumed
+        # frame; restart = server crash → replacement's first consumed
+        # frame. The numbers RESULTS.md quotes from the chaos smoke.
+        self.recovery_times: Dict[str, List[float]] = {
+            "worker_respawn_s": [], "server_restart_s": [],
+        }
+        self._respawn_watch: Dict[int, float] = {}
+        self._restart_watch: Optional[float] = None
+
+    # -- server lifecycle -------------------------------------------------
+    def _make_codec(self):
+        if not self.cfg.get("codec"):
+            return None
+        from pytorch_ps_mpi_tpu.codecs import get_codec
+
+        return get_codec(self.cfg["codec"], **self.cfg.get("codec_kw", {}))
+
+    def _make_server(self, template: PyTree):
+        kw = dict(
+            num_workers=self.n_workers, template=template,
+            max_staleness=int(self.cfg.get("max_staleness", 4)),
+            code=self._make_codec(),
+            bucket_mb=float(self.cfg.get("bucket_mb", 0.0)),
+            frame=bool(self.cfg.get("frame_check")),
+        )
+        if self.transport == "tcp":
+            from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSServer
+
+            server = TcpPSServer(self._port, **kw)
+            self._port = server.port  # pin: replacements bind the same port
+        else:
+            from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSServer
+
+            server = ShmPSServer(self.shm_name, **kw)
+        reg = server.scrape_registry()
+        reg.add_collector(
+            lambda r, s=server: self._collect_recovery_metrics(r, s))
+        return server
+
+    def _collect_recovery_metrics(self, reg, server) -> None:
+        reg.counter("ps_worker_respawns_total",
+                    "dead worker processes respawned by the supervisor"
+                    ).set(float(self.worker_respawns))
+        reg.counter("ps_server_restarts_total",
+                    "server crashes recovered by restart-from-checkpoint"
+                    ).set(float(self.server_restarts))
+        reg.counter("ps_worker_reconnects_total",
+                    "workers observed pushing again after a server restart"
+                    ).set(float(self.worker_reconnects))
+        # registered AFTER the server's own collector, so these run
+        # totals (prior server generations + the live one) win the
+        # scrape. Per-worker labeled series only — an unlabeled sibling
+        # under the same name would double PromQL sum() aggregations.
+        rej_help = ("self-verifying frames rejected "
+                    "(corruption / config drift / size mismatch)")
+        live = getattr(server, "frames_rejected", {})
+        for w in range(self.n_workers):
+            total = (self._frames_rejected_accum.get(w, 0)
+                     + int(live.get(w, 0)))
+            reg.counter("ps_frames_rejected_total", rej_help,
+                        labels={"worker": str(w)}).set(float(total))
+
+    def _absorb_server_counts(self, server) -> None:
+        """Fold a retiring server generation's counters into the run
+        totals (called just before every ``server.close()``)."""
+        for w, n in getattr(server, "frames_rejected", {}).items():
+            self._frames_rejected_accum[w] = (
+                self._frames_rejected_accum.get(w, 0) + int(n))
+        self._frames_rejected_accum_total += int(
+            getattr(server, "frames_rejected_total", 0))
+        self._grads_received_accum += int(server.grads_received)
+
+    def addr(self) -> str:
+        """The address workers connect to — stable across restarts."""
+        if self.transport == "tcp":
+            return f"127.0.0.1:{self._port}"
+        return self.shm_name
+
+    # -- worker lifecycle -------------------------------------------------
+    def _worker_cfg(self) -> Dict[str, Any]:
+        cfg = dict(self.cfg)
+        cfg["fault_fired"] = sorted(self.cfg["fault_fired"])
+        return cfg
+
+    def _spawn(self, wid: int) -> None:
+        from pytorch_ps_mpi_tpu.parallel.async_train import spawn_worker
+
+        proc = spawn_worker(self.addr(), wid, self._worker_cfg())
+        now = time.time()
+        if wid in self._recs:
+            rec = self._recs[wid]
+            rec.proc = proc
+            rec.spawned_at = now
+        else:
+            self._recs[wid] = _WorkerRec(wid, proc, now)
+
+    def _mark_crash_fault_fired(self, wid: int) -> None:
+        """A respawned worker restarts at step 0: mark its earliest
+        unfired crash fault fired so the deterministic plan cannot
+        crash-loop the replacement."""
+        fired = set(self.cfg["fault_fired"])
+        crashes = sorted(
+            (f for f in self.cfg.get("fault_plan", ())
+             if f.get("kind") == "crash_worker"
+             and int(f.get("worker", -1)) == wid),
+            key=lambda f: int(f["at_step"]),
+        )
+        for i, f in enumerate(crashes):
+            fid = int(f.get("id", self.cfg["fault_plan"].index(f)))
+            if fid not in fired:
+                fired.add(fid)
+                break
+        self.cfg["fault_fired"] = sorted(fired)
+
+    def _tick(self, server) -> None:
+        """Called from inside the serve loop (same thread as the native
+        transport — never racing a pump): respawn dead workers, observe
+        post-restart reconnects."""
+        for rec in self._recs.values():
+            if rec.done or rec.abandoned:
+                continue
+            rc = rec.proc.poll()
+            if rc is None:
+                continue
+            if rc == 0:
+                rec.done = True
+                self._reconnect_credit.discard(rec.wid)
+                continue
+            if rec.respawns >= self.max_worker_respawns:
+                rec.abandoned = True
+                telemetry.record_event("supervisor.worker_abandoned",
+                                       worker=rec.wid, exit_code=rc)
+                continue
+            self._mark_crash_fault_fired(rec.wid)
+            if hasattr(server, "reset_worker_slot"):
+                # shm: a worker killed inside its mailbox-write window
+                # leaves the slot wedged; clear it for the replacement
+                try:
+                    server.reset_worker_slot(rec.wid)
+                except Exception:
+                    pass  # slot already clean / segment replaced
+            rec.respawns += 1
+            self.worker_respawns += 1
+            self._reconnect_credit.discard(rec.wid)
+            telemetry.record_event("supervisor.worker_respawn",
+                                   worker=rec.wid, exit_code=rc,
+                                   respawns=rec.respawns)
+            self._spawn(rec.wid)
+            self._respawn_watch[rec.wid] = time.time()
+        for wid, t0 in list(self._respawn_watch.items()):
+            seen = server.last_seen.get(wid, 0.0)
+            if seen > t0:  # the replacement's first frame landed
+                self.recovery_times["worker_respawn_s"].append(seen - t0)
+                del self._respawn_watch[wid]
+        if self._restart_watch is not None and server.grads_received > 0:
+            self.recovery_times["server_restart_s"].append(
+                time.time() - self._restart_watch)
+            self._restart_watch = None
+        if self._reconnect_credit:
+            # a worker is "reconnected" once the restarted server has
+            # consumed something from it (transport-agnostic signal)
+            for wid in sorted(self._reconnect_credit):
+                if wid in server.last_seen:
+                    self._reconnect_credit.discard(wid)
+                    self.worker_reconnects += 1
+                    telemetry.record_event("supervisor.worker_reconnected",
+                                           worker=wid)
+
+    def _workers_done(self) -> bool:
+        return all(r.done or r.abandoned for r in self._recs.values())
+
+    # -- the supervised run ----------------------------------------------
+    def run(self) -> Tuple[PyTree, Dict[str, Any]]:
+        """Serve (and re-serve, across server crashes) until every worker
+        finished; returns ``(params, metrics)`` where metrics is the last
+        serve phase's dict plus the fleet-recovery totals."""
+        import jax
+
+        from pytorch_ps_mpi_tpu.parallel.async_train import (
+            join_workers,
+            make_problem,
+            serve,
+        )
+
+        _, template, batch_fn, loss_fn = make_problem(self.cfg)
+        deadline = time.time() + self.timeout
+        resume = bool(self.cfg.get("resume"))
+        # the RUN's initial loss: a server crash destroys phase 1's
+        # metrics dict, so the end-to-end "training improved" claim needs
+        # its own anchor (same held-out eval batch as serve's)
+        run_loss_initial = None
+        if not (resume and self._ckpt_exists()):
+            run_loss_initial = float(
+                jax.jit(loss_fn)(template, batch_fn(10**6, 10**6)))
+        params, metrics = None, {}
+        phases = 0
+        try:
+            while True:
+                server = self._make_server(template)
+                if not self._recs:  # first phase: launch the fleet
+                    for wid in range(self.n_workers):
+                        self._spawn(wid)
+                try:
+                    do_resume = resume and self._ckpt_exists()
+                    params, metrics = serve(
+                        server, self.cfg, total_grads=10**18,
+                        sync_barrier=self.sync_barrier,
+                        timeout=max(1.0, deadline - time.time()),
+                        checkpoint_dir=self.checkpoint_dir,
+                        checkpoint_every=self.checkpoint_every,
+                        resume=do_resume,
+                        on_tick=lambda: self._tick(server),
+                        stop_when=self._workers_done,
+                    )
+                    phases += 1
+                    self.phase_versions.append(int(server.version))
+                    self.final_prometheus_text = server.prometheus_text()
+                    break
+                except (InjectedServerCrash, RuntimeError, OSError) as e:
+                    # a server crash — injected (the fault kind) or real
+                    # (native transport failure, checkpoint I/O error).
+                    # Same recovery either way: restart from the cadence
+                    # snapshot. Only injected crashes are fired-marked.
+                    phases += 1
+                    self.phase_versions.append(int(server.version))
+                    fault_id = None
+                    if isinstance(e, InjectedServerCrash):
+                        fault_id = e.fault["id"]
+                        fired = set(self.cfg["fault_fired"])
+                        fired.add(fault_id)
+                        self.cfg["fault_fired"] = sorted(fired)
+                    self.server_restarts += 1
+                    self._reconnect_credit = {
+                        r.wid for r in self._recs.values()
+                        if not (r.done or r.abandoned)
+                    }
+                    self._restart_watch = time.time()
+                    resume = True
+                    telemetry.record_event("supervisor.server_restart",
+                                           fault_id=fault_id,
+                                           error=str(e),
+                                           restarts=self.server_restarts)
+                    if self.server_restarts > self.max_server_restarts:
+                        raise
+                    if not self.checkpoint_dir:
+                        raise RuntimeError(
+                            "server crashed but no checkpoint_dir was "
+                            "configured — cannot restart-from-checkpoint"
+                        ) from e
+                finally:
+                    self._absorb_server_counts(server)
+                    server.close()
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "supervised run exceeded its deadline")
+        except BaseException:
+            # never leak the fleet on a failed run: terminate and reap
+            # every worker before propagating (the success path joins
+            # with the full remaining budget below)
+            join_workers([r.proc for r in self._recs.values()],
+                         timeout=5.0)
+            raise
+
+        exit_codes = join_workers(
+            [r.proc for r in self._recs.values()],
+            timeout=max(1.0, deadline - time.time()),
+        )
+        metrics = dict(metrics)
+        metrics.update(
+            worker_respawns=float(self.worker_respawns),
+            server_restarts=float(self.server_restarts),
+            worker_reconnects=float(self.worker_reconnects),
+            workers_abandoned=float(
+                sum(1 for r in self._recs.values() if r.abandoned)),
+            supervised_phases=float(phases),
+            worker_exit_codes=exit_codes,
+            versions_monotonic=all(
+                b > a for a, b in zip(self.phase_versions,
+                                      self.phase_versions[1:])
+            ),
+            # run totals across every server generation (a replacement
+            # server's own counters start at zero)
+            frames_rejected=float(self._frames_rejected_accum_total),
+            frames_rejected_by_worker=dict(self._frames_rejected_accum),
+            grads_received_all_phases=float(self._grads_received_accum),
+            recovery_times={k: [round(v, 3) for v in vs]
+                            for k, vs in self.recovery_times.items()},
+        )
+        if run_loss_initial is not None:
+            metrics["run_loss_initial"] = run_loss_initial
+        return params, metrics
+
+    def _ckpt_exists(self) -> bool:
+        if not self.checkpoint_dir:
+            return False
+        from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
+
+        try:
+            return CheckpointManager(
+                self.checkpoint_dir).latest_step() is not None
+        except Exception:
+            return False

@@ -1,0 +1,186 @@
+"""ResilientWorker: retry/backoff + auto-reconnect around a PS worker.
+
+Today's failure mode: ``worker_main`` raises on the first
+``TimeoutError`` from ``read_params``/``push_grad`` and on any transport
+``RuntimeError`` (socket EOF, wedged shm mailbox), so a server restart
+kills every worker even though the replacement serves the same snapshot
+seconds later. This wrapper keeps the worker's surface
+(``read_params`` / ``push_grad`` / ``close``) while absorbing those
+failures:
+
+- **timeouts** → exponential backoff with deterministic jitter
+  (seeded per worker — two workers never thundering-herd in lockstep,
+  and a test replay sleeps the same schedule), then a reconnect after
+  ``reconnect_after`` consecutive timeouts. The shm orphan case needs
+  this: a restarted shm server *recreates* the segment, so a surviving
+  worker's pushes land in an orphaned mailbox and time out — the
+  reconnect re-opens the name and finds the live segment.
+- **transport errors** (``RuntimeError``/``OSError``/``ConnectionError``
+  — TCP EOF, protocol desync) → immediate reconnect via the factory,
+  which itself retries with backoff while the replacement server comes
+  up.
+
+At most one in-flight gradient is lost per failover (the push the old
+server acknowledged but never applied, or the one written into an
+orphaned mailbox) — exactly the loss the async protocol already
+tolerates from a stale drop.
+
+Counters (``retries``, ``reconnects``) are exposed for tests and pushed
+into the flight recorder as ``resilience.retry`` / ``resilience.reconnect``
+events, so worker JSONLs tell the recovery story per process; the
+supervisor mirrors fleet-level reconnects into ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Optional
+
+from pytorch_ps_mpi_tpu import telemetry
+
+PyTree = Any
+
+
+class ResilientWorker:
+    """Wrap a transport worker factory with retry, backoff and reconnect.
+
+    ``factory`` builds a fresh ``ShmPSWorker``/``TcpPSWorker`` (or
+    anything with the same surface); it may raise ``TimeoutError`` while
+    the server is down — construction itself is retried with backoff.
+    """
+
+    def __init__(self, factory: Callable[[], Any], worker_id: int = 0, *,
+                 max_retries: int = 12, backoff_base: float = 0.05,
+                 backoff_max: float = 2.0, jitter: float = 0.5,
+                 reconnect_after: int = 1, seed: int = 0):
+        if max_retries < 1:
+            raise ValueError(f"max_retries must be >= 1, got {max_retries}")
+        self._factory = factory
+        self.worker_id = worker_id
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.reconnect_after = int(reconnect_after)
+        # deterministic jitter stream: (seed, worker) → same backoff
+        # schedule on every replay of a chaos scenario
+        self._rng = random.Random((int(seed) << 16) ^ (worker_id + 1))
+        self.retries = 0
+        self.reconnects = 0
+        self._tamper = None
+        self._w: Optional[Any] = None
+        self._w = self._build(initial=True)
+
+    # -- plumbing ---------------------------------------------------------
+    @property
+    def inner(self):
+        """The current transport worker (changes across reconnects)."""
+        return self._w
+
+    @property
+    def wire(self):
+        return getattr(self._w, "wire", None)
+
+    def set_tamper(self, fn) -> None:
+        """One-shot outgoing-frame hook (fault injection); survives a
+        reconnect so a corrupt fault is never silently skipped by a
+        concurrent failover."""
+        self._tamper = fn
+        if self._w is not None:
+            self._w._tamper = fn
+
+    def _backoff(self, attempt: int) -> None:
+        d = min(self.backoff_max, self.backoff_base * (2.0 ** attempt))
+        d *= 1.0 + self.jitter * self._rng.random()
+        time.sleep(d)
+
+    def _build(self, initial: bool = False):
+        """Construct a transport worker, retrying while the server is
+        unreachable. Counts a reconnect (and emits the event) for every
+        non-initial rebuild."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries):
+            try:
+                w = self._factory()
+                if not initial:
+                    self.reconnects += 1
+                    telemetry.record_event(
+                        "resilience.reconnect", worker=self.worker_id,
+                        attempt=attempt, reconnects=self.reconnects,
+                    )
+                w._tamper = self._tamper
+                return w
+            except (TimeoutError, RuntimeError, OSError) as e:
+                last = e
+                self.retries += 1
+                telemetry.record_event(
+                    "resilience.retry", worker=self.worker_id,
+                    op="connect", attempt=attempt, error=str(e),
+                )
+                self._backoff(attempt)
+        raise TimeoutError(
+            f"worker {self.worker_id}: could not (re)connect after "
+            f"{self.max_retries} attempts"
+        ) from last
+
+    def _reconnect(self) -> None:
+        if self._w is not None:
+            try:
+                self._w.close()
+            except Exception:
+                pass  # a dead transport may fail its own teardown
+            self._w = None
+        self._w = self._build()
+
+    def _call(self, op: str, *args, **kw):
+        timeouts_in_a_row = 0
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries):
+            try:
+                return getattr(self._w, op)(*args, **kw)
+            except TimeoutError as e:
+                last = e
+                self.retries += 1
+                timeouts_in_a_row += 1
+                telemetry.record_event(
+                    "resilience.retry", worker=self.worker_id, op=op,
+                    attempt=attempt, error=str(e),
+                )
+                if timeouts_in_a_row >= self.reconnect_after:
+                    # repeated timeouts on a live handle smell like an
+                    # orphaned segment / dead peer: re-resolve the server
+                    self._reconnect()
+                    timeouts_in_a_row = 0
+                else:
+                    self._backoff(attempt)
+            except (RuntimeError, OSError, ConnectionError) as e:
+                # transport-level failure (EOF, reset, wedged slot):
+                # the handle is unusable, rebuild it
+                last = e
+                self.retries += 1
+                telemetry.record_event(
+                    "resilience.retry", worker=self.worker_id, op=op,
+                    attempt=attempt, error=str(e),
+                )
+                self._reconnect()
+        raise TimeoutError(
+            f"worker {self.worker_id}: {op} failed after "
+            f"{self.max_retries} attempts: {last}"
+        ) from last
+
+    # -- worker surface ---------------------------------------------------
+    def read_params(self, timeout: float = 30.0):
+        return self._call("read_params", timeout=timeout)
+
+    def push_grad(self, grad: PyTree, version: int,
+                  timeout: float = 30.0) -> None:
+        out = self._call("push_grad", grad, version, timeout=timeout)
+        # the transport consumed any one-shot tamper with the push
+        self._tamper = getattr(self._w, "_tamper", None)
+        return out
+
+    def close(self) -> None:
+        if self._w is not None:
+            self._w.close()
+            self._w = None

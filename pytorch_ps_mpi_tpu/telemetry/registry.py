@@ -171,11 +171,16 @@ class MetricsRegistry:
         self._lock = threading.Lock()
 
     def _get_or_create(self, cls, name: str, *args, **kw):
+        # labeled instruments are distinct series under one metric name
+        # (the Prometheus model: ``name{worker="1"}``); the registry key
+        # carries the label set so per-worker counters coexist with the
+        # unlabeled total
+        key = name + _labels_text(kw.get("labels"))
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
                 m = cls(name, *args, **kw)
-                self._metrics[name] = m
+                self._metrics[key] = m
             elif not isinstance(m, cls):
                 raise ValueError(
                     f"metric {name!r} already registered as {m.kind}"
@@ -214,22 +219,28 @@ class MetricsRegistry:
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
+            lt = _labels_text(m.labels)
             if isinstance(m, Histogram):
-                out[f"{m.name}_sum"] = m.sum
-                out[f"{m.name}_count"] = float(m.count)
+                out[f"{m.name}{lt}_sum"] = m.sum
+                out[f"{m.name}{lt}_count"] = float(m.count)
             else:
-                out[m.name] = m.value
+                out[f"{m.name}{lt}"] = m.value
         return out
 
     def prometheus_text(self) -> str:
         self.collect()
         lines: List[str] = []
         with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+            metrics = sorted(self._metrics.values(),
+                             key=lambda m: (m.name, _labels_text(m.labels)))
+        last_name = None
         for m in metrics:
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+            if m.name != last_name:  # HELP/TYPE once per metric name,
+                # however many labeled series it has
+                if m.help:
+                    lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# TYPE {m.name} {m.kind}")
+                last_name = m.name
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
 
@@ -254,6 +265,10 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     # (buckets when bucketing, leaves otherwise)
     "bucket_count",
     "wire_units_per_push",
+    # self-verifying wire frames (resilience.frames): pushes whose frame
+    # failed validation (corruption, config drift, size) — always 0 when
+    # frame checking is off
+    "frames_rejected",
 )
 
 
@@ -287,6 +302,7 @@ def ps_server_metrics(server) -> Dict[str, float]:
         "stale_drops": float(server.stale_drops),
         "bucket_count": buckets,
         "wire_units_per_push": units,
+        "frames_rejected": float(getattr(server, "frames_rejected_total", 0)),
     }
 
 
@@ -313,6 +329,16 @@ def ps_server_registry(
         r.counter("ps_stale_drops_total",
                   "gradients dropped for exceeding max_staleness").set(
                       m["stale_drops"])
+        # per-worker labeled series ONLY (zero-filled for every
+        # configured worker): an additional unlabeled total under the
+        # same name would double PromQL aggregations like sum(...)
+        rej_help = ("self-verifying frames rejected "
+                    "(corruption / config drift / size mismatch)")
+        rejected = getattr(server, "frames_rejected", {})
+        for w in range(int(server.num_workers)):
+            r.counter("ps_frames_rejected_total", rej_help,
+                      labels={"worker": str(w)}).set(
+                          float(rejected.get(w, 0)))
         r.gauge("ps_raw_bytes_per_grad",
                 "dense f32 bytes of one gradient").set(m["raw_bytes_per_grad"])
         r.gauge("ps_wire_bytes_per_grad",
@@ -344,9 +370,27 @@ class PSServerTelemetry:
     ``metrics()`` (the canonical dict), ``scrape_registry()`` (a
     :class:`MetricsRegistry` that reads live server state at scrape
     time), and ``prometheus_text()`` (the shm server's scrape method;
-    the TCP server additionally serves it over HTTP)."""
+    the TCP server additionally serves it over HTTP). Also the home of
+    the frame-rejection accounting both transports share: one
+    misconfigured or corrupting worker becomes a counted, per-worker
+    rejection stream instead of a server crash."""
 
     _telemetry_registry: Optional[MetricsRegistry] = None
+    #: total self-verifying frames rejected (all workers)
+    frames_rejected_total: int = 0
+
+    @property
+    def frames_rejected(self) -> Dict[int, int]:
+        """Per-worker rejected-frame counts (lazily created)."""
+        return self.__dict__.setdefault("_frames_rejected", {})
+
+    def _reject_frame(self, worker: int, reason: str) -> None:
+        d = self.frames_rejected
+        d[worker] = d.get(worker, 0) + 1
+        self.frames_rejected_total += 1
+        from pytorch_ps_mpi_tpu.telemetry.recorder import record_event
+
+        record_event("ps.frame_rejected", worker=worker, reason=reason)
 
     def metrics(self) -> Dict[str, float]:
         """Canonical wire-observability schema, identical across

@@ -22,6 +22,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from the tier-1 `-m 'not slow'` "
+        "gate (e.g. the double-run chaos determinism check; its fast "
+        "single-run form stays in the default path)",
+    )
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from pytorch_ps_mpi_tpu.mesh import make_mesh

@@ -18,6 +18,7 @@ import pytest
 
 from pytorch_ps_mpi_tpu.parallel import dcn
 from pytorch_ps_mpi_tpu.parallel.async_train import (
+    join_workers,
     make_problem,
     serve,
     spawn_worker,
@@ -65,8 +66,9 @@ def test_async_jitted_workers_converge_with_staleness_and_drops():
             server, cfg, total_grads=0, total_received=total_pushes,
             timeout=240.0,
         )
-        for p in procs:
-            assert p.wait(timeout=120) == 0
+        # join_workers: a failed assert can no longer leak the rest of
+        # the fleet (they are terminated and reaped on every exit path)
+        assert join_workers(procs, timeout=120) == [0, 0, 0]
     finally:
         server.close()
 
@@ -122,8 +124,7 @@ def test_sync_barrier_collapses_to_straggler_async_does_not():
                 total_received=steps_fast + steps_slow,
                 sync_barrier=sync_barrier, timeout=240.0,
             )
-            for p in procs:
-                assert p.wait(timeout=120) == 0
+            assert join_workers(procs, timeout=120) == [0, 0]
         finally:
             server.close()
         return m
@@ -322,8 +323,7 @@ def test_gpt_causal_lm_over_async_wire():
         procs = [spawn_worker(name, i, cfg) for i in range(2)]
         _, m = serve(server, cfg, total_grads=0, total_received=total,
                      timeout=420.0)
-        for p in procs:
-            assert p.wait(timeout=240) == 0
+        assert join_workers(procs, timeout=240) == [0, 0]
     finally:
         server.close()
     assert m["grads_received"] == total
@@ -369,8 +369,7 @@ def test_inxla_sampled_staleness_matches_shm_arrival_histogram():
             server, cfg, total_grads=0,
             total_received=2 * fast_steps + slow_steps, timeout=240.0,
         )
-        for p in procs:
-            assert p.wait(timeout=120) == 0
+        assert join_workers(procs, timeout=120) == [0, 0, 0]
     finally:
         server.close()
     shm_hist = m["staleness_hist"]

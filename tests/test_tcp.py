@@ -18,6 +18,7 @@ import pytest
 
 from pytorch_ps_mpi_tpu.parallel import tcp
 from pytorch_ps_mpi_tpu.parallel.async_train import (
+    join_workers,
     make_problem,
     serve,
     spawn_worker,
@@ -372,8 +373,7 @@ def test_async_jitted_workers_converge_over_tcp():
             server, cfg, total_grads=0, total_received=total_pushes,
             timeout=240.0,
         )
-        for p in procs:
-            assert p.wait(timeout=120) == 0
+        assert join_workers(procs, timeout=120) == [0, 0, 0]
     finally:
         server.close()
 

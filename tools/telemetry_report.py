@@ -38,7 +38,12 @@ def collect_files(paths: List[str]) -> List[str]:
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(glob.glob(os.path.join(p, "*.jsonl"))))
+            # faults-*.jsonl are injected-fault event logs (resilience
+            # layer), not recorder files — their rows have no name/kind
+            out.extend(sorted(
+                f for f in glob.glob(os.path.join(p, "*.jsonl"))
+                if not os.path.basename(f).startswith("faults-")
+            ))
         else:
             out.append(p)
     if not out:
