@@ -10,9 +10,14 @@ test:
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
 # launch-count assertion (bucketed step lowers to >=5x fewer collective
-# ops than per-leaf), and the serialization wire-format tests.
+# ops than per-leaf), and the serialization wire-format tests. Wrapped
+# by bench_gate: each run appends a timed row to
+# benchmarks/results/bucket_smoke.jsonl and is gated against the median
+# of previous runs (noise-tolerant: 100% wall tolerance).
 bucket-smoke:
-	python -m pytest tests/test_bucketing.py tests/test_utils.py -q
+	python tools/bench_gate.py \
+		--run "python -m pytest tests/test_bucketing.py tests/test_utils.py -q" \
+		--tag bucket_smoke --out benchmarks/results/bucket_smoke.jsonl
 
 # Recorder-overhead gate: short CPU trainer, recorder off vs on in
 # interleaved blocks; writes smoke.jsonl + report.txt and FAILS if the
@@ -28,6 +33,20 @@ telemetry-smoke:
 # an identical injected-event log on replay of the same plan + seed
 chaos-smoke:
 	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/chaos_smoke.jsonl \
+		--metric 'chaos_smoke.wall_total_s:lower:1.5' \
+		--metric 'chaos_smoke.loss_final:lower:0.75'
+
+# Online-diagnosis gate: a 2-worker async run with injected delay faults
+# on worker 1 must be ATTRIBUTED by the health layer — /health + ps_top
+# name worker 1 slow and wire-bound, ps_worker_anomaly_total and a
+# nonzero ps_staleness_p95 land in /metrics — and bench_gate.py must
+# pass a self-comparison and fail a doctored 20% regression. The second
+# command re-asserts the standing <=5% recorder-overhead budget.
+diag-smoke:
+	JAX_PLATFORMS=cpu python tools/diag_smoke.py
+	python tools/telemetry_smoke.py
 
 bench:
 	python bench.py
@@ -51,4 +70,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke

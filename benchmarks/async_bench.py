@@ -32,6 +32,7 @@ jax.config.update("jax_platforms", "cpu")  # protocol bench: never touch the TPU
 
 from pytorch_ps_mpi_tpu.parallel import dcn
 from pytorch_ps_mpi_tpu.parallel.async_train import (
+    join_workers,
     make_problem,
     serve,
     spawn_worker,
@@ -62,16 +63,17 @@ def run(cfg, n_workers: int, sync_barrier: bool, total: int, code=None,
             name, num_workers=n_workers, template=params0,
             max_staleness=max_staleness, code=code,
         )
+    procs = []
     try:
         procs = [spawn_worker(name, i, cfg) for i in range(n_workers)]
         _, m = serve(server, cfg, total_grads=0, total_received=total,
                      sync_barrier=sync_barrier, timeout=3600.0)
-        for p in procs:
-            rc = p.wait(timeout=600)
+        for rc in join_workers(procs, timeout=600.0):
             if rc != 0:
                 raise RuntimeError(f"worker exited {rc}")
     finally:
         server.close()
+        join_workers(procs, timeout=5.0)  # failure path: reap, don't leak
     return m
 
 
@@ -135,6 +137,11 @@ def main():
         "sync_updates_per_sec": round(m_sync["updates_per_sec"], 3),
         "async_loss": round(m_async["loss_final"], 4),
         "sync_loss": round(m_sync["loss_final"], 4),
+        # the staleness half of the tradeoff the ratio buys (canonical
+        # schema quantiles — what the ps_staleness_p* gauges export)
+        "async_staleness_p50": m_async["staleness_p50"],
+        "async_staleness_p95": m_async["staleness_p95"],
+        "async_staleness_p99": m_async["staleness_p99"],
         "workers": w,
         "transport": args.transport,
         "straggler_ms": args.slow_ms,

@@ -81,8 +81,22 @@ def main(argv=None):
                          "Perfetto trace (trace.json), and a per-phase "
                          "report — all dropped in this directory")
     ap.add_argument("--metrics-port", type=int, default=None,
-                    help="tcp transport: serve Prometheus /metrics on this "
-                         "port (0 = auto; implied =0 by --telemetry-dir)")
+                    help="serve Prometheus /metrics (+ /health) on this "
+                         "port — both transports (0 = auto; implied =0 "
+                         "by --telemetry-dir)")
+    ap.add_argument("--health-port", type=int, default=None,
+                    help="arm the online HealthMonitor and serve its "
+                         "/health JSON (per-worker verdicts, straggler "
+                         "attribution, anomaly flags) beside /metrics "
+                         "on this port (0 = auto). Worker beacon files "
+                         "land in --telemetry-dir when set, else a temp "
+                         "dir")
+    ap.add_argument("--ps-top", action="store_true",
+                    help="run the tools/ps_top.py live dashboard against "
+                         "the /health endpoint for the duration of the "
+                         "run (implies --health-port 0; with --supervise "
+                         "pass an explicit --health-port so the pinned "
+                         "port survives server restarts)")
     ap.add_argument("--no-frame-check", action="store_true",
                     help="disable the self-verifying wire frames (CRC + "
                          "config fingerprint on every push; on by default "
@@ -192,6 +206,19 @@ def main(argv=None):
             args.metrics_port = 0
     if args.metrics_port is not None:
         cfg["metrics_port"] = args.metrics_port
+    if args.ps_top and args.health_port is None:
+        if args.supervise:
+            ap.error("--ps-top with --supervise needs an explicit "
+                     "--health-port (the dashboard must re-find the "
+                     "endpoint across server restarts)")
+        args.health_port = 0
+    if args.health_port is not None:
+        cfg["health_port"] = args.health_port
+        if "health_dir" not in cfg:
+            import tempfile
+
+            cfg["health_dir"] = (args.telemetry_dir
+                                 or tempfile.mkdtemp(prefix="ps_health_"))
 
     if args.supervise:
         from pytorch_ps_mpi_tpu.resilience import Supervisor
@@ -207,7 +234,11 @@ def main(argv=None):
             checkpoint_every=args.checkpoint_every,
             sync_barrier=args.sync_barrier, timeout=args.timeout,
         )
-        params, metrics = sup.run()
+        top = _spawn_ps_top(args.health_port) if args.ps_top else None
+        try:
+            params, metrics = sup.run()
+        finally:
+            _stop_ps_top(top)
         if args.telemetry_dir:
             # merged trace + report from the per-process JSONLs (no
             # device trace on the supervised path: the server process
@@ -247,6 +278,18 @@ def main(argv=None):
         )
     total = args.workers * args.steps
     procs = []
+    top = None
+    if args.ps_top:
+        # bind the /metrics + /health endpoint NOW (serve()'s own call is
+        # idempotent and returns this same port) so the dashboard can
+        # attach before the first gradient flows — on the SAME port
+        # serve() would pick (metrics_port wins over health_port there),
+        # so an explicit --metrics-port is honored, never shadowed
+        bound = server.start_metrics_http(
+            args.metrics_port if args.metrics_port is not None
+            else args.health_port)
+        print(f"/health live on port {bound}")
+        top = _spawn_ps_top(bound)
     device_trace_dir = device_t0_wall = None
     if args.telemetry_dir:
         # device-side half of the merged timeline: trace the server
@@ -277,6 +320,9 @@ def main(argv=None):
                 # skip the server close / orphan-worker kill below
                 print(f"device trace capture failed: {e}", file=sys.stderr)
                 device_trace_dir = None
+        _stop_ps_top(top)
+        # server.close() also tears down the /metrics + /health endpoint
+        # (PSServerTelemetry.close_metrics_http) — no leaked sockets
         server.close()
         # never leave orphan workers if serve() raised: terminate + reap
         join_workers(procs, timeout=5.0)
@@ -287,6 +333,27 @@ def main(argv=None):
         ))
     print(json.dumps(metrics, default=str))
     return metrics
+
+
+def _spawn_ps_top(port):
+    """Launch the live dashboard against the local /health endpoint."""
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "ps_top.py",
+    )
+    return subprocess.Popen([sys.executable, script, str(int(port))])
+
+
+def _stop_ps_top(proc) -> None:
+    if proc is None or proc.poll() is not None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except Exception:
+        proc.kill()
 
 
 def _parse_fault_plan(spec: str):
@@ -305,10 +372,12 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     from pytorch_ps_mpi_tpu.telemetry import export_chrome_trace, load_jsonl
     from tools.telemetry_report import format_table, summarize
 
-    # faults-*.jsonl are injected-fault logs (resilience layer), not
+    # faults-*.jsonl are injected-fault logs (resilience layer) and
+    # beacon-*.jsonl are health-monitor side channels, not
     # flight-recorder files — exclude them from the merged trace
     files = sorted(f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
-                   if not os.path.basename(f).startswith("faults-"))
+                   if not os.path.basename(f).startswith(
+                       ("faults-", "beacon-")))
     events = []
     for f in files:
         events.extend(load_jsonl(f)[1])

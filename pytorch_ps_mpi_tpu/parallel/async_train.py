@@ -267,9 +267,20 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
 
     inj = FaultInjector.from_cfg(cfg, role=worker_id)
     push_timeout = float(cfg.get("push_timeout", 60.0))
+    beacon = None
+    if cfg.get("health_dir"):
+        # the online-diagnosis side channel: one appended JSONL row per
+        # step with the SAME durations the recorder spans measure, so
+        # the server-side HealthMonitor can attribute a straggle to
+        # compute vs wire while the run is still going (the recorder
+        # dump only lands at exit)
+        from pytorch_ps_mpi_tpu.telemetry.diagnosis import BeaconWriter
+
+        beacon = BeaconWriter(cfg["health_dir"], worker_id)
     pushed = 0
     try:
         for step in range(steps):
+            t_step0 = time.monotonic()
             drop = duplicate = False
             if inj is not None:
                 for f in inj.faults_at(step):
@@ -306,38 +317,61 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                     w.set_tamper(None)
                 else:
                     w._tamper = None
-            if rec is None:
-                params, version = w.read_params()
-                loss, grads = grad_fn(params, batch_fn(step, worker_id))
-                jax.block_until_ready(grads)
-                if slow_ms:
-                    time.sleep(slow_ms / 1e3)  # deliberate straggler
-                if not drop:
+            # one measured path for recorder spans AND health beacons:
+            # durations are taken once and shared (explicit ts/dur events
+            # are exactly what rec.span records)
+            t0 = time.monotonic()
+            params, version = w.read_params()
+            if rec is not None:
+                rec.event("worker.read_params", kind="span", ts=t0,
+                          dur=time.monotonic() - t0, step=step)
+            t0 = time.monotonic()
+            loss, grads = grad_fn(params, batch_fn(step, worker_id))
+            jax.block_until_ready(grads)
+            compute_s = time.monotonic() - t0
+            if rec is not None:
+                rec.event("worker.grad", kind="span", ts=t0, dur=compute_s,
+                          step=step, version=version)
+            straggle_s = 0.0
+            if slow_ms:
+                t0 = time.monotonic()
+                time.sleep(slow_ms / 1e3)  # deliberate straggler
+                straggle_s = time.monotonic() - t0
+                if rec is not None:
+                    rec.event("worker.straggle", kind="span", ts=t0,
+                              dur=straggle_s, step=step)
+            if not drop:
+                t0 = time.monotonic()
+                w.push_grad(grads, version, timeout=push_timeout)
+                if duplicate:
                     w.push_grad(grads, version, timeout=push_timeout)
-                    if duplicate:
-                        w.push_grad(grads, version, timeout=push_timeout)
-            else:
-                with rec.span("worker.read_params", step=step):
-                    params, version = w.read_params()
-                with rec.span("worker.grad", step=step, version=version):
-                    loss, grads = grad_fn(params, batch_fn(step, worker_id))
-                    jax.block_until_ready(grads)
-                if slow_ms:
-                    with rec.span("worker.straggle", step=step):
-                        time.sleep(slow_ms / 1e3)  # deliberate straggler
-                if not drop:
-                    with rec.span("worker.push_grad", step=step,
-                                  version=version):
-                        w.push_grad(grads, version, timeout=push_timeout)
-                        if duplicate:
-                            w.push_grad(grads, version, timeout=push_timeout)
+                if rec is not None:
+                    rec.event("worker.push_grad", kind="span", ts=t0,
+                              dur=time.monotonic() - t0, step=step,
+                              version=version)
             pushed += 1
+            if beacon is not None:
+                # step accounting for straggler ATTRIBUTION: the
+                # deliberate slow_ms sleep emulates slow compute, so it
+                # rides the compute bucket; everything else that isn't
+                # the jitted grad — reads, pushes, retry backoff, and
+                # injected delay faults — is wire-side
+                wire_s = max(
+                    0.0, (time.monotonic() - t_step0) - compute_s
+                    - straggle_s)
+                beacon.step(step, compute_s + straggle_s, wire_s,
+                            straggle_s,
+                            retries=getattr(w, "retries", 0),
+                            reconnects=getattr(w, "reconnects", 0))
         if rec is not None and hasattr(w, "reconnects"):
             rec.event("resilience.summary", worker=worker_id,
                       retries=w.retries, reconnects=w.reconnects)
     finally:
         w.close()
         _dump_recorder(cfg, rec, f"worker-{worker_id}.jsonl")
+        if beacon is not None:
+            beacon.close(retries=getattr(w, "retries", 0),
+                         reconnects=getattr(w, "reconnects", 0))
     return pushed
 
 
@@ -443,13 +477,24 @@ def serve(
       ``worker-N.jsonl``) and the path rides the returned metrics as
       ``telemetry_jsonl``. Disabled (the default), the loop pays one
       None-check per gradient.
-    - ``metrics_port``: start the Prometheus ``/metrics`` HTTP endpoint
-      on a server that can serve one (TCP transport; 0 = auto-assign).
-      The bound port is returned as ``metrics_port`` in the metrics and
-      the endpoint stays up until ``server.close()``. Either way the
-      serve loop feeds step-latency and straggler-wait histograms into
-      ``server.scrape_registry()`` — the shm transport scrapes the same
-      registry via ``server.prometheus_text()``.
+    - ``metrics_port``: start the Prometheus ``/metrics`` (+ ``/health``)
+      HTTP endpoint (both transports — the endpoint renders live Python
+      state on a daemon thread; 0 = auto-assign). The bound port is
+      returned as ``metrics_port`` in the metrics and the endpoint stays
+      up until ``server.close()``. Either way the serve loop feeds
+      step-latency and straggler-wait histograms into
+      ``server.scrape_registry()`` — also scrapable in-process via
+      ``server.prometheus_text()``.
+
+    Online diagnosis (``telemetry.diagnosis``): ``health_dir`` (worker
+    beacon files + the HealthMonitor), ``health_port`` (serve ``/health``
+    + ``/metrics`` over HTTP when ``metrics_port`` isn't set; same
+    endpoint), or ``health: true`` (monitor only — verdicts ride the
+    returned metrics as ``health``) arm a :class:`HealthMonitor` fed
+    from INSIDE this loop: per-gradient EWMA/MAD anomaly flags, beacon
+    tailing at tick cadence, and sync-round critical-path gating. Armed,
+    the scrape registry additionally carries ``ps_worker_anomaly_total``,
+    ``ps_round_gating_seconds`` and ``ps_worker_health`` per worker.
 
     Resilience hooks:
 
@@ -521,13 +566,21 @@ def serve(
     g_applied = reg.gauge(
         "ps_applied_total", "gradients applied this serve call"
     )
+    monitor = None
+    if (cfg.get("health") or cfg.get("health_dir")
+            or cfg.get("health_port") is not None):
+        from pytorch_ps_mpi_tpu.telemetry.diagnosis import HealthMonitor
+
+        # attaches itself to server.health_monitor (the /health route)
+        # and registers its instruments on the scrape registry
+        monitor = HealthMonitor(server, cfg)
     metrics_http_port = None
-    if cfg.get("metrics_port") is not None and hasattr(
-            server, "start_metrics_http"):
-        metrics_http_port = server.start_metrics_http(
-            int(cfg["metrics_port"])
-        )
-        print(f"prometheus /metrics on port {metrics_http_port}",
+    http_port = cfg.get("metrics_port")
+    if http_port is None:
+        http_port = cfg.get("health_port")  # same endpoint serves both
+    if http_port is not None and hasattr(server, "start_metrics_http"):
+        metrics_http_port = server.start_metrics_http(int(http_port))
+        print(f"prometheus /metrics + /health on port {metrics_http_port}",
               flush=True)
 
     from pytorch_ps_mpi_tpu.resilience.faults import (
@@ -554,6 +607,9 @@ def serve(
     import collections
 
     pending: Dict[int, Any] = collections.defaultdict(collections.deque)
+    # critical-path bookkeeping for the monitor: when each worker FIRST
+    # became ready (had something queued) in the current sync round
+    round_ready: Dict[int, float] = {}
     dead_workers: set = set()
     c_degraded = reg.counter(
         "ps_degraded_rounds_total",
@@ -642,6 +698,15 @@ def serve(
         summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
         params, state = update(params, summed, state)
         applied += len(batch_grads)
+        if monitor is not None:
+            # bill the round's critical path to the last-ready worker,
+            # then reopen the book: a fast worker with another gradient
+            # already queued is ready for the NEXT round right now
+            monitor.observe_round(round_ready, active)
+            round_ready.clear()
+            for w2 in range(n_workers):
+                if pending[w2]:
+                    round_ready[w2] = up_t0
         if len(batch_grads) < n_workers:
             degraded_rounds += 1
             c_degraded.inc()
@@ -658,6 +723,8 @@ def serve(
             next_tick = now + tick_interval
             if on_tick is not None:
                 on_tick()
+            if monitor is not None:
+                monitor.tick()  # tail worker beacons, same thread
             if stop_when is not None and not draining and stop_when():
                 draining = True  # consume what's queued, then return
             if sync_barrier and now - round_t0 > degrade_after:
@@ -671,11 +738,14 @@ def serve(
             time.sleep(0.0005)
             continue
         wid, grad_version, grad = item
-        h_wait.observe(time.perf_counter() - wait_t0)
+        wait_s = time.perf_counter() - wait_t0
+        h_wait.observe(wait_s)
+        staleness = max(0, server.version - grad_version)
         if rec is not None:
-            rec.event("serve.grad", worker=wid,
-                      staleness=max(0, server.version - grad_version),
+            rec.event("serve.grad", worker=wid, staleness=staleness,
                       step=applied, version=grad_version)
+        if monitor is not None:
+            monitor.observe_grad(wid, staleness, wait_s)
         if sync_barrier:
             # synchronous oracle: a round completes when every active
             # worker has at least one queued gradient; one per worker is
@@ -683,6 +753,8 @@ def serve(
             # back alive (elastic replacement) — it rejoins the barrier.
             dead_workers.discard(wid)
             pending[wid].append(grad)
+            if monitor is not None and wid not in round_ready:
+                round_ready[wid] = time.perf_counter()
             if not _try_complete_round():
                 wait_t0 = time.perf_counter()
         else:
@@ -712,6 +784,17 @@ def serve(
     )
     if metrics_http_port is not None:
         m["metrics_port"] = metrics_http_port
+    if monitor is not None:
+        m["health"] = monitor.snapshot()
+    if cfg.get("telemetry_dir"):
+        # final scrape snapshot for offline tooling: telemetry_report
+        # tabulates the labeled series (per-worker rejections, anomaly
+        # counts) from this file next to the recorder JSONLs
+        prom_path = os.path.join(cfg["telemetry_dir"], "metrics.prom")
+        os.makedirs(cfg["telemetry_dir"], exist_ok=True)
+        with open(prom_path, "w") as f:
+            f.write(server.prometheus_text())
+        m["metrics_prom"] = prom_path
     jsonl = _dump_recorder(cfg, rec, "server.jsonl")
     if jsonl is not None:
         m["telemetry_jsonl"] = jsonl

@@ -282,9 +282,11 @@ class ShmPSServer(PSServerTelemetry):
     Telemetry (:class:`PSServerTelemetry`): ``metrics()`` returns the
     canonical schema shared with ``TcpPSServer`` — the reference's
     ``msg_bytes``/``packaged_bytes`` pair (``ps.py:135-136``) measured
-    on the live async path — and ``prometheus_text()`` is the shm
-    transport's scrape method (no socket to serve HTTP over; the TCP
-    server exposes the same registry at ``/metrics``)."""
+    on the live async path — ``prometheus_text()`` is the in-process
+    scrape method, and ``start_metrics_http()`` serves the same registry
+    (plus the ``/health`` diagnosis JSON) over HTTP: the endpoint only
+    renders Python state on a daemon thread, so the shm transport gets
+    the same ops surface as TCP."""
 
     def __init__(self, name: str, num_workers: int, template: PyTree,
                  max_staleness: int = 4, code=None, bucket_mb: float = 0.0,
@@ -468,6 +470,9 @@ class ShmPSServer(PSServerTelemetry):
         return out
 
     def close(self):
+        # the /metrics + /health endpoint (PSServerTelemetry mixin) dies
+        # with the server — a supervisor restart can never leak a socket
+        self.close_metrics_http()
         if self._h:
             self._lib.psq_close(self._h)
             self._h = None

@@ -119,6 +119,22 @@ def server_main(shard_id: int, n_shards: int, port: int,
                          max_staleness=int(cfg.get("max_staleness", 4)),
                          code=code, frame=bool(cfg.get("frame_check")))
 
+    # per-shard online diagnosis: each shard server gets its own
+    # HealthMonitor and /metrics + /health endpoint (port auto-assigned
+    # — S shards cannot share one pinned port; the bound port rides the
+    # stdout handshake line below as "health_port")
+    monitor = None
+    health_port = None
+    if (cfg.get("health") or cfg.get("health_dir")
+            or cfg.get("health_port") is not None
+            or cfg.get("metrics_port") is not None):
+        from pytorch_ps_mpi_tpu.telemetry.diagnosis import HealthMonitor
+
+        monitor = HealthMonitor(server, cfg)
+        if (cfg.get("health_port") is not None
+                or cfg.get("metrics_port") is not None):
+            health_port = server.start_metrics_http(0)
+
     ckpt = None
     applied_before = 0
     checkpoint_every = int(cfg.get("checkpoint_every", 50))
@@ -139,7 +155,10 @@ def server_main(shard_id: int, n_shards: int, port: int,
             )
 
     # the coordinator reads the auto-assigned port from this line
-    print(json.dumps({"shard": shard_id, "port": server.port}), flush=True)
+    hello = {"shard": shard_id, "port": server.port}
+    if health_port is not None:
+        hello["health_port"] = health_port
+    print(json.dumps(hello), flush=True)
     try:
         server.publish(params)
         applied = 0
@@ -165,7 +184,9 @@ def server_main(shard_id: int, n_shards: int, port: int,
             if item is None:
                 time.sleep(0.0005)
                 continue
-            _, _, grad = item
+            wid, ver, grad = item
+            if monitor is not None:
+                monitor.observe_grad(wid, max(0, server.version - ver))
             params, state = update(params, grad, state)
             applied += 1
             if slow_ms:
@@ -191,6 +212,7 @@ def server_main(shard_id: int, n_shards: int, port: int,
             staleness_hist=json.dumps(
                 {int(k): int(v) for k, v in server.staleness_seen.items()}
             ),
+            health=(monitor.render_json() if monitor is not None else "{}"),
         )
     finally:
         server.close()

@@ -49,7 +49,7 @@ from pytorch_ps_mpi_tpu.parallel.dcn import (
     _u8,
     _unflatten,
 )
-from pytorch_ps_mpi_tpu.telemetry import MetricsHTTPServer, PSServerTelemetry
+from pytorch_ps_mpi_tpu.telemetry import PSServerTelemetry
 
 _lib: Optional[ctypes.CDLL] = None
 
@@ -173,19 +173,9 @@ class TcpPSServer(PSServerTelemetry):
         self.last_seen: Dict[int, float] = {}
         self._ever_connected: set = set()
         self._t0 = time.time()
-        self._metrics_http: Optional[MetricsHTTPServer] = None
-
-    def start_metrics_http(self, port: int = 0,
-                           host: str = "0.0.0.0") -> int:
-        """Serve ``prometheus_text()`` at ``http://host:port/metrics`` on
-        a daemon thread (``port=0`` auto-assigns). Returns the bound
-        port; idempotent — a second call returns the live endpoint's
-        port. Torn down by :meth:`close`."""
-        if self._metrics_http is None:
-            self._metrics_http = MetricsHTTPServer(
-                self.prometheus_text, port=port, host=host
-            )
-        return self._metrics_http.port
+        # /metrics + /health HTTP: start_metrics_http / close_metrics_http
+        # live on PSServerTelemetry (shared with the shm server)
+        self._metrics_http = None
 
     def publish(self, params: PyTree) -> None:
         flat = _flatten(params)
@@ -337,9 +327,7 @@ class TcpPSServer(PSServerTelemetry):
         return out
 
     def close(self):
-        if self._metrics_http is not None:
-            self._metrics_http.close()
-            self._metrics_http = None
+        self.close_metrics_http()
         if self._h:
             self._lib.tps_server_close(self._h)
             self._h = None

@@ -17,6 +17,11 @@ dicts). One system, three faces:
   :class:`MetricsHTTPServer` serves it at ``/metrics``.
 - :mod:`trace export <.trace_export>` — merges host-side recorder spans
   with ``jax.profiler`` device traces into one Chrome/Perfetto timeline.
+- :mod:`diagnosis <.diagnosis>` — the layer that turns the streams into
+  ANSWERS: :class:`HealthMonitor` derives per-worker verdicts (EWMA +
+  MAD anomaly flags, compute/wire/churn straggler attribution, sync-
+  round critical-path gating) served as ``/health`` JSON beside
+  ``/metrics`` and rendered live by ``tools/ps_top.py``.
 
 ``tools/telemetry_report.py`` turns a recorded JSONL into the per-phase
 summary table; ``make telemetry-smoke`` bounds the enabled-recorder
@@ -42,8 +47,13 @@ from pytorch_ps_mpi_tpu.telemetry.registry import (
     PSServerTelemetry,
     ps_server_metrics,
     ps_server_registry,
+    staleness_quantile,
 )
 from pytorch_ps_mpi_tpu.telemetry.http_server import MetricsHTTPServer
+from pytorch_ps_mpi_tpu.telemetry.diagnosis import (
+    BeaconWriter,
+    HealthMonitor,
+)
 from pytorch_ps_mpi_tpu.telemetry.trace_export import (
     export_chrome_trace,
     merged_trace_events,
@@ -66,7 +76,10 @@ __all__ = [
     "PSServerTelemetry",
     "ps_server_metrics",
     "ps_server_registry",
+    "staleness_quantile",
     "MetricsHTTPServer",
+    "BeaconWriter",
+    "HealthMonitor",
     "export_chrome_trace",
     "merged_trace_events",
 ]

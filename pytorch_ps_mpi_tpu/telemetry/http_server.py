@@ -1,52 +1,69 @@
-"""Prometheus-text ``/metrics`` HTTP endpoint (stdlib only).
+"""Prometheus-text ``/metrics`` (+ JSON ``/health``) HTTP endpoint.
 
 Serves whatever a render callable returns — typically
 ``registry.prometheus_text`` — on a daemon thread, so the PS serve loop
 is never blocked by a scraper. One scrape is one GET; the registry's
 collectors refresh instrument values from live server state at render
 time, so there is no per-gradient bookkeeping behind this endpoint.
+
+Beyond ``/metrics``, the server takes a ``routes`` dict mapping extra
+paths to render callables returning ``(body_str, content_type)`` — the
+ops side-channel the diagnosis layer uses for its ``/health`` JSON
+(:mod:`.diagnosis`). Routes are resolved at REQUEST time, so a route
+registered after construction (a health monitor attached mid-run) is
+served without restarting the listener.
 """
 
 from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable
+from typing import Callable, Dict, Optional, Tuple
 
 _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 class MetricsHTTPServer:
-    """``GET /metrics`` → the render callable's text; anything else 404.
+    """``GET /metrics`` → the render callable's text; ``GET <route>`` →
+    that route's ``(body, content_type)``; anything else 404.
 
     ``port=0`` auto-assigns (read back via ``.port``). ``close()`` shuts
     the listener down; the object is also a context manager.
     """
 
     def __init__(self, render: Callable[[], str], port: int = 0,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0",
+                 routes: Optional[
+                     Dict[str, Callable[[], Tuple[str, str]]]] = None):
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                if self.path.rstrip("/") not in ("/metrics", ""):
-                    self.send_error(404)
-                    return
+                path = self.path.split("?", 1)[0].rstrip("/")
                 try:
-                    body = outer._render().encode()
+                    if path in ("/metrics", ""):
+                        body, ctype = outer._render(), _CONTENT_TYPE
+                    elif path in outer.routes:
+                        body, ctype = outer.routes[path]()
+                    else:
+                        self.send_error(404)
+                        return
+                    payload = body.encode()
                 except Exception as e:  # a scrape must never kill serving
                     self.send_error(500, f"{type(e).__name__}: {e}")
                     return
                 self.send_response(200)
-                self.send_header("Content-Type", _CONTENT_TYPE)
-                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
-                self.wfile.write(body)
+                self.wfile.write(payload)
 
             def log_message(self, *a):  # scrapes are not stdout news
                 pass
 
         self._render = render
+        self.routes: Dict[str, Callable[[], Tuple[str, str]]] = dict(
+            routes or {})
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self.port = int(self._httpd.server_address[1])
@@ -55,6 +72,12 @@ class MetricsHTTPServer:
             daemon=True, name=f"metrics-http:{self.port}",
         )
         self._thread.start()
+
+    def add_route(self, path: str,
+                  render: Callable[[], Tuple[str, str]]) -> None:
+        """Register ``path`` → ``render() -> (body, content_type)`` on
+        the live listener (request-time dispatch — no restart)."""
+        self.routes[path.rstrip("/")] = render
 
     def close(self) -> None:
         httpd, self._httpd = self._httpd, None

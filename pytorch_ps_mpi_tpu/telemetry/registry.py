@@ -114,10 +114,12 @@ class Histogram:
         server's ``staleness_seen``) — replaces current contents. Built
         locally and swapped under ONE lock acquisition so concurrent
         scrapes (ThreadingHTTPServer runs collectors per request) can
-        never interleave a reset with another scrape's adds."""
+        never interleave a reset with another scrape's adds. The source
+        dict is snapshotted atomically first — it is typically the live
+        ``staleness_seen`` the serve thread is inserting into."""
         counts = [0] * (len(self.bounds) + 1)
         total_sum, total_n = 0.0, 0
-        for v, n in value_counts.items():
+        for v, n in list(value_counts.items()):
             v, n = float(v), int(n)
             i = 0
             while i < len(self.bounds) and v > self.bounds[i]:
@@ -145,6 +147,36 @@ class Histogram:
             if cum >= target and c:
                 return self.bounds[i] if i < len(self.bounds) else _INF
         return _INF
+
+    def approx_quantile(self, q: float) -> float:
+        """Interpolated quantile (Prometheus ``histogram_quantile``
+        semantics): observations are assumed uniform within each bucket
+        and the q-quantile position is linearly interpolated between the
+        bucket's edges — so p95 of a histogram is a value, not just
+        "somewhere ≤ bound". Observations in the +Inf overflow bucket
+        degrade to the highest finite bound (same clamp Prometheus
+        applies). NaN when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            counts, total = list(self.counts), self.count
+        if total == 0:
+            return math.nan
+        target = q * total
+        cum = 0
+        # the first bucket's lower edge: 0 for the nonneg histograms this
+        # registry holds (latencies, staleness), else the bound itself
+        lo = 0.0 if self.bounds[0] > 0 else float(self.bounds[0])
+        for i, c in enumerate(counts):
+            if i >= len(self.bounds):
+                return float(self.bounds[-1])  # overflow bucket: clamp
+            hi = float(self.bounds[i])
+            if cum + c >= target and c:
+                frac = (target - cum) / c
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            cum += c
+            lo = hi
+        return float(self.bounds[-1])
 
     def render(self) -> List[str]:
         out = []
@@ -269,7 +301,35 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     # failed validation (corruption, config drift, size) — always 0 when
     # frame checking is off
     "frames_rejected",
+    # staleness distribution summary (exact weighted quantiles over
+    # ``staleness_seen``; the scrape registry mirrors them as the
+    # ps_staleness_p* gauges via Histogram.approx_quantile) — the
+    # headline numbers of the staleness/convergence tradeoff, 0.0 before
+    # any gradient arrives
+    "staleness_p50",
+    "staleness_p95",
+    "staleness_p99",
 )
+
+
+def staleness_quantile(seen: Dict[Any, int], q: float) -> float:
+    """Exact weighted q-quantile of a ``{staleness_value: count}`` dict
+    (the server's ``staleness_seen``); 0.0 when empty. Snapshots the
+    dict in ONE C-level call first — scrapes run on the HTTP thread
+    while the serve loop inserts, and a Python-level iteration over the
+    live dict would intermittently raise 'changed size during
+    iteration' into a 500."""
+    items = sorted(seen.items())  # atomic under the GIL (no bytecode)
+    total = sum(int(n) for _, n in items)
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for v, n in items:
+        cum += int(n)
+        if cum >= target:
+            return float(v)
+    return float(items[-1][0])
 
 
 def ps_server_metrics(server) -> Dict[str, float]:
@@ -303,6 +363,9 @@ def ps_server_metrics(server) -> Dict[str, float]:
         "bucket_count": buckets,
         "wire_units_per_push": units,
         "frames_rejected": float(getattr(server, "frames_rejected_total", 0)),
+        "staleness_p50": staleness_quantile(server.staleness_seen, 0.50),
+        "staleness_p95": staleness_quantile(server.staleness_seen, 0.95),
+        "staleness_p99": staleness_quantile(server.staleness_seen, 0.99),
     }
 
 
@@ -357,9 +420,20 @@ def ps_server_registry(
                 "latest published snapshot version").set(float(server.version))
         r.gauge("ps_num_workers", "configured worker count").set(
             float(server.num_workers))
-        r.histogram("ps_staleness", stale_buckets,
-                    "observed gradient staleness (versions)").load(
-                        server.staleness_seen)
+        hist = r.histogram("ps_staleness", stale_buckets,
+                           "observed gradient staleness (versions)")
+        hist.load(server.staleness_seen)
+        # quantile GAUGES beside the bucketed histogram: alert rules and
+        # the /health snapshot read a number, not a bucket dict
+        # (Histogram.approx_quantile — NaN-free: 0.0 before any gradient)
+        for q, name in ((0.50, "ps_staleness_p50"),
+                        (0.95, "ps_staleness_p95"),
+                        (0.99, "ps_staleness_p99")):
+            v = hist.approx_quantile(q)
+            r.gauge(name,
+                    f"observed gradient staleness p{int(q * 100)} "
+                    "(interpolated, versions)").set(
+                        0.0 if math.isnan(v) else v)
 
     reg.add_collector(collect)
     return reg
@@ -369,8 +443,11 @@ class PSServerTelemetry:
     """Mixin giving a PS server the canonical telemetry surface:
     ``metrics()`` (the canonical dict), ``scrape_registry()`` (a
     :class:`MetricsRegistry` that reads live server state at scrape
-    time), and ``prometheus_text()`` (the shm server's scrape method;
-    the TCP server additionally serves it over HTTP). Also the home of
+    time), ``prometheus_text()`` (the scrape method), and
+    :meth:`start_metrics_http` (the ``/metrics`` + ``/health`` HTTP
+    endpoint — transport-independent: it renders live Python state on a
+    daemon thread and never touches a native transport handle, so the
+    shm server serves it as readily as the TCP one). Also the home of
     the frame-rejection accounting both transports share: one
     misconfigured or corrupting worker becomes a counted, per-worker
     rejection stream instead of a server crash."""
@@ -378,6 +455,9 @@ class PSServerTelemetry:
     _telemetry_registry: Optional[MetricsRegistry] = None
     #: total self-verifying frames rejected (all workers)
     frames_rejected_total: int = 0
+    #: the attached online-diagnosis monitor (``/health``'s source),
+    #: set by ``serve()`` when health is armed — see :mod:`.diagnosis`
+    health_monitor: Optional[Any] = None
 
     @property
     def frames_rejected(self) -> Dict[int, int]:
@@ -404,3 +484,42 @@ class PSServerTelemetry:
 
     def prometheus_text(self) -> str:
         return self.scrape_registry().prometheus_text()
+
+    def health_json(self) -> str:
+        """The ``/health`` body: the attached monitor's verdict snapshot,
+        or an explicit not-armed marker — a scraper can always tell
+        "diagnosis off" from "fleet empty"."""
+        import json
+
+        mon = self.health_monitor
+        if mon is None:
+            return json.dumps({"armed": False, "workers": []})
+        return mon.render_json()
+
+    def start_metrics_http(self, port: int = 0,
+                           host: str = "0.0.0.0") -> int:
+        """Serve ``prometheus_text()`` at ``http://host:port/metrics``
+        and :meth:`health_json` at ``/health`` on a daemon thread
+        (``port=0`` auto-assigns). Returns the bound port; idempotent —
+        a second call returns the live endpoint's port. Torn down by
+        :meth:`close_metrics_http` (every transport's ``close()`` calls
+        it, so a supervisor restart can never leak the socket)."""
+        if getattr(self, "_metrics_http", None) is None:
+            from pytorch_ps_mpi_tpu.telemetry.http_server import (
+                MetricsHTTPServer,
+            )
+
+            # the route reads health_monitor at REQUEST time: a monitor
+            # attached after the listener started is served immediately
+            self._metrics_http = MetricsHTTPServer(
+                self.prometheus_text, port=port, host=host,
+                routes={"/health": lambda: (self.health_json(),
+                                            "application/json")},
+            )
+        return self._metrics_http.port
+
+    def close_metrics_http(self) -> None:
+        http = getattr(self, "_metrics_http", None)
+        self._metrics_http = None
+        if http is not None:
+            http.close()

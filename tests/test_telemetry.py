@@ -204,6 +204,28 @@ def test_histogram_quantile_and_load():
     assert h.quantile(1.0) == 8
 
 
+def test_histogram_approx_quantile_interpolates():
+    """The satellite: a VALUE from cumulative buckets (linear
+    interpolation, Prometheus histogram_quantile semantics), not just
+    'somewhere <= bound' — what the ps_staleness_p* gauges export."""
+    import math
+
+    from pytorch_ps_mpi_tpu.telemetry import Histogram
+
+    h = Histogram("x", buckets=[1, 2, 4, 8])
+    assert math.isnan(h.approx_quantile(0.5))  # empty: explicit NaN
+    h.load({1: 50, 4: 45, 8: 5})
+    assert h.approx_quantile(0.50) == 1.0   # exactly fills bucket 1
+    assert h.approx_quantile(0.95) == 4.0
+    assert abs(h.approx_quantile(0.99) - 7.2) < 1e-9  # interpolated
+    # overflow observations clamp to the highest finite bound
+    h2 = Histogram("y", buckets=[1.0])
+    h2.observe(50.0)
+    assert h2.approx_quantile(0.99) == 1.0
+    with pytest.raises(ValueError):
+        h.approx_quantile(1.5)
+
+
 # -- trace export + report --------------------------------------------------
 
 def test_chrome_trace_export_merges_processes(tmp_path):

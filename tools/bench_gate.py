@@ -1,0 +1,330 @@
+"""Statistical perf-regression gate over benchmark JSONL artifacts.
+
+The BENCH_*.json / benchmarks/results/*.jsonl trajectory only stays
+honest if someone compares runs — this tool is that someone, built to
+fail ``make`` instead of letting a regression drift in silently, while
+staying calm about the noise a shared CPU container injects into any
+single run (median-of-runs + a configurable relative tolerance per
+metric, the same statistics ``tools/telemetry_smoke.py`` settled on).
+
+Three modes::
+
+  # compare two artifacts (baseline vs candidate)
+  python tools/bench_gate.py results/sweep_old.jsonl results/sweep_new.jsonl
+
+  # gate the LAST appended run of an accumulating smoke file against the
+  # median of every previous run
+  python tools/bench_gate.py --trajectory benchmarks/results/chaos_smoke.jsonl \
+      --metric chaos_smoke.wall_total_s:lower:1.0
+
+  # run a command, time it, append a row, then trajectory-gate the file
+  python tools/bench_gate.py --run "python -m pytest tests/foo.py -q" \
+      --tag bucket_smoke --out benchmarks/results/bucket_smoke.jsonl
+
+Inputs understood:
+
+- JSONL rows of the ``{"metric": name, "value": v, "unit": u}`` shape
+  every bench here emits (multiple rows with one name = repeated runs →
+  the median is compared);
+- flat JSON-object rows (one per run — ``chaos_smoke.jsonl``'s shape):
+  numeric fields become ``<bench>.<field>`` metrics, gated only when
+  named by ``--metric`` (their improve-direction isn't inferable);
+- ``BENCH_r*.json`` round records (the ``parsed`` payload).
+
+Direction ("which way is worse") comes from the per-metric spec
+(``name:lower:0.2`` / ``name:higher``), else from the unit
+(``steps/sec`` up, ``ms`` down), else from name heuristics
+(``*_per_sec``/``*ratio``/``*mfu`` up, ``*_s``/``*_ms``/``*wall*``
+down); metrics with no inferable direction are reported and skipped,
+never silently gated the wrong way.
+
+Exit codes: 0 pass, 1 regression, 2 usage/input error (``--run``
+propagates the command's own failure code first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+HIGHER_UNITS = {"steps/sec", "updates/sec", "items/sec", "ops/sec",
+                "grads/sec", "mb/s", "gb/s", "x", "ratio", "flops"}
+LOWER_UNITS = {"s", "ms", "us", "ns", "seconds", "sec", "bytes", "mb",
+               "gb", "collective launches"}
+HIGHER_NAME_HINTS = ("per_sec", "throughput", "ratio", "mfu", "speedup",
+                     "reduction_x", "compression")
+LOWER_NAME_HINTS = ("_s", "_ms", "_seconds", "wall", "latency", "_bytes",
+                    "_time", "launches")
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def load_rows(path: str) -> List[dict]:
+    """One artifact file → list of row dicts."""
+    rows: List[dict] = []
+    with open(path) as f:
+        text = f.read()
+    if path.endswith(".jsonl"):
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if isinstance(obj, dict):
+                rows.append(obj)
+        return rows
+    obj = json.loads(text)
+    if isinstance(obj, dict) and "parsed" in obj:  # BENCH_r*.json record
+        obj = obj["parsed"]
+    if isinstance(obj, dict):
+        rows = [obj]
+    elif isinstance(obj, list):
+        rows = [r for r in obj if isinstance(r, dict)]
+    return rows
+
+
+def extract_metrics(rows: List[dict]) -> Tuple[
+        Dict[str, List[float]], Dict[str, str], set]:
+    """Rows → {metric: [samples]}, {metric: unit}, {flat-field names}.
+    Metric-shaped rows keep their own name; flat run-rows expand numeric
+    fields under a ``<bench>.`` prefix — those names ride the returned
+    ``flat`` set so the gate only ever judges them when ``--metric``
+    names them (their improve-direction isn't declared anywhere)."""
+    samples: Dict[str, List[float]] = {}
+    units: Dict[str, str] = {}
+    flat: set = set()
+    for r in rows:
+        if "metric" in r and _is_num(r.get("value")):
+            name = str(r["metric"])
+            samples.setdefault(name, []).append(float(r["value"]))
+            if r.get("unit"):
+                units.setdefault(name, str(r["unit"]))
+        else:
+            prefix = str(r.get("bench", "")).strip()
+            for k, v in r.items():
+                if k in ("bench", "t", "timestamp") or not _is_num(v):
+                    continue
+                name = f"{prefix}.{k}" if prefix else k
+                samples.setdefault(name, []).append(float(v))
+                flat.add(name)
+    return samples, units, flat
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def infer_direction(name: str, unit: Optional[str]) -> Optional[str]:
+    if unit:
+        u = unit.strip().lower()
+        if u in HIGHER_UNITS:
+            return "higher"
+        if u in LOWER_UNITS:
+            return "lower"
+    low = name.lower()
+    if any(h in low for h in HIGHER_NAME_HINTS):
+        return "higher"
+    if any(h in low for h in ("wall", "latency", "_time")) or \
+            low.endswith(("_s", "_ms", "_seconds", "_bytes", "launches")):
+        return "lower"
+    return None
+
+
+def parse_metric_specs(specs: List[str], default_tol: float
+                       ) -> Dict[str, Tuple[Optional[str], float]]:
+    """``name[:direction][:tolerance]`` → {pattern: (direction, tol)}.
+    ``name`` may be an fnmatch glob; direction empty = infer."""
+    out: Dict[str, Tuple[Optional[str], float]] = {}
+    for spec in specs:
+        parts = spec.split(":")
+        name = parts[0]
+        direction = parts[1] if len(parts) > 1 and parts[1] else None
+        if direction not in (None, "lower", "higher"):
+            raise SystemExit(
+                f"bad --metric direction {direction!r} in {spec!r} "
+                "(lower|higher)")
+        tol = float(parts[2]) if len(parts) > 2 and parts[2] else default_tol
+        out[name] = (direction, tol)
+    return out
+
+
+def compare(base: Dict[str, List[float]], cand: Dict[str, List[float]],
+            units: Dict[str, str],
+            specs: Dict[str, Tuple[Optional[str], float]],
+            default_tol: float, gate_unlisted: bool = True,
+            flat: Optional[set] = None) -> dict:
+    """Median-of-runs comparison per overlapping metric. Returns the
+    verdict dict (``regressions``, ``improved``, ``ok``, ``skipped``).
+    Names in ``flat`` (expanded run-row fields) are gated ONLY when a
+    spec matches them — name heuristics never judge a field whose
+    improve-direction was never declared."""
+    regressions, improved, ok, skipped = [], [], [], []
+    flat = flat or set()
+    for name in sorted(set(base) & set(cand)):
+        spec = None
+        for pat, s in specs.items():
+            if name == pat or fnmatch.fnmatch(name, pat):
+                spec = s
+                break
+        if spec is None and (name in flat or not gate_unlisted):
+            skipped.append({
+                "metric": name,
+                "reason": ("flat run-row field (gate it via --metric)"
+                           if name in flat else "not in --metric"),
+            })
+            continue
+        direction, tol = spec if spec else (None, default_tol)
+        if direction is None:
+            direction = infer_direction(name, units.get(name))
+        if direction is None:
+            skipped.append({"metric": name,
+                            "reason": "unknown improve-direction "
+                                      "(name it via --metric)"})
+            continue
+        b, c = _median(base[name]), _median(cand[name])
+        row = {"metric": name, "direction": direction, "tolerance": tol,
+               "baseline": b, "candidate": c,
+               "n_baseline": len(base[name]), "n_candidate": len(cand[name])}
+        if b == 0.0:
+            if c == 0.0:
+                ok.append(row)
+            else:
+                skipped.append({**row,
+                                "reason": "zero baseline (no relative "
+                                          "comparison possible)"})
+            continue
+        rel = (c - b) / abs(b)
+        row["rel_change"] = round(rel, 6)
+        worse = rel > tol if direction == "lower" else rel < -tol
+        better = rel < -tol if direction == "lower" else rel > tol
+        (regressions if worse else improved if better else ok).append(row)
+    return {"regressions": regressions, "improved": improved, "ok": ok,
+            "skipped": skipped}
+
+
+def _report(verdict: dict, as_json: bool, note: str = "") -> None:
+    if as_json:
+        print(json.dumps(verdict))
+        return
+    if note:
+        print(note)
+    for row in verdict["regressions"]:
+        print(f"REGRESSION  {row['metric']}: {row['baseline']:.6g} -> "
+              f"{row['candidate']:.6g} ({row['rel_change']:+.1%}, "
+              f"{row['direction']} is better, tol {row['tolerance']:.0%})")
+    for row in verdict["improved"]:
+        print(f"improved    {row['metric']}: {row['baseline']:.6g} -> "
+              f"{row['candidate']:.6g} ({row['rel_change']:+.1%})")
+    for row in verdict["ok"]:
+        print(f"ok          {row['metric']}: {row['baseline']:.6g} -> "
+              f"{row['candidate']:.6g} "
+              f"({row.get('rel_change', 0.0):+.1%})")
+    for row in verdict["skipped"]:
+        print(f"skipped     {row['metric']}: {row['reason']}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("files", nargs="*",
+                    help="BASELINE CANDIDATE artifact files")
+    ap.add_argument("--trajectory", metavar="FILE",
+                    help="gate FILE's last appended run-row against the "
+                         "median of all previous rows")
+    ap.add_argument("--run", metavar="CMD",
+                    help="run CMD (shell), time it, append a run-row to "
+                         "--out, then trajectory-gate --out")
+    ap.add_argument("--tag", default="run",
+                    help="bench tag for the --run row")
+    ap.add_argument("--out", metavar="FILE",
+                    help="accumulating JSONL for --run")
+    ap.add_argument("--metric", action="append", default=[],
+                    metavar="NAME[:DIR][:TOL]",
+                    help="gate this metric (glob ok); DIR lower|higher "
+                         "(default: inferred), TOL relative (default "
+                         "--tolerance). Repeatable.")
+    ap.add_argument("--tolerance", type=float, default=0.1,
+                    help="default relative tolerance (0.1 = 10%%)")
+    ap.add_argument("--only-listed", action="store_true",
+                    help="gate ONLY --metric-named metrics (flat run-row "
+                         "fields are only ever gated when listed)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as JSON")
+    args = ap.parse_args(argv)
+    specs = parse_metric_specs(args.metric, args.tolerance)
+
+    if args.run:
+        import subprocess
+
+        if not args.out:
+            ap.error("--run requires --out")
+        t0 = time.perf_counter()
+        rc = subprocess.call(args.run, shell=True)
+        wall = time.perf_counter() - t0
+        if rc != 0:
+            print(f"bench-gate: command failed (rc={rc}); no row appended",
+                  file=sys.stderr)
+            return rc
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "a") as f:
+            f.write(json.dumps({"bench": args.tag,
+                                "wall_s": round(wall, 3),
+                                "t": time.time()}) + "\n")
+        if not specs:
+            specs = parse_metric_specs(
+                [f"{args.tag}.wall_s:lower:{max(args.tolerance, 1.0)}"],
+                args.tolerance)
+        args.trajectory = args.out
+
+    if args.trajectory:
+        rows = load_rows(args.trajectory)
+        if len(rows) < 2:
+            print(f"bench-gate: {args.trajectory} has {len(rows)} run(s); "
+                  "nothing to compare yet — pass")
+            return 0
+        base, units_b, flat_b = extract_metrics(rows[:-1])
+        cand, units_c, flat_c = extract_metrics(rows[-1:])
+        units = {**units_b, **units_c}
+        note = (f"bench-gate trajectory: run #{len(rows)} of "
+                f"{args.trajectory} vs median of the previous "
+                f"{len(rows) - 1}")
+    else:
+        if len(args.files) != 2:
+            ap.error("need BASELINE CANDIDATE files "
+                     "(or --trajectory / --run)")
+        base_rows = load_rows(args.files[0])
+        cand_rows = load_rows(args.files[1])
+        base, units_b, flat_b = extract_metrics(base_rows)
+        cand, units_c, flat_c = extract_metrics(cand_rows)
+        units = {**units_b, **units_c}
+        note = f"bench-gate: {args.files[1]} vs baseline {args.files[0]}"
+        if not set(base) & set(cand):
+            print(f"bench-gate: no overlapping metrics between "
+                  f"{args.files[0]} and {args.files[1]}", file=sys.stderr)
+            return 2
+
+    verdict = compare(base, cand, units, specs, args.tolerance,
+                      gate_unlisted=not args.only_listed,
+                      flat=flat_b | flat_c)
+    _report(verdict, args.json, note)
+    if verdict["regressions"]:
+        n = len(verdict["regressions"])
+        print(f"bench-gate: FAIL — {n} metric(s) regressed past tolerance",
+              file=sys.stderr)
+        return 1
+    print("bench-gate: pass")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

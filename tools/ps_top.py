@@ -1,0 +1,219 @@
+"""ps_top: live terminal dashboard over the PS ``/health`` endpoint.
+
+``top`` for the async fleet: polls the JSON the serve loop's
+:class:`~pytorch_ps_mpi_tpu.telemetry.diagnosis.HealthMonitor` publishes
+at ``/health`` (beside ``/metrics`` — both transports serve it now) and
+redraws one verdict row per worker: health verdict, straggler
+attribution (compute-bound / wire-bound / reconnect-churn), push
+interarrival EWMA + p95, staleness EWMA, anomaly count, sync-round
+gating bill, retry/reconnect counters, and last-seen age.
+
+Usage::
+
+  python tools/ps_top.py http://127.0.0.1:9100        # or host:port
+  python tools/ps_top.py 9100 --interval 0.5          # localhost port
+  python tools/ps_top.py 9100 --once                  # one frame, no tty
+
+Keybindings (when stdin is a tty): ``q`` quit · ``p`` pause/resume ·
+``s`` cycle the sort column (worker → verdict → interarrival → gating)
+· ``r`` force an immediate refresh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+SORT_KEYS = ("worker", "verdict", "interarrival", "gating")
+
+_VERDICT_ORDER = {"missing": 0, "churning": 1, "slow": 2, "ok": 3}
+_COLOR = {"ok": "\x1b[32m", "slow": "\x1b[33m", "churning": "\x1b[35m",
+          "missing": "\x1b[31m"}
+_RESET = "\x1b[0m"
+
+
+def normalize_url(target: str) -> str:
+    if target.startswith("http"):
+        url = target
+    elif ":" in target:
+        url = f"http://{target}"
+    else:
+        url = f"http://127.0.0.1:{target}"
+    return url.rstrip("/") + ("" if url.endswith("/health") else "/health")
+
+
+def fetch(url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v < 1.0:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v:.2f}s"
+
+
+def render_table(health: Dict[str, Any], sort: str = "worker",
+                 color: bool = False) -> str:
+    """One dashboard frame from a ``/health`` document (pure — the
+    testable core)."""
+    lines: List[str] = []
+    fleet = health.get("fleet", {})
+    if not health.get("armed", False):
+        return ("health monitor not armed on this server "
+                "(run with health/health_dir/health_port configured)")
+    lines.append(
+        f"ps_top  workers={health.get('n_workers')}  "
+        f"grads={int(fleet.get('grads_received', 0))}  "
+        f"stale_drops={int(fleet.get('stale_drops', 0))}  "
+        f"staleness p50/p95/p99="
+        f"{fleet.get('staleness_p50', 0):.1f}/"
+        f"{fleet.get('staleness_p95', 0):.1f}/"
+        f"{fleet.get('staleness_p99', 0):.1f}  "
+        f"anomalies={fleet.get('anomaly_total', 0)}  "
+        f"rounds={fleet.get('rounds', 0)}  "
+        f"up={health.get('uptime_s', 0):.0f}s"
+    )
+    cols = ["wk", "verdict", "cause", "grads", "inter-ewma", "inter-p95",
+            "stale-ewma", "anom", "gate-rounds", "gate-s", "retry",
+            "reconn", "rej", "seen-ago"]
+    rows = []
+    workers = list(health.get("workers", []))
+    if sort == "verdict":
+        workers.sort(key=lambda w: _VERDICT_ORDER.get(w["verdict"], 9))
+    elif sort == "interarrival":
+        workers.sort(key=lambda w: -(w["push_interarrival_s"]["ewma"]
+                                     or 0.0))
+    elif sort == "gating":
+        workers.sort(key=lambda w: -w["gating"]["seconds"])
+    for w in workers:
+        inter = w["push_interarrival_s"]
+        stale = w["staleness"]
+        verdict = w["verdict"] + (" (done)" if w.get("done") else "")
+        rows.append([
+            str(w["worker"]), verdict, w["cause"] or "-",
+            str(w["grads"]), _fmt_s(inter.get("ewma")),
+            _fmt_s(inter.get("p95")),
+            "-" if stale.get("ewma") is None else f"{stale['ewma']:.2f}",
+            str(w["anomalies"]), str(w["gating"]["rounds"]),
+            f"{w['gating']['seconds']:.2f}", str(w["retries"]),
+            str(w["reconnects"]), str(w["frames_rejected"]),
+            _fmt_s(w.get("last_seen_age_s")),
+        ])
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    fmt = "  ".join(f"{{:<{w}}}" if i in (1, 2) else f"{{:>{w}}}"
+                    for i, w in enumerate(widths))
+    lines.append(fmt.format(*cols))
+    lines.append("  ".join("-" * w for w in widths))
+    for w, r in zip(workers, rows):
+        line = fmt.format(*r)
+        if color and w["verdict"] in _COLOR:
+            line = _COLOR[w["verdict"]] + line + _RESET
+        lines.append(line)
+    lines.append(f"[sort: {sort}]  q quit · p pause · s sort · r refresh")
+    return "\n".join(lines)
+
+
+class _Keys:
+    """Raw, non-blocking single-key reads from a tty (restores the
+    terminal on exit); a no-op stub off-tty so ``ps_top`` also runs
+    under pipes/CI."""
+
+    def __init__(self):
+        self.enabled = sys.stdin.isatty()
+        self._old = None
+        if self.enabled:
+            try:
+                import termios
+                import tty
+
+                self._termios = termios
+                self._old = termios.tcgetattr(sys.stdin.fileno())
+                tty.setcbreak(sys.stdin.fileno())
+            except Exception:
+                self.enabled = False
+
+    def poll(self) -> Optional[str]:
+        if not self.enabled:
+            return None
+        import select
+
+        r, _, _ = select.select([sys.stdin], [], [], 0)
+        if r:
+            return sys.stdin.read(1)
+        return None
+
+    def restore(self) -> None:
+        if self._old is not None:
+            self._termios.tcsetattr(
+                sys.stdin.fileno(), self._termios.TCSADRAIN, self._old)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("target",
+                    help="/health URL, host:port, or a bare local port")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no tty control)")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="exit after this many seconds (0 = forever)")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args(argv)
+    url = normalize_url(args.target)
+
+    if args.once:
+        print(render_table(fetch(url), color=False))
+        return 0
+
+    keys = _Keys()
+    sort_i = 0
+    paused = False
+    deadline = time.time() + args.duration if args.duration else None
+    frame = "(waiting for first scrape...)"
+    try:
+        while True:
+            if not paused:
+                try:
+                    frame = render_table(fetch(url),
+                                         sort=SORT_KEYS[sort_i],
+                                         color=not args.no_color)
+                except Exception as e:
+                    frame = f"scrape failed: {type(e).__name__}: {e}"
+            sys.stdout.write("\x1b[2J\x1b[H" + frame
+                             + ("\n[PAUSED]" if paused else "") + "\n")
+            sys.stdout.flush()
+            t_next = time.time() + args.interval
+            while time.time() < t_next:
+                k = keys.poll()
+                if k == "q":
+                    return 0
+                if k == "p":
+                    paused = not paused
+                    break
+                if k == "s":
+                    sort_i = (sort_i + 1) % len(SORT_KEYS)
+                    break
+                if k == "r":
+                    break
+                if deadline and time.time() > deadline:
+                    return 0
+                time.sleep(0.05)
+            if deadline and time.time() > deadline:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        keys.restore()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,12 @@ Spans aggregate into count / total / mean / p50 / p95 / max wall time
 per name; point events are counted. ``--by-worker`` splits rows per
 worker id — the straggler view. ``--json`` emits the same summary as a
 machine-readable dict (what ``bench.py`` embeds).
+
+Prometheus scrape snapshots (``*.prom`` — ``serve()`` drops
+``metrics.prom`` into the telemetry dir at exit) are parsed too,
+INCLUDING worker-labeled series (``ps_frames_rejected_total{worker="1"}``,
+``ps_worker_anomaly_total{...}`` — previously silently ignored): labeled
+instruments are tabulated per worker in their own section.
 """
 
 from __future__ import annotations
@@ -39,16 +45,46 @@ def collect_files(paths: List[str]) -> List[str]:
     for p in paths:
         if os.path.isdir(p):
             # faults-*.jsonl are injected-fault event logs (resilience
-            # layer), not recorder files — their rows have no name/kind
+            # layer) and beacon-*.jsonl are health-monitor side channels
+            # — not recorder files (their rows have no name/kind)
             out.extend(sorted(
                 f for f in glob.glob(os.path.join(p, "*.jsonl"))
-                if not os.path.basename(f).startswith("faults-")
+                if not os.path.basename(f).startswith(
+                    ("faults-", "beacon-"))
             ))
+            out.extend(sorted(glob.glob(os.path.join(p, "*.prom"))))
         else:
             out.append(p)
     if not out:
-        raise SystemExit(f"no .jsonl files found under {paths}")
+        raise SystemExit(f"no .jsonl/.prom files found under {paths}")
     return out
+
+
+def parse_prometheus_text(text: str) -> List[Dict[str, Any]]:
+    """Prometheus exposition text → ``[{name, labels, value}]`` rows
+    (``# HELP``/``# TYPE`` skipped; label values unescaped enough for
+    the simple labels this stack emits)."""
+    import re
+
+    series: List[Dict[str, Any]] = []
+    line_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)\s*$")
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = line_re.match(line)
+        if not m:
+            continue
+        name, labels_text, raw = m.groups()
+        try:
+            value = float(raw.replace("+Inf", "inf"))
+        except ValueError:
+            continue
+        labels = dict(label_re.findall(labels_text)) if labels_text else {}
+        series.append({"name": name, "labels": labels, "value": value})
+    return series
 
 
 def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
@@ -57,7 +93,19 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     spans: Dict[Any, List[float]] = {}
     events: Dict[Any, int] = {}
     meta: List[Dict[str, Any]] = []
+    labeled: List[Dict[str, Any]] = []
     for path in files:
+        if path.endswith(".prom"):
+            with open(path) as f:
+                for s in parse_prometheus_text(f.read()):
+                    # the per-worker labeled series (PR 3's rejection
+                    # counters, the diagnosis layer's anomaly/gating/
+                    # health instruments) are the tabulation target;
+                    # unlabeled totals already ride the metrics dicts
+                    if s["labels"]:
+                        labeled.append({"file": os.path.basename(path),
+                                        **s})
+            continue
         m, rows = load_jsonl(path)
         if m:
             meta.append({"file": os.path.basename(path),
@@ -96,6 +144,13 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
             {"name": k[0], "worker": k[1], "count": n}
             for k, n in sorted(events.items(), key=lambda kv: -kv[1])
         ],
+        # worker-labeled (and any other labeled) instrument series from
+        # *.prom scrape snapshots, histogram bucket rows excluded (the
+        # per-worker counters are the per-worker story)
+        "labeled_metrics": sorted(
+            (s for s in labeled if "le" not in s["labels"]),
+            key=lambda s: (s["name"], sorted(s["labels"].items())),
+        ),
         "dropped_total": sum(m.get("dropped") or 0 for m in meta),
     }
 
@@ -126,6 +181,15 @@ def format_table(summary: Dict[str, Any]) -> str:
         for e in summary["events"]:
             who = f" [worker {e['worker']}]" if e["worker"] is not None else ""
             lines.append(f"  {e['name']}{who}: {e['count']}")
+    if summary.get("labeled_metrics"):
+        lines.append("")
+        lines.append("labeled metrics (scrape snapshot):")
+        for s in summary["labeled_metrics"]:
+            labels = ",".join(f"{k}={v}"
+                              for k, v in sorted(s["labels"].items()))
+            v = s["value"]
+            v_txt = str(int(v)) if float(v).is_integer() else f"{v:.6g}"
+            lines.append(f"  {s['name']}{{{labels}}}: {v_txt}")
     if summary["dropped_total"]:
         lines.append("")
         lines.append(
