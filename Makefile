@@ -48,6 +48,16 @@ diag-smoke:
 	JAX_PLATFORMS=cpu python tools/diag_smoke.py
 	python tools/telemetry_smoke.py
 
+# Numerics gate (beside diag-smoke; tests/test_numerics.py covers the
+# same paths in the default `make test` run): a NaN-injecting worker
+# must be quarantined — exactly that worker — with a parseable
+# postmortem on disk, online codec-fidelity probes must report nonzero
+# rel-error for sign and ~0 for identity, and the fused gradient
+# statistics must re-pass the <=5% telemetry-overhead budget
+# (tools/telemetry_smoke.py --numerics runs inside the smoke).
+numerics-smoke:
+	JAX_PLATFORMS=cpu python tools/numerics_smoke.py
+
 bench:
 	python bench.py
 
@@ -70,4 +80,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke

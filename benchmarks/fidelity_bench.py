@@ -1,0 +1,143 @@
+"""Codec-fidelity table: what each codec actually does to real gradients.
+
+The offline half of the online `Codec.fidelity_probe` story (the online
+half runs inside the async workers, ``telemetry/numerics.py``): one real
+backprop of resnet18 / BERT, then every registered codec probed per leaf
+and aggregated over the whole gradient tree — decode-after-encode
+relative L2 error, cosine similarity, and achieved bits-per-parameter.
+This is the measured form of the compression-utility trade the
+reference's ``codings`` hook existed to explore: the sanity anchor
+(identity ≈ 0 error), the cheap-cast tier (bf16/f16), and how much of
+the gradient direction each aggressive codec actually keeps.
+
+Run: ``python benchmarks/fidelity_bench.py [--models resnet18,bert]
+[--bert-config base|tiny]``. Emits one JSON row per (model, codec) and
+appends to ``benchmarks/results/fidelity_<model>.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_ps_mpi_tpu.codecs import get_codec
+
+#: the probed configurations — the registry's full compression curve
+CODECS = [
+    ("identity", {}),
+    ("bf16", {}),
+    ("f16", {}),
+    ("int8", {}),
+    ("qsgd", {}),
+    ("sign", {"use_pallas": False}),
+    ("terngrad", {}),
+    ("topk", {"fraction": 0.01}),
+    ("randomk", {"fraction": 0.01}),
+    ("threshold", {}),
+    ("powersgd", {"rank": 2}),
+    ("ef", {"inner_name": "topk", "fraction": 0.01}),
+]
+
+
+def tree_fidelity(code, grads, seed: int = 0) -> dict:
+    """Per-leaf encode→decode roundtrip aggregated over the whole tree:
+    rel error from total error energy, cosine from total dot/norms,
+    bits/param from the summed payload bits — per-tensor codecs keep
+    their per-leaf statistics, exactly as the train step runs them."""
+    err2 = g2 = r2 = dot = 0.0
+    bits = 0
+    n = 0
+    key = jax.random.key(seed)
+    for i, g in enumerate(jax.tree.leaves(grads)):
+        state = code.init_state(g.shape, g.dtype)
+        rng = jax.random.fold_in(key, i) if code.needs_rng else None
+        payload, _ = code.encode(g, state, rng)
+        rec = code.decode(payload, g.shape, g.dtype)
+        gf = np.asarray(g, np.float64).reshape(-1)
+        rf = np.asarray(rec, np.float64).reshape(-1)
+        err2 += float(np.sum((rf - gf) ** 2))
+        g2 += float(np.sum(gf * gf))
+        r2 += float(np.sum(rf * rf))
+        dot += float(np.sum(rf * gf))
+        bits += code.payload_bits(g.shape, g.dtype)
+        n += gf.size
+    return {
+        "rel_error": (err2 / max(g2, 1e-300)) ** 0.5,
+        "cosine": dot / max((r2 * g2) ** 0.5, 1e-300),
+        "bits_per_param": bits / n,
+        "params": n,
+    }
+
+
+def resnet18_grads(batch: int = 8):
+    from pytorch_ps_mpi_tpu.models import ResNet18
+
+    model = ResNet18(num_classes=10, small_inputs=True)
+    k = jax.random.key(0)
+    x = jax.random.normal(k, (batch, 32, 32, 3))
+    y = jax.random.randint(jax.random.fold_in(k, 1), (batch,), 0, 10)
+    params = model.init(jax.random.fold_in(k, 2), x[:1])
+
+    def loss_fn(p, xx, yy):
+        logits = model.apply(p, xx)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, yy[:, None], axis=1))
+
+    return jax.jit(jax.grad(loss_fn))(params, x, y)
+
+
+def bert_grads(config: str = "base", batch: int = 4, seq: int = 128):
+    from pytorch_ps_mpi_tpu.models.bert import BertConfig, BertMLM, mlm_loss
+
+    cfg = (BertConfig.base() if config == "base" else BertConfig.tiny())
+    model = BertMLM(cfg)
+    k = jax.random.key(0)
+    tokens = jax.random.randint(k, (batch, seq), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.fold_in(k, 1), (batch, seq), 0,
+                                 cfg.vocab_size)
+    mask = jax.random.bernoulli(jax.random.fold_in(k, 2), 0.15, (batch, seq))
+    params = model.init(jax.random.fold_in(k, 3), tokens[:1])
+
+    def loss_fn(p):
+        return mlm_loss(model.apply(p, tokens), targets, mask)
+
+    return jax.jit(jax.grad(loss_fn))(params)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--models", default="resnet18,bert")
+    ap.add_argument("--bert-config", default="base",
+                    choices=["base", "tiny"])
+    args = ap.parse_args(argv)
+    os.makedirs("benchmarks/results", exist_ok=True)
+    for model in args.models.split(","):
+        if model == "resnet18":
+            grads, label = resnet18_grads(), "resnet18"
+        elif model == "bert":
+            grads = bert_grads(args.bert_config)
+            label = f"bert-{args.bert_config}"
+        else:
+            raise SystemExit(f"unknown model {model!r}")
+        out = f"benchmarks/results/fidelity_{label}.jsonl"
+        with open(out, "a") as f:
+            for name, kw in CODECS:
+                row = {"bench": "codec_fidelity", "model": label,
+                       "codec": name, "codec_kw": kw,
+                       "backend": jax.default_backend()}
+                row.update(tree_fidelity(get_codec(name, **kw), grads))
+                print(json.dumps(row), flush=True)
+                f.write(json.dumps(row) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

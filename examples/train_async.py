@@ -97,6 +97,22 @@ def main(argv=None):
                          "run (implies --health-port 0; with --supervise "
                          "pass an explicit --health-port so the pinned "
                          "port survives server restarts)")
+    ap.add_argument("--numerics", action="store_true",
+                    help="arm the NumericsMonitor: every consumed push "
+                         "is validated (NaN/Inf counted per worker, the "
+                         "worker quarantined), grad-norm/update-ratio "
+                         "stats flow into /metrics + /health, workers "
+                         "probe codec fidelity online, and a NaN or "
+                         "norm spike writes a postmortem-*.json into "
+                         "the numerics dir (--telemetry-dir when set)")
+    ap.add_argument("--numerics-policy", choices=["skip", "zero", "abort"],
+                    default="skip",
+                    help="what happens to a non-finite push: skip it "
+                         "(default), zero its bad elements and apply "
+                         "the rest, or abort the run with a postmortem")
+    ap.add_argument("--numerics-probe-every", type=int, default=25,
+                    help="codec-fidelity probe / trajectory-row cadence "
+                         "(steps)")
     ap.add_argument("--no-frame-check", action="store_true",
                     help="disable the self-verifying wire frames (CRC + "
                          "config fingerprint on every push; on by default "
@@ -197,13 +213,26 @@ def main(argv=None):
 
         os.makedirs(args.telemetry_dir, exist_ok=True)
         # a reused dir must not leak a previous run's files into this
-        # run's merged trace/report (worker counts can differ)
+        # run's merged trace/report (worker counts can differ) —
+        # numerics trajectories and postmortems included
         for stale in glob.glob(os.path.join(args.telemetry_dir, "*.jsonl")) \
-                + glob.glob(os.path.join(args.telemetry_dir, "trace.json")):
+                + glob.glob(os.path.join(args.telemetry_dir, "trace.json")) \
+                + glob.glob(os.path.join(args.telemetry_dir,
+                                         "postmortem-*.json")):
             os.remove(stale)
         cfg["telemetry_dir"] = args.telemetry_dir
         if args.metrics_port is None:
             args.metrics_port = 0
+    if args.numerics:
+        import tempfile
+
+        cfg["numerics"] = True
+        # one dir, both ends: workers append probe rows here, the server
+        # tails them and drops postmortems beside them
+        cfg["numerics_dir"] = (args.telemetry_dir
+                               or tempfile.mkdtemp(prefix="ps_numerics_"))
+        cfg["numerics_kw"] = {"policy": args.numerics_policy,
+                              "probe_every": args.numerics_probe_every}
     if args.metrics_port is not None:
         cfg["metrics_port"] = args.metrics_port
     if args.ps_top and args.health_port is None:
@@ -372,12 +401,14 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     from pytorch_ps_mpi_tpu.telemetry import export_chrome_trace, load_jsonl
     from tools.telemetry_report import format_table, summarize
 
-    # faults-*.jsonl are injected-fault logs (resilience layer) and
-    # beacon-*.jsonl are health-monitor side channels, not
-    # flight-recorder files — exclude them from the merged trace
+    # faults-*.jsonl are injected-fault logs (resilience layer),
+    # beacon-*.jsonl are health-monitor side channels, and
+    # numerics-*.jsonl are codec-fidelity/grad-norm trajectories — not
+    # flight-recorder files, so exclude them from the merged trace
+    # (telemetry_report's dir mode routes them to its numerics section)
     files = sorted(f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
                    if not os.path.basename(f).startswith(
-                       ("faults-", "beacon-")))
+                       ("faults-", "beacon-", "numerics-")))
     events = []
     for f in files:
         events.extend(load_jsonl(f)[1])

@@ -34,6 +34,55 @@ import numpy as np
 
 PyTree = Any
 
+#: accepted values of the lossy codecs' ``nonfinite=`` constructor kwarg
+NONFINITE_MODES = ("propagate", "zero", "raise")
+
+
+def check_nonfinite_mode(mode: str) -> str:
+    """Constructor-time validation of the ``nonfinite=`` kwarg — a typo
+    must fail where the config was written, not at the first encode on
+    a worker mid-startup."""
+    if mode not in NONFINITE_MODES:
+        raise ValueError(
+            f"nonfinite must be one of {NONFINITE_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def guard_nonfinite(flat: jax.Array, mode: str, codec_name: str) -> jax.Array:
+    """The non-finite input guard the lossy codecs share.
+
+    ``mode`` is the codec's ``nonfinite=`` kwarg:
+
+    - ``"propagate"`` — legacy behavior: NaN/Inf flow into the payload
+      statistics (sign's mean|g|, terngrad's max|g|, qsgd's norm all go
+      NaN and poison every decoded element) undetected.
+    - ``"zero"`` — sanitize: non-finite elements become 0 before any
+      statistic or quantization, so one bad element can no longer wipe
+      the whole payload. jit-safe (a ``where``, fused for free).
+    - ``"raise"`` — eager (concrete-array) encodes raise
+      ``FloatingPointError`` on any non-finite input — the fail-fast
+      debugging mode. Under tracing a data-dependent raise is
+      impossible, so traced encodes degrade to the ``"zero"`` sanitize
+      (the payload stays finite either way); pair with the serve loop's
+      NumericsMonitor for the online detection story.
+    """
+    if mode == "propagate":
+        return flat
+    if mode not in NONFINITE_MODES:
+        raise ValueError(
+            f"nonfinite must be one of {NONFINITE_MODES}, got {mode!r}"
+        )
+    if mode == "raise" and not isinstance(flat, jax.core.Tracer):
+        bad = int(jnp.sum(~jnp.isfinite(flat)))
+        if bad:
+            raise FloatingPointError(
+                f"{codec_name}.encode: {bad} non-finite gradient "
+                "element(s) in input (nonfinite='raise')"
+            )
+        return flat
+    return jnp.where(jnp.isfinite(flat), flat, jnp.zeros_like(flat))
+
 
 class Codec:
     """Base codec: subclasses override encode/decode (+ optionally
@@ -94,6 +143,41 @@ class Codec:
             int(np.prod(leaf.shape)) * leaf.dtype.itemsize * 8
             for leaf in jax.tree.leaves(payload)
         )
+
+    def fidelity_probe(self, grad: jax.Array, state: PyTree = (),
+                       rng: Optional[jax.Array] = None) -> Dict[str, float]:
+        """Decode-after-encode fidelity of THIS codec on a real gradient:
+        what the wire actually does to the values it carries, measured
+        online instead of assumed from the paper. Returns relative L2
+        reconstruction error, cosine similarity, and achieved
+        bits-per-parameter — the three numbers the compression-utility
+        literature gates wins on. Read-only: codec state is consulted
+        (error feedback probes through its residual memory) but NEVER
+        updated, so a probe is safe mid-training at any cadence.
+
+        ``state`` defaults to a fresh ``init_state``; stochastic codecs
+        need ``rng`` (a default key is used when omitted). Identity-like
+        codecs report ~0 error / ~1 cosine — the sanity anchor the
+        numerics smoke asserts."""
+        grad = jnp.asarray(grad)
+        if not jax.tree.leaves(state):
+            state = self.init_state(grad.shape, grad.dtype)
+        if rng is None and self.needs_rng:
+            rng = jax.random.key(0)
+        payload, _ = self.encode(grad, state, rng)
+        rec = self.decode(payload, grad.shape, grad.dtype)
+        g = grad.astype(jnp.float32).reshape(-1)
+        r = rec.astype(jnp.float32).reshape(-1)
+        gn = jnp.linalg.norm(g)
+        rel = jnp.linalg.norm(r - g) / jnp.maximum(gn, 1e-30)
+        cos = jnp.dot(r, g) / jnp.maximum(jnp.linalg.norm(r) * gn, 1e-30)
+        n = int(np.prod(grad.shape)) if grad.shape else 1
+        return {
+            "rel_error": float(rel),
+            "cosine": float(cos),
+            "bits_per_param": self.payload_bits(grad.shape, grad.dtype) / n,
+            "grad_norm": float(gn),
+        }
 
 
 _REGISTRY: Dict[str, Type[Codec]] = {}

@@ -41,3 +41,21 @@ class ErrorFeedback(Codec):
 
     def payload_bits(self, shape, dtype):
         return self.inner.payload_bits(shape, dtype)
+
+    def fidelity_probe(self, grad, state=(), rng=None):
+        """Probe the INNER codec on the error-corrected gradient (what
+        actually rides the wire: grad + memory) and additionally export
+        the residual-memory norm — EF's correctness hinges on that
+        residual staying bounded (Karimireddy et al. 2019, Thm. 2), so
+        it is the one extra number worth a time series. Read-only, like
+        the base probe: the memory is consulted, never updated."""
+        import jax
+        import numpy as np
+
+        if not jax.tree.leaves(state):
+            state = self.init_state(grad.shape, grad.dtype)
+        corrected = grad + state["memory"]
+        out = self.inner.fidelity_probe(corrected, state["inner"], rng)
+        mem = np.asarray(state["memory"], np.float32)
+        out["ef_residual_norm"] = float(np.linalg.norm(mem.reshape(-1)))
+        return out

@@ -14,7 +14,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    check_nonfinite_mode,
+    guard_nonfinite,
+    register_codec,
+)
 
 
 @register_codec("int8")
@@ -32,11 +37,16 @@ class Int8Codec(Codec):
     # per-BUCKET absmax scale instead of per-tensor (coarser scale group)
     bucketable = True
 
-    def __init__(self, use_pallas: bool = False):
+    def __init__(self, use_pallas: bool = False,
+                 nonfinite: str = "propagate"):
         self.use_pallas = use_pallas
+        # one Inf element drives the absmax scale to Inf (every other
+        # element quantizes to 0); a NaN scale poisons the whole decode —
+        # guard per codecs/base.guard_nonfinite
+        self.nonfinite = check_nonfinite_mode(nonfinite)
 
     def encode(self, grad, state=(), rng=None):
-        flat = grad.reshape(-1)
+        flat = guard_nonfinite(grad.reshape(-1), self.nonfinite, "Int8Codec")
         if self.use_pallas:
             from pytorch_ps_mpi_tpu.ops.quant_pallas import quantize_int8
             q, scale = quantize_int8(flat)
@@ -78,16 +88,20 @@ class QSGDCodec(Codec):
     # per-bucket norm instead of per-tensor under bucketing; still unbiased
     bucketable = True
 
-    def __init__(self, levels: int = 16):
+    def __init__(self, levels: int = 16, nonfinite: str = "propagate"):
         # levels must fit the int8 payload: encode stores q in [-levels,
         # levels], so levels > 127 would silently overflow int8.
         if not 1 <= levels <= 127:
             raise ValueError(f"levels must be in [1, 127], got {levels}")
         self.levels = int(levels)
+        # a non-finite element makes the L2 norm NaN/Inf, turning every
+        # quantized magnitude into garbage (NaN probabilities round the
+        # stochastic rounding to 0) — guard per codecs/base.guard_nonfinite
+        self.nonfinite = check_nonfinite_mode(nonfinite)
 
     def encode(self, grad, state=(), rng=None):
         assert rng is not None, "QSGDCodec needs a PRNG key"
-        flat = grad.reshape(-1)
+        flat = guard_nonfinite(grad.reshape(-1), self.nonfinite, "QSGDCodec")
         norm = jnp.maximum(jnp.linalg.norm(flat), 1e-12)
         scaled = jnp.abs(flat) / norm * self.levels          # in [0, levels]
         lower = jnp.floor(scaled)

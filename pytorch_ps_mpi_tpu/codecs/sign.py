@@ -11,7 +11,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    check_nonfinite_mode,
+    guard_nonfinite,
+    register_codec,
+)
 
 
 def _packed_len(n: int) -> int:
@@ -33,14 +38,18 @@ class SignCodec(Codec):
     # family, coarser normalization group — documented semantics change)
     bucketable = True
 
-    def __init__(self, use_pallas: bool = True):
+    def __init__(self, use_pallas: bool = True, nonfinite: str = "propagate"):
         self.use_pallas = use_pallas
+        # non-finite input guard: a single NaN makes the mean|g| scale
+        # NaN, which decodes EVERY element to NaN — "zero" sanitizes,
+        # "raise" fails fast on eager encodes (codecs/base.guard_nonfinite)
+        self.nonfinite = check_nonfinite_mode(nonfinite)
 
     def _pallas_ok(self, n: int) -> bool:
         return self.use_pallas and n > 0 and n % 1024 == 0
 
     def encode(self, grad, state=(), rng=None):
-        flat = grad.reshape(-1)
+        flat = guard_nonfinite(grad.reshape(-1), self.nonfinite, "SignCodec")
         n = flat.shape[0]
         scale = jnp.mean(jnp.abs(flat))
         if self._pallas_ok(n):

@@ -18,7 +18,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    check_nonfinite_mode,
+    guard_nonfinite,
+    register_codec,
+)
 
 _WEIGHTS = (1, 4, 16, 64)  # base-4 digit weights, 4 ternary digits per byte
 
@@ -34,9 +39,16 @@ class TernGradCodec(Codec):
     # unbiasedness is preserved (scale is shared, Bernoulli stays exact)
     bucketable = True
 
+    def __init__(self, nonfinite: str = "propagate"):
+        # a NaN/Inf element drives the max|g| scale non-finite AND makes
+        # its keep-probability NaN (uniform < NaN is False, so the digit
+        # silently collapses to 0) — guard per codecs/base.guard_nonfinite
+        self.nonfinite = check_nonfinite_mode(nonfinite)
+
     def encode(self, grad, state=(), rng=None):
         assert rng is not None, "TernGradCodec needs a PRNG key"
-        g = grad.astype(jnp.float32)
+        g = guard_nonfinite(grad.astype(jnp.float32), self.nonfinite,
+                            "TernGradCodec")
         n = int(np.prod(g.shape)) if g.shape else 1
         scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
         # draw the Bernoulli randoms in the gradient's NATIVE shape and

@@ -267,6 +267,22 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
 
     inj = FaultInjector.from_cfg(cfg, role=worker_id)
     push_timeout = float(cfg.get("push_timeout", 60.0))
+    prober = None
+    probe_every = 0
+    if cfg.get("numerics_dir") and getattr(w, "wire", None) is not None:
+        # the codec-fidelity half of the numerics layer: decode-after-
+        # encode probes must run HERE, on the pre-encode gradient — the
+        # server only ever sees decoded values, and re-encoding those
+        # measures ~0 error for sign-like codecs. Rows are tailed live
+        # by the server-side NumericsMonitor.
+        from pytorch_ps_mpi_tpu.telemetry.numerics import (
+            NUMERICS_KNOBS,
+            ProbeWriter,
+        )
+
+        probe_every = max(1, int((cfg.get("numerics_kw") or {}).get(
+            "probe_every", NUMERICS_KNOBS["probe_every"])))
+        prober = ProbeWriter(cfg["numerics_dir"], worker_id)
     beacon = None
     if cfg.get("health_dir"):
         # the online-diagnosis side channel: one appended JSONL row per
@@ -281,7 +297,7 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     try:
         for step in range(steps):
             t_step0 = time.monotonic()
-            drop = duplicate = False
+            drop = duplicate = poison = False
             if inj is not None:
                 for f in inj.faults_at(step):
                     kind = f["kind"]
@@ -301,6 +317,12 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                     elif kind == "duplicate":
                         inj.fire(f)
                         duplicate = True
+                    elif kind == "nan":
+                        # numerics chaos: poison this step's gradient
+                        # with NaNs BEFORE encode — the quarantine leg's
+                        # deterministic test vector
+                        inj.fire(f)
+                        poison = True
                     elif kind == "corrupt":
                         # fires when the tampered push actually happens
                         tamper = inj.make_tamper(f)
@@ -332,6 +354,17 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
             if rec is not None:
                 rec.event("worker.grad", kind="span", ts=t0, dur=compute_s,
                           step=step, version=version)
+            if poison:
+                import jax.numpy as jnp
+
+                grads = jax.tree.map(
+                    lambda g: jnp.full_like(g, jnp.nan), grads
+                )
+            if prober is not None and step % probe_every == 0:
+                try:
+                    prober.write(step, w.wire.probe_fidelity(grads))
+                except Exception:
+                    pass  # a probe must never take a worker down
             straggle_s = 0.0
             if slow_ms:
                 t0 = time.monotonic()
@@ -369,6 +402,8 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     finally:
         w.close()
         _dump_recorder(cfg, rec, f"worker-{worker_id}.jsonl")
+        if prober is not None:
+            prober.close()
         if beacon is not None:
             beacon.close(retries=getattr(w, "retries", 0),
                          reconnects=getattr(w, "reconnects", 0))
@@ -496,6 +531,19 @@ def serve(
     the scrape registry additionally carries ``ps_worker_anomaly_total``,
     ``ps_round_gating_seconds`` and ``ps_worker_health`` per worker.
 
+    Numerics observability (``telemetry.numerics``): ``numerics: true``
+    (or ``numerics_dir`` / ``numerics_kw``) arms a
+    :class:`NumericsMonitor` — every consumed push is validated BEFORE
+    it can touch the optimizer (non-finite pushes counted per worker
+    through ``_reject_frame``, the worker quarantined, the push skipped
+    / sanitized / run-aborting per ``numerics_kw["policy"]``), grad-norm
+    and update-to-weight-ratio statistics flow into the canonical
+    metrics and ``/health``'s ``numerics`` section, workers append
+    codec-fidelity probe rows into ``numerics_dir`` (tailed at tick
+    cadence), and a NaN or norm spike writes a ``postmortem-*.json``
+    divergence capture. An abort lands in the returned metrics as
+    ``numerics_abort``.
+
     Resilience hooks:
 
     - ``on_tick``: called from INSIDE the loop (same thread as every
@@ -574,6 +622,18 @@ def serve(
         # attaches itself to server.health_monitor (the /health route)
         # and registers its instruments on the scrape registry
         monitor = HealthMonitor(server, cfg)
+    numon = None
+    if (cfg.get("numerics") or cfg.get("numerics_dir")
+            or cfg.get("numerics_kw")):
+        from pytorch_ps_mpi_tpu.telemetry.numerics import NumericsMonitor
+
+        # attaches itself to server.numerics_monitor: the canonical
+        # metrics grow grad_norm / nonfinite_total / update_ratio /
+        # codec_rel_error / ef_residual_norm, /health gains the
+        # "numerics" section, and every consumed push is validated
+        # below BEFORE it can touch the optimizer
+        numon = NumericsMonitor(server, cfg)
+    numerics_probe_every = int(numon.knobs["probe_every"]) if numon else 0
     metrics_http_port = None
     http_port = cfg.get("metrics_port")
     if http_port is None:
@@ -630,6 +690,8 @@ def serve(
     round_t0 = time.perf_counter()
     next_tick = 0.0
     draining = False
+    numerics_stop = False
+    next_numerics_probe = 0  # applied count of the next update-ratio probe
 
     def _fire_server_faults() -> None:
         """Server-targeted faults fire when the global applied count
@@ -688,16 +750,29 @@ def serve(
     def _try_complete_round() -> bool:
         """Complete one sync round over the ACTIVE (not declared-dead)
         workers if each has a queued gradient; degraded rounds (fewer
-        than n_workers contributions) are counted, never hung on."""
+        than n_workers contributions) are counted, never hung on.
+        Numerics-quarantined workers under the ``skip`` policy are
+        excluded too: their pushes never enter ``pending``, so waiting
+        on them would hang the barrier exactly like a dead worker —
+        and unlike one, their socket stays open."""
         nonlocal params, state, applied, degraded_rounds, wait_t0, round_t0
+        nonlocal next_numerics_probe
         active = [w for w in range(n_workers) if w not in dead_workers]
+        if numon is not None and numon.knobs["policy"] == "skip":
+            active = [w for w in active if not numon.is_quarantined(w)]
         if not active or any(not pending[w] for w in active):
             return False
         up_t0 = time.perf_counter()
         batch_grads = [pending[w].popleft() for w in active]
         summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
+        probe = numon is not None and applied >= next_numerics_probe
+        old_params = params if probe else None
         params, state = update(params, summed, state)
         applied += len(batch_grads)
+        if probe:
+            numon.observe_update(old_params, params,
+                                 applied_before + applied)
+            next_numerics_probe = applied + numerics_probe_every
         if monitor is not None:
             # bill the round's critical path to the last-ready worker,
             # then reopen the book: a fast worker with another gradient
@@ -725,6 +800,8 @@ def serve(
                 on_tick()
             if monitor is not None:
                 monitor.tick()  # tail worker beacons, same thread
+            if numon is not None:
+                numon.tick()  # tail worker codec-fidelity probes
             if stop_when is not None and not draining and stop_when():
                 draining = True  # consume what's queued, then return
             if sync_barrier and now - round_t0 > degrade_after:
@@ -746,6 +823,23 @@ def serve(
                       step=applied, version=grad_version)
         if monitor is not None:
             monitor.observe_grad(wid, staleness, wait_s)
+        if numon is not None:
+            # numerics validation BEFORE the gradient can touch the
+            # optimizer: count/quarantine non-finite pushes, then let
+            # the policy decide the frame's fate
+            action = numon.observe_push(wid, grad, applied_before + applied)
+            if action == "abort":
+                numerics_stop = True
+                break
+            if action == "skip":
+                wait_t0 = time.perf_counter()
+                continue
+            if action == "zero":
+                from pytorch_ps_mpi_tpu.telemetry.numerics import (
+                    sanitize_tree,
+                )
+
+                grad = sanitize_tree(grad)
         if sync_barrier:
             # synchronous oracle: a round completes when every active
             # worker has at least one queued gradient; one per worker is
@@ -759,13 +853,30 @@ def serve(
                 wait_t0 = time.perf_counter()
         else:
             up_t0 = time.perf_counter()
+            probe = numon is not None and applied >= next_numerics_probe
+            old_params = params if probe else None
             params, state = update(params, grad, state)
             applied += 1
+            if probe:
+                # ||dp||/||p|| at probe cadence only — the old params
+                # are retained just long enough for one jitted diff
+                numon.observe_update(old_params, params,
+                                     applied_before + applied)
+                next_numerics_probe = applied + numerics_probe_every
             _post_update(up_t0)
             wait_t0 = time.perf_counter()
     wall = time.perf_counter() - t0
     if cadence:  # final state always captured, whatever the stop reason
         cadence.final_save(params, state, server, applied_before + applied)
+    if numon is not None:
+        # drain the last worker probe rows BEFORE any metrics snapshot:
+        # server.metrics() (and the /health snapshot below) read the
+        # probe-derived gauges, and the workers' final rows typically
+        # land after the loop's last tick
+        numon.tick()
+        # one closing trajectory row so offline tooling sees the FINAL
+        # grad-norm/nonfinite state, not the last probe-cadence sample
+        numon._trajectory_row(applied_before + applied)
     m = dict(server.metrics())
     m.update(
         applied=float(applied),
@@ -786,6 +897,11 @@ def serve(
         m["metrics_port"] = metrics_http_port
     if monitor is not None:
         m["health"] = monitor.snapshot()
+    if numon is not None:
+        m["numerics"] = numon.snapshot()
+        if numerics_stop:
+            m["numerics_abort"] = numon.aborted
+        numon.close()
     if cfg.get("telemetry_dir"):
         # final scrape snapshot for offline tooling: telemetry_report
         # tabulates the labeled series (per-worker rejections, anomaly

@@ -249,6 +249,29 @@ class CodecWire:
         pack_arrays_into(buf, flat)
         return buf
 
+    def probe_fidelity(self, grad_tree: PyTree) -> Dict[str, Any]:
+        """Online codec-fidelity probe on the LARGEST wire unit (the
+        sampled bucket, or the biggest leaf on the per-leaf wire):
+        decode-after-encode relative L2 error, cosine similarity, and
+        achieved bits-per-parameter via ``Codec.fidelity_probe``.
+        Read-only — the wire's codec states and PRNG stream are
+        untouched (the probe folds its own fixed key), so probing at any
+        cadence never perturbs what actually ships."""
+        import jax
+
+        grad_leaves = self.treedef.flatten_up_to(grad_tree)
+        units = (
+            self.plan.pack_leaves(grad_leaves) if self.plan is not None
+            else grad_leaves
+        )
+        i = max(range(len(units)),
+                key=lambda j: int(np.prod(self.shapes[j]) or 1))
+        rng = jax.random.key(0x9E3779B9) if self.code.needs_rng else None
+        out = self.code.fidelity_probe(units[i], self._states[i], rng)
+        out["unit"] = i
+        out["codec"] = type(self.code).__name__
+        return out
+
     def decode_from_bytes(self, buf) -> PyTree:
         """Decode a wire buffer (``bytes``, ``bytearray``, ``memoryview``
         or uint8 ndarray) back into the template-structured gradient tree.

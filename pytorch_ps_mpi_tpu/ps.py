@@ -60,7 +60,7 @@ from pytorch_ps_mpi_tpu.bucketing import (
     plan_buckets,
     unflatten_from_buckets,
 )
-from pytorch_ps_mpi_tpu.codecs import Codec, IdentityCodec
+from pytorch_ps_mpi_tpu.codecs import Codec, ErrorFeedback, IdentityCodec
 from pytorch_ps_mpi_tpu.telemetry import get_recorder
 from pytorch_ps_mpi_tpu.mesh import DATA_AXIS, make_mesh
 from pytorch_ps_mpi_tpu.optim import (
@@ -640,6 +640,19 @@ class MPI_PS:
         then apply per bucket); per-tensor codecs (PowerSGD, top-k,
         absolute-k randomk) keep the per-leaf path automatically. ``0`` (default) preserves per-leaf behavior
         exactly. Requires pure-DP layouts (no ``param_specs``).
+      numerics: if True, fuse on-device gradient statistics into the
+        lowered step programs (``telemetry.numerics``): global finite
+        grad norm, NaN/Inf element count, update-to-weight ratio
+        ``||dp||/||p||``, per-BUCKET grad norms when ``bucket_mb`` is
+        active, and the error-feedback residual norm when ``code`` is an
+        :class:`~pytorch_ps_mpi_tpu.codecs.ErrorFeedback`. All
+        reductions run inside the jit (XLA fuses them into the step for
+        ~free) and land in the returned metrics dict as ``grad_norm`` /
+        ``nonfinite_total`` / ``update_ratio`` / ``bucket_grad_norms``
+        / ``ef_residual_norm`` — one tiny stats vector fetched per
+        step. The fused and accumulation paths compute them;
+        ``instrument=True`` stages and ``run_steps`` (one opaque scanned
+        program) do not. Requires pure-DP layouts (no ``param_specs``).
       batch_spec: optional PartitionSpec for the batch pytree's leaves
         (default ``P(axis_name)``: leading dim split over the data
         axis). With model parallelism e.g. ``P('data')`` replicates the
@@ -680,6 +693,7 @@ class MPI_PS:
         donate_buffers: bool = False,
         clip_norm: float = 0.0,
         bucket_mb: float = 0.0,
+        numerics: bool = False,
         param_specs: Optional[PyTree] = None,
         batch_spec=None,
         loss_reduction: Optional[str] = None,
@@ -824,6 +838,14 @@ class MPI_PS:
             self._bucket_plan.bucket_templates()
             if self._bucket_plan is not None else None
         )
+        # -- fused numerics statistics (numerics=True) --------------------
+        self.numerics = bool(numerics)
+        if self.numerics and self._model_parallel:
+            raise NotImplementedError(
+                "numerics=True requires pure-DP layouts: model-sharded "
+                "leaves would need per-leaf reduction axis sets for the "
+                "global norms. Drop param_specs or set numerics=False"
+            )
         self.batch_spec = batch_spec if batch_spec is not None else P(axis_name)
         if self._model_parallel and instrument:
             raise NotImplementedError(
@@ -1251,6 +1273,75 @@ class MPI_PS:
         )
         return new_params, new_opt_state, new_codec_state
 
+    def _numerics_vec(self, old_params, new_params, grads, codec_state):
+        """On-device numerics statistics, computed INSIDE the lowered
+        step (runs under shard_map; XLA fuses the reductions into the
+        surrounding program). Returns one f32 vector::
+
+            [grad_sumsq, nonfinite, update_sumsq, param_sumsq,
+             ef_residual_sumsq, *per_bucket_sumsq]
+
+        grad sums are finite-masked (a NaN element must not erase the
+        healthy part's norm) and psum'd across the data axis — the
+        GLOBAL gradient energy and total NaN/Inf count; update/param
+        sums read the replicated params, no collective needed."""
+        def finite_sumsq(x):
+            xf = x.astype(jnp.float32)
+            return jnp.sum(jnp.square(jnp.where(jnp.isfinite(xf), xf, 0.0)))
+
+        leaves = jax.tree.leaves(grads)
+        gss = sum(finite_sumsq(g) for g in leaves)
+        nonf = sum(
+            jnp.sum(~jnp.isfinite(g.astype(jnp.float32))).astype(jnp.float32)
+            for g in leaves
+        )
+        gss = lax.psum(gss, self.axis_name)
+        nonf = lax.psum(nonf, self.axis_name)
+        upd = sum(
+            jnp.sum(jnp.square((n.astype(jnp.float32)
+                                - o.astype(jnp.float32))))
+            for o, n in zip(jax.tree.leaves(old_params),
+                            jax.tree.leaves(new_params))
+        )
+        psq = sum(
+            jnp.sum(jnp.square(o.astype(jnp.float32)))
+            for o in jax.tree.leaves(old_params)
+        )
+        if isinstance(self.code, ErrorFeedback):
+            flat_states = jax.tree.structure(self.params).flatten_up_to(
+                codec_state
+            )
+            ef = sum(
+                jnp.sum(jnp.square(st["memory"].astype(jnp.float32)))
+                for st in flat_states
+            )
+            ef = lax.psum(ef, self.axis_name)
+        else:
+            ef = jnp.float32(0.0)
+        parts = [gss, nonf, upd, psq, ef]
+        if self._bucket_plan is not None:
+            parts.extend(
+                lax.psum(finite_sumsq(b), self.axis_name)
+                for b in flatten_into_buckets(self._bucket_plan, grads)
+            )
+        return jnp.stack([jnp.asarray(p, jnp.float32) for p in parts])
+
+    def _fill_numerics(self, data: Dict[str, float], nvec) -> None:
+        """Unpack the fetched stats vector into the step's metrics dict
+        (the one device fetch the numerics leg costs per step)."""
+        v = np.asarray(nvec, np.float32)
+        data["grad_norm"] = float(np.sqrt(v[0]))
+        data["nonfinite_total"] = float(v[1])
+        data["update_ratio"] = float(np.sqrt(v[2])) / max(
+            float(np.sqrt(v[3])), 1e-30
+        )
+        if isinstance(self.code, ErrorFeedback):
+            data["ef_residual_norm"] = float(np.sqrt(v[4]))
+        if self._bucket_plan is not None:
+            data["bucket_grad_norms"] = [
+                float(np.sqrt(x)) for x in v[5:]
+            ]
+
     def _opt_state_spec(self):
         """shard_map PartitionSpec pytree for the optimizer state: sharded
         over the mesh axis in leader mode (ZeRO-1); with param_specs the
@@ -1562,7 +1653,11 @@ class MPI_PS:
                     params, opt_state, codec_state, grads, rng
                 )
             )
-            return new_params, new_opt_state, new_codec_state, loss, new_aux
+            out = (new_params, new_opt_state, new_codec_state, loss, new_aux)
+            if self.numerics:
+                out += (self._numerics_vec(params, new_params, grads,
+                                           new_codec_state),)
+            return out
 
         state_spec = self._codec_spec
         opt_spec = self._opt_state_spec()
@@ -1570,12 +1665,15 @@ class MPI_PS:
         in_specs = (pspec, opt_spec, state_spec, self.batch_spec, P()) + (
             (P(),) if has_aux else ()
         )
+        out_specs = (pspec, opt_spec, state_spec, P(), P()) + (
+            (P(),) if self.numerics else ()
+        )
         return jax.jit(
             jax.shard_map(
                 spmd,
                 mesh=self.mesh,
                 in_specs=in_specs,
-                out_specs=(pspec, opt_spec, state_spec, P(), P()),
+                out_specs=out_specs,
                 check_vma=False,
             ),
             # in-place params/state update on device: the outputs reuse
@@ -1602,18 +1700,25 @@ class MPI_PS:
                     params, opt_state, codec_state, grads, rng
                 )
             )
-            return new_params, new_opt_state, new_codec_state, loss
+            out = (new_params, new_opt_state, new_codec_state, loss)
+            if self.numerics:
+                out += (self._numerics_vec(params, new_params, grads,
+                                           new_codec_state),)
+            return out
 
         state_spec = self._codec_spec
         opt_spec = self._opt_state_spec()
         pspec = self.param_specs if self._model_parallel else P()
         mb_spec = P(*((None,) + tuple(self.batch_spec)))
+        out_specs = (pspec, opt_spec, state_spec, P()) + (
+            (P(),) if self.numerics else ()
+        )
         return jax.jit(
             jax.shard_map(
                 spmd,
                 mesh=self.mesh,
                 in_specs=(pspec, opt_spec, state_spec, mb_spec, P()),
-                out_specs=(pspec, opt_spec, state_spec, P()),
+                out_specs=out_specs,
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2) if self.donate_buffers else (),
@@ -1719,7 +1824,12 @@ class MPI_PS:
             out, _ = self._profiled_call(call, data)
         else:
             out = call()
-        self.params, self.opt_state, self.codec_state, loss = out
+        if self.numerics:
+            (self.params, self.opt_state, self.codec_state, loss,
+             nvec) = out
+            self._fill_numerics(data, nvec)
+        else:
+            self.params, self.opt_state, self.codec_state, loss = out
         jax.block_until_ready(self.params)
         self._step_count += 1
         data["step_time"] = time.perf_counter() - t0
@@ -1739,17 +1849,24 @@ class MPI_PS:
                     params, opt_state, codec_state, grads, rng
                 )
             )
-            return new_params, new_opt_state, new_codec_state
+            out = (new_params, new_opt_state, new_codec_state)
+            if self.numerics:
+                out += (self._numerics_vec(params, new_params, grads,
+                                           new_codec_state),)
+            return out
 
         state_spec = self._codec_spec
         grads_spec = jax.tree.map(lambda _: P(axis), self.params)
         opt_spec = self._opt_state_spec()
+        out_specs = (P(), opt_spec, state_spec) + (
+            (P(),) if self.numerics else ()
+        )
         return jax.jit(
             jax.shard_map(
                 spmd,
                 mesh=self.mesh,
                 in_specs=(P(), opt_spec, state_spec, grads_spec, P()),
-                out_specs=(P(), opt_spec, state_spec),
+                out_specs=out_specs,
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2) if self.donate_buffers else (),
@@ -1880,7 +1997,13 @@ class MPI_PS:
                 out, split = self._profiled_call(call, data)
             else:
                 out = call()
-            (self.params, self.opt_state, self.codec_state, loss, new_aux) = out
+            if self.numerics:
+                (self.params, self.opt_state, self.codec_state, loss,
+                 new_aux, nvec) = out
+                self._fill_numerics(data, nvec)
+            else:
+                (self.params, self.opt_state, self.codec_state, loss,
+                 new_aux) = out
             if has_aux:
                 self.aux_state = new_aux
         elif grads is not None:
@@ -1906,7 +2029,12 @@ class MPI_PS:
                 out, split = self._profiled_call(call, data)
             else:
                 out = call()
-            self.params, self.opt_state, self.codec_state = out
+            if self.numerics:
+                (self.params, self.opt_state, self.codec_state,
+                 nvec) = out
+                self._fill_numerics(data, nvec)
+            else:
+                self.params, self.opt_state, self.codec_state = out
         else:
             raise ValueError("pass grads or loss_fn+batch")
 

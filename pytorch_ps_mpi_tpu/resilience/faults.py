@@ -19,6 +19,10 @@ A **fault plan** is a JSON-able list of entries::
   ``corrupt``      XOR-flip ``corrupt_bytes`` (default 8) payload bytes —
                    deterministic positions from (seed, fault id); detected
                    and rejected when frame checking is on
+  ``nan``          poison the step's gradient with NaNs BEFORE encode —
+                   frames stay wire-valid (CRC passes); detection is the
+                   numerics layer's job (``telemetry.numerics``
+                   quarantine), which this fault exists to exercise
   ``crash_worker`` ``os._exit`` mid-step (skips every ``finally:`` — the
                    closest a test can get to SIGKILL from inside)
   ``crash_server`` raise :class:`InjectedServerCrash` out of the serve
@@ -54,8 +58,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
-FAULT_KINDS = ("drop", "delay", "duplicate", "corrupt", "crash_worker",
-               "crash_server")
+FAULT_KINDS = ("drop", "delay", "duplicate", "corrupt", "nan",
+               "crash_worker", "crash_server")
 
 #: Exit code of an injected worker crash (``os._exit``) — distinguishable
 #: from a clean exit (0) and from real crashes in logs, treated like any

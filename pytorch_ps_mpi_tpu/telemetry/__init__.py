@@ -22,6 +22,12 @@ dicts). One system, three faces:
   MAD anomaly flags, compute/wire/churn straggler attribution, sync-
   round critical-path gating) served as ``/health`` JSON beside
   ``/metrics`` and rendered live by ``tools/ps_top.py``.
+- :mod:`numerics <.numerics>` — the layer that watches the NUMBERS:
+  :class:`NumericsMonitor` fuses gradient statistics into the lowered
+  step programs (grad norms, NaN/Inf counts, update-to-weight ratio),
+  tails online codec-fidelity probes (``Codec.fidelity_probe``),
+  quarantines non-finite pushes with a skip/zero/abort policy, and
+  writes divergence postmortems.
 
 ``tools/telemetry_report.py`` turns a recorded JSONL into the per-phase
 summary table; ``make telemetry-smoke`` bounds the enabled-recorder
@@ -54,6 +60,12 @@ from pytorch_ps_mpi_tpu.telemetry.diagnosis import (
     BeaconWriter,
     HealthMonitor,
 )
+from pytorch_ps_mpi_tpu.telemetry.numerics import (
+    NumericsMonitor,
+    ProbeWriter,
+    tree_stats,
+    update_weight_ratio,
+)
 from pytorch_ps_mpi_tpu.telemetry.trace_export import (
     export_chrome_trace,
     merged_trace_events,
@@ -80,6 +92,10 @@ __all__ = [
     "MetricsHTTPServer",
     "BeaconWriter",
     "HealthMonitor",
+    "NumericsMonitor",
+    "ProbeWriter",
+    "tree_stats",
+    "update_weight_ratio",
     "export_chrome_trace",
     "merged_trace_events",
 ]

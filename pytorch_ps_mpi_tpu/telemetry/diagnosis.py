@@ -43,7 +43,8 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 #: verdict → numeric code for the ``ps_worker_health`` gauge
-VERDICT_CODES = {"ok": 0.0, "slow": 1.0, "churning": 2.0, "missing": 3.0}
+VERDICT_CODES = {"ok": 0.0, "slow": 1.0, "churning": 2.0, "missing": 3.0,
+                 "quarantined": 4.0}
 
 
 class Ewma:
@@ -352,6 +353,11 @@ class HealthMonitor:
         h = self._w[worker]
         k = self.knobs
         now = time.monotonic() if now is None else float(now)
+        nm = getattr(self.server, "numerics_monitor", None)
+        if nm is not None and nm.is_quarantined(worker):
+            # numerics outranks everything: a worker emitting NaNs is
+            # broken whatever its latency looks like
+            return "quarantined", "nonfinite"
         if h.grads == 0 and not h.done:
             if now - self._t0 > k["missing_after_s"]:
                 return "missing", None
@@ -396,6 +402,10 @@ class HealthMonitor:
         inter_ewmas = [h.inter_ewma.value for h in self._w
                        if h.inter_ewma.value is not None]
         fleet_med = _median(inter_ewmas) if inter_ewmas else None
+        nm = getattr(self.server, "numerics_monitor", None)
+        # ONE numerics snapshot, indexed per worker below — the verdict
+        # section and the per-worker rows can never drift apart
+        nsnap = nm.snapshot() if nm is not None else None
         workers = []
         for wid in range(self.num_workers):
             h = self._w[wid]
@@ -404,6 +414,7 @@ class HealthMonitor:
                 None if h.last_arrival is None
                 else round(now - h.last_arrival, 3)
             )
+            num_row = nsnap["workers"][wid] if nsnap is not None else None
             workers.append({
                 "worker": wid,
                 "verdict": verdict,
@@ -430,6 +441,7 @@ class HealthMonitor:
                 "last_seen_age_s": last_age,
                 "gating": {"rounds": h.gated_rounds,
                            "seconds": round(h.gating_s, 6)},
+                "numerics": num_row,
             })
         fleet: Dict[str, Any] = {
             "anomaly_total": sum(h.anomalies for h in self._w),
@@ -446,7 +458,7 @@ class HealthMonitor:
             fleet.update({k: m[k] for k in (
                 "grads_received", "stale_drops",
                 "staleness_p50", "staleness_p95", "staleness_p99")})
-        return {
+        out = {
             "armed": True,
             "t_wall": time.time(),
             "uptime_s": round(time.monotonic() - self._t0, 3),
@@ -454,6 +466,11 @@ class HealthMonitor:
             "fleet": fleet,
             "workers": workers,
         }
+        if nsnap is not None:
+            # the numerics verdict section: quarantine state, grad-norm
+            # trajectory summary, latest codec-fidelity probe, postmortems
+            out["numerics"] = nsnap
+        return out
 
     def render_json(self) -> str:
         return json.dumps(self.snapshot())

@@ -309,6 +309,17 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "staleness_p50",
     "staleness_p95",
     "staleness_p99",
+    # numerics observability (telemetry.numerics.NumericsMonitor): all
+    # 0.0 when numerics is unarmed. nonfinite_total counts NaN/Inf
+    # PUSHES (frames, not elements); grad_norm is the last healthy
+    # consumed gradient's finite L2 norm; update_ratio is ||dp||/||p||
+    # at the last probe; codec_rel_error / ef_residual_norm mirror the
+    # latest worker-side codec-fidelity probe
+    "nonfinite_total",
+    "grad_norm",
+    "update_ratio",
+    "codec_rel_error",
+    "ef_residual_norm",
 )
 
 
@@ -353,6 +364,7 @@ def ps_server_metrics(server) -> Dict[str, float]:
         buckets = 0.0
         # the no-codec wire ships ONE concatenated f32 buffer per push
         units = 1.0 if jax.tree.leaves(server.template) else 0.0
+    nm = getattr(server, "numerics_monitor", None)
     return {
         "grads_received": float(server.grads_received),
         "bytes_received": float(server.bytes_received),
@@ -366,6 +378,15 @@ def ps_server_metrics(server) -> Dict[str, float]:
         "staleness_p50": staleness_quantile(server.staleness_seen, 0.50),
         "staleness_p95": staleness_quantile(server.staleness_seen, 0.95),
         "staleness_p99": staleness_quantile(server.staleness_seen, 0.99),
+        "nonfinite_total": float(
+            nm.nonfinite_frames_total if nm is not None else 0.0),
+        "grad_norm": float(nm.last_grad_norm if nm is not None else 0.0),
+        "update_ratio": float(
+            (nm.update_ratio or 0.0) if nm is not None else 0.0),
+        "codec_rel_error": float(
+            nm.codec_rel_error if nm is not None else 0.0),
+        "ef_residual_norm": float(
+            nm.ef_residual_norm if nm is not None else 0.0),
     }
 
 
@@ -458,6 +479,11 @@ class PSServerTelemetry:
     #: the attached online-diagnosis monitor (``/health``'s source),
     #: set by ``serve()`` when health is armed — see :mod:`.diagnosis`
     health_monitor: Optional[Any] = None
+    #: the attached numerics monitor (grad-norm/NaN/codec-fidelity
+    #: source for the canonical schema and ``/health``'s ``numerics``
+    #: section), set by ``serve()`` when numerics is armed — see
+    #: :mod:`.numerics`
+    numerics_monitor: Optional[Any] = None
 
     @property
     def frames_rejected(self) -> Dict[int, int]:

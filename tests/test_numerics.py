@@ -1,0 +1,602 @@
+"""Numerics observability: on-device gradient statistics, codec-fidelity
+probes, non-finite quarantine, and divergence postmortems.
+
+The layer that watches the NUMBERS (``telemetry/numerics.py``): a worker
+emitting NaNs used to silently poison the aggregate — ``grep isfinite``
+across ps.py/optim.py/async_train.py returned nothing — and no lossy
+codec reported what it actually does to the gradients it compresses.
+These tests cover all three legs plus the hardened codecs, the report
+section, and the ps_top rendering.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_ps_mpi_tpu.codecs import get_codec
+from pytorch_ps_mpi_tpu.telemetry.numerics import (
+    NumericsMonitor,
+    sanitize_tree,
+    tree_stats,
+    update_weight_ratio,
+)
+
+
+# ---------------------------------------------------------------------------
+# leg 1 primitives: jitted tree statistics
+# ---------------------------------------------------------------------------
+
+def test_tree_stats_counts_nonfinite_and_masks_norm():
+    t = {"a": np.array([1.0, np.nan, 2.0, -np.inf], np.float32),
+         "b": np.ones((2, 2), np.float32)}
+    sumsq, nonf = tree_stats(t)
+    assert nonf.tolist() == [2, 0]
+    # the finite part's energy survives the poison: 1^2 + 2^2 and 4*1^2
+    np.testing.assert_allclose(sumsq, [5.0, 4.0], rtol=1e-6)
+
+
+def test_sanitize_tree_zeroes_only_the_bad_elements():
+    t = {"a": np.array([1.0, np.nan, np.inf, 4.0], np.float32)}
+    out = sanitize_tree(t)
+    np.testing.assert_array_equal(out["a"], [1.0, 0.0, 0.0, 4.0])
+
+
+def test_update_weight_ratio():
+    old = {"w": np.ones(16, np.float32)}
+    new = {"w": np.full(16, 1.05, np.float32)}
+    assert abs(update_weight_ratio(old, new) - 0.05) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# satellite: lossy codecs hardened against non-finite input
+# ---------------------------------------------------------------------------
+
+_LOSSY = [
+    ("sign", {"use_pallas": False}, "scale"),
+    ("terngrad", {}, "scale"),
+    ("qsgd", {}, "norm"),
+    ("int8", {}, "scale"),
+]
+
+
+@pytest.mark.parametrize("name,kw,stat_key", _LOSSY)
+def test_codec_nonfinite_propagate_is_the_documented_poison(name, kw, stat_key):
+    """Default behavior unchanged: a NaN input drives the payload's
+    per-tensor statistic non-finite — the failure mode the guard exists
+    for, asserted so the docs stay honest."""
+    code = get_codec(name, **kw)
+    g = jnp.array([1.0, jnp.nan, 3.0, -2.0])
+    rng = jax.random.key(0) if code.needs_rng else None
+    payload, _ = code.encode(g, (), rng)
+    assert not np.isfinite(float(payload[stat_key]))
+
+
+@pytest.mark.parametrize("name,kw,stat_key", _LOSSY)
+def test_codec_nonfinite_zero_sanitizes(name, kw, stat_key):
+    code = get_codec(name, nonfinite="zero", **kw)
+    g = jnp.array([1.0, jnp.nan, 3.0, -jnp.inf])
+    rng = jax.random.key(0) if code.needs_rng else None
+    payload, _ = code.encode(g, (), rng)
+    assert np.isfinite(float(payload[stat_key]))
+    dec = np.asarray(code.decode(payload, (4,), jnp.float32))
+    assert np.isfinite(dec).all()
+
+
+@pytest.mark.parametrize("name,kw,stat_key", _LOSSY)
+def test_codec_nonfinite_raise_eager_and_jit_degrade(name, kw, stat_key):
+    code = get_codec(name, nonfinite="raise", **kw)
+    g = jnp.array([1.0, jnp.nan, 3.0, -2.0])
+    rng = jax.random.key(0) if code.needs_rng else None
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        code.encode(g, (), rng)
+    # a clean input passes
+    payload, _ = code.encode(jnp.abs(jnp.arange(4.0)) + 1.0, (), rng)
+    assert np.isfinite(float(payload[stat_key]))
+    # under jit a data-dependent raise is impossible: degrades to "zero"
+    payload, _ = jax.jit(lambda x, r: code.encode(x, (), r))(g, rng)
+    assert np.isfinite(float(payload[stat_key]))
+
+
+def test_codec_nonfinite_mode_validated():
+    with pytest.raises(ValueError, match="nonfinite"):
+        get_codec("sign", use_pallas=False, nonfinite="explode").encode(
+            jnp.ones(4))
+
+
+# ---------------------------------------------------------------------------
+# leg 2: codec fidelity probes
+# ---------------------------------------------------------------------------
+
+def test_fidelity_probe_identity_vs_sign():
+    g = jax.random.normal(jax.random.key(1), (512,))
+    ident = get_codec("identity").fidelity_probe(g)
+    assert ident["rel_error"] < 1e-6
+    assert ident["cosine"] > 0.999
+    assert ident["bits_per_param"] == 32.0
+    s = get_codec("sign", use_pallas=False).fidelity_probe(g)
+    assert s["rel_error"] > 0.05
+    assert 0.0 < s["cosine"] < 1.0
+    assert s["bits_per_param"] < 2.0  # ~1 bit + the scale scalar
+
+
+def test_fidelity_probe_stochastic_codecs_take_rng():
+    g = jax.random.normal(jax.random.key(2), (256,))
+    for name, kw in (("qsgd", {}), ("terngrad", {}),
+                     ("randomk", {"fraction": 0.25})):
+        out = get_codec(name, **kw).fidelity_probe(g)
+        assert np.isfinite(out["rel_error"])
+
+
+def test_error_feedback_probe_exports_residual_and_reads_only():
+    ef = get_codec("ef", inner_name="topk", fraction=0.25)
+    st = ef.init_state((64,), jnp.float32)
+    st = {"memory": jnp.full(64, 0.1, jnp.float32), "inner": st["inner"]}
+    g = jax.random.normal(jax.random.key(3), (64,))
+    out = ef.fidelity_probe(g, st)
+    assert abs(out["ef_residual_norm"] - 0.1 * 8.0) < 1e-4  # sqrt(64)*0.1
+    # read-only: probing never mutated the memory
+    np.testing.assert_array_equal(np.asarray(st["memory"]),
+                                  np.full(64, 0.1, np.float32))
+
+
+def test_codec_wire_probe_uses_pre_encode_gradient():
+    """The probe must run on the true gradient: probing the sign codec
+    through the wire yields large rel-error even though re-encoding a
+    DECODED sign gradient would measure ~0."""
+    from pytorch_ps_mpi_tpu.parallel.dcn import CodecWire
+
+    tpl = {"a": np.zeros((128,), np.float32), "b": np.zeros((8,), np.float32)}
+    wire = CodecWire(get_codec("sign", use_pallas=False), tpl)
+    g = {"a": np.asarray(jax.random.normal(jax.random.key(4), (128,))),
+         "b": np.ones(8, np.float32)}
+    out = wire.probe_fidelity(g)
+    assert out["codec"] == "SignCodec"
+    assert out["unit"] == 0  # the largest unit was sampled
+    assert out["rel_error"] > 0.05
+
+
+# ---------------------------------------------------------------------------
+# leg 3: the NumericsMonitor (unit level)
+# ---------------------------------------------------------------------------
+
+def _nan_tree(n=8):
+    return {"w": np.full(n, np.nan, np.float32)}
+
+
+def _ok_tree(n=8, v=1.0):
+    return {"w": np.full(n, v, np.float32)}
+
+
+def test_monitor_policy_actions_and_quarantine(tmp_path):
+    m = NumericsMonitor(num_workers=2, policy="skip", quarantine_after=2,
+                        cfg={"numerics_dir": str(tmp_path)})
+    assert m.observe_push(0, _ok_tree()) == "apply"
+    assert m.observe_push(1, _nan_tree()) == "skip"
+    assert not m.is_quarantined(1)  # below the threshold
+    assert m.observe_push(1, _nan_tree()) == "skip"
+    assert m.is_quarantined(1) and not m.is_quarantined(0)
+    snap = m.snapshot()
+    assert snap["quarantined"] == [1]
+    assert snap["nonfinite_total"] == 2
+    assert snap["workers"][1]["verdict"] == "quarantined"
+    # first offense wrote a postmortem
+    assert len(m.postmortems) == 1 and os.path.exists(m.postmortems[0])
+
+
+def test_monitor_quarantined_worker_finite_pushes_also_skipped():
+    """Under the skip policy quarantine isolates the worker wholesale:
+    after the NaN offense its FINITE pushes are dropped too (rejection
+    reason 'quarantined'), so an intermittently-poisoned worker cannot
+    keep steering the model between offenses."""
+    m = NumericsMonitor(num_workers=2, policy="skip", quarantine_after=1)
+    assert m.observe_push(1, _nan_tree()) == "skip"
+    assert m.observe_push(1, _ok_tree()) == "skip"  # finite but untrusted
+    assert m.observe_push(0, _ok_tree()) == "apply"  # healthy unaffected
+    # zero policy keeps salvaging: finite pushes from a quarantined
+    # worker still apply
+    mz = NumericsMonitor(num_workers=1, policy="zero", quarantine_after=1)
+    assert mz.observe_push(0, _nan_tree()) == "zero"
+    assert mz.observe_push(0, _ok_tree()) == "apply"
+
+
+def test_monitor_probe_every_clamped():
+    m = NumericsMonitor(num_workers=1, probe_every=0)
+    assert m.knobs["probe_every"] == 1
+
+
+def test_monitor_tick_sanitizes_nan_probe_rows(tmp_path):
+    """A probe row written off a poisoned gradient carries NaN floats
+    (Python json round-trips them; strict parsers reject the document):
+    the tailer must sanitize so /health stays RFC-valid JSON."""
+    from pytorch_ps_mpi_tpu.telemetry.numerics import ProbeWriter
+
+    m = NumericsMonitor(num_workers=1, cfg={"numerics_dir": str(tmp_path)})
+    w = ProbeWriter(str(tmp_path), 0)
+    w.write(0, {"rel_error": float("nan"), "cosine": float("nan"),
+                "bits_per_param": 1.0, "codec": "SignCodec"})
+    w.close()
+    m.tick()
+    assert m.snapshot()["workers"][0]["probe"]["rel_error"] is None
+    assert m.codec_rel_error == 0.0
+    assert "NaN" not in json.dumps(m.snapshot())
+
+
+def test_monitor_zero_policy_sanitizes_not_rejects():
+    m = NumericsMonitor(num_workers=1, policy="zero")
+    assert m.observe_push(0, _nan_tree()) == "zero"
+    assert m.nonfinite_frames_total == 1
+
+
+def test_monitor_abort_policy(tmp_path):
+    m = NumericsMonitor(num_workers=1, policy="abort",
+                        cfg={"numerics_dir": str(tmp_path)})
+    assert m.observe_push(0, _nan_tree()) == "abort"
+    assert m.aborted is not None and m.aborted["worker"] == 0
+    assert m.aborted["postmortem"] and os.path.exists(
+        m.aborted["postmortem"])
+
+
+def test_monitor_invalid_policy_rejected():
+    with pytest.raises(ValueError, match="policy"):
+        NumericsMonitor(num_workers=1, policy="explode")
+
+
+def test_monitor_norm_spike_trips_postmortem(tmp_path):
+    m = NumericsMonitor(num_workers=1, cfg={"numerics_dir": str(tmp_path)},
+                        spike_factor=10.0, spike_min_samples=5)
+    for _ in range(10):
+        assert m.observe_push(0, _ok_tree(v=1.0)) == "apply"
+    assert not m.postmortems
+    assert m.observe_push(0, _ok_tree(v=1000.0)) == "apply"  # spike applies
+    assert len(m.postmortems) == 1
+    pm = json.load(open(m.postmortems[0]))
+    assert pm["reason"] == "norm_spike"
+    assert pm["step_stats_ring"]  # the last-k ring rode along
+
+
+def test_monitor_postmortem_contents(tmp_path):
+    m = NumericsMonitor(num_workers=2, cfg={"numerics_dir": str(tmp_path)})
+    m.observe_push(0, _ok_tree())
+    m.observe_push(1, {"a": np.array([1.0, np.nan], np.float32),
+                       "b": np.ones(3, np.float32)})
+    pm = json.load(open(m.postmortems[0]))
+    assert pm["kind"] == "numerics_postmortem"
+    assert pm["worker"] == 1
+    leaves = pm["offending"]["leaves"]
+    assert leaves[0]["nonfinite"] == 1 and leaves[1]["nonfinite"] == 0
+    assert pm["offending"]["sample"]["leaf"] == 0
+
+
+def test_postmortems_survive_monitor_restart(tmp_path):
+    """A supervised restart builds a fresh monitor over the same dir:
+    the new generation's postmortems must not clobber the pre-crash
+    capture (numbering continues from the files on disk)."""
+    m1 = NumericsMonitor(num_workers=1, cfg={"numerics_dir": str(tmp_path)})
+    m1.observe_push(0, _nan_tree())
+    m2 = NumericsMonitor(num_workers=1, cfg={"numerics_dir": str(tmp_path)})
+    m2.observe_push(0, _nan_tree())
+    names = sorted(os.path.basename(p) for p in
+                   (m1.postmortems + m2.postmortems))
+    assert names == ["postmortem-00-nonfinite.json",
+                     "postmortem-01-nonfinite.json"]
+
+
+def test_codec_nonfinite_validated_at_construction():
+    with pytest.raises(ValueError, match="nonfinite"):
+        get_codec("sign", use_pallas=False, nonfinite="zeros")  # typo
+    with pytest.raises(ValueError, match="nonfinite"):
+        get_codec("qsgd", nonfinite="ZERO")
+
+
+def test_monitor_registry_instruments():
+    from pytorch_ps_mpi_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    m = NumericsMonitor(num_workers=2)
+    m.register(reg)
+    m.observe_push(0, _ok_tree(v=2.0))
+    m.observe_push(1, _nan_tree())
+    text = reg.prometheus_text()
+    assert "ps_nonfinite_total 1" in text
+    assert 'ps_worker_nonfinite_total{worker="1"} 1' in text
+    assert 'ps_worker_quarantined{worker="1"} 1' in text
+    assert "ps_grad_norm" in text
+
+
+def test_monitor_tails_worker_probe_rows(tmp_path):
+    from pytorch_ps_mpi_tpu.telemetry.numerics import ProbeWriter
+
+    m = NumericsMonitor(num_workers=1, cfg={"numerics_dir": str(tmp_path)})
+    w = ProbeWriter(str(tmp_path), 0)
+    w.write(3, {"rel_error": 0.4, "cosine": 0.9, "bits_per_param": 1.1,
+                "codec": "SignCodec"})
+    w.close()
+    m.tick()
+    assert m.codec_rel_error == 0.4
+    assert m.snapshot()["workers"][0]["probe"]["codec"] == "SignCodec"
+
+
+# ---------------------------------------------------------------------------
+# leg 1 fused into MPI_PS lowered steps
+# ---------------------------------------------------------------------------
+
+def _toy_problem():
+    params = {"w": jnp.ones((8, 4)), "b": jnp.zeros((4,))}
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    k = jax.random.key(0)
+    batch = (jax.random.normal(k, (16, 8)),
+             jax.random.normal(jax.random.fold_in(k, 1), (16, 4)))
+    return params, loss_fn, batch
+
+
+def test_mpi_ps_numerics_stats_in_step_metrics():
+    from pytorch_ps_mpi_tpu.ps import MPI_PS
+
+    params, loss_fn, batch = _toy_problem()
+    opt = MPI_PS(params, optim="sgd", lr=0.05, average=True, numerics=True)
+    _, data = opt.step(loss_fn=loss_fn, batch=batch)
+    assert data["grad_norm"] > 0
+    assert data["nonfinite_total"] == 0.0
+    assert 0 < data["update_ratio"] < 1
+
+
+def test_mpi_ps_numerics_counts_injected_nan_grads():
+    from pytorch_ps_mpi_tpu.ps import MPI_PS
+
+    params, _, _ = _toy_problem()
+    opt = MPI_PS(params, optim="sgd", lr=0.05, numerics=True)
+    world = opt.size
+    g = {"w": jnp.full((world, 8, 4), jnp.nan), "b": jnp.ones((world, 4))}
+    _, data = opt.step(grads=g)
+    assert data["nonfinite_total"] == world * 8 * 4
+
+
+def test_mpi_ps_numerics_bucket_norms_and_accum():
+    from pytorch_ps_mpi_tpu.ps import MPI_PS
+
+    params, loss_fn, batch = _toy_problem()
+    opt = MPI_PS(params, optim="sgd", lr=0.05, code=get_codec("int8"),
+                 bucket_mb=0.001, numerics=True)
+    _, data = opt.step(loss_fn=loss_fn, batch=batch)
+    assert data["bucket_grad_norms"]
+    assert all(v >= 0 for v in data["bucket_grad_norms"])
+    mb = (jnp.stack([batch[0]] * 2), jnp.stack([batch[1]] * 2))
+    _, data = opt.step_accumulate(loss_fn, mb)
+    assert data["grad_norm"] > 0
+
+
+def test_mpi_ps_numerics_ef_residual_and_leader():
+    from pytorch_ps_mpi_tpu.ps import MPI_PS
+
+    params, loss_fn, batch = _toy_problem()
+    opt = MPI_PS(params, optim="sgd", lr=0.05,
+                 code=get_codec("ef", inner_name="topk", fraction=0.5),
+                 numerics=True)
+    opt.step(loss_fn=loss_fn, batch=batch)
+    _, data = opt.step(loss_fn=loss_fn, batch=batch)
+    assert data["ef_residual_norm"] > 0
+    lead = MPI_PS(params, optim="adam", lr=0.01, mode="leader",
+                  numerics=True)
+    _, data = lead.step(loss_fn=loss_fn, batch=batch)
+    assert data["grad_norm"] > 0 and data["update_ratio"] > 0
+
+
+def test_mpi_ps_numerics_rejects_model_parallel():
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_ps_mpi_tpu.ps import MPI_PS
+
+    params = {"w": jnp.ones((8, 4))}
+    with pytest.raises(NotImplementedError, match="numerics"):
+        MPI_PS(params, optim="sgd", lr=0.05, numerics=True,
+               param_specs={"w": P("data")})
+
+
+# ---------------------------------------------------------------------------
+# satellites: report numerics section + ps_top columns
+# ---------------------------------------------------------------------------
+
+def test_report_numerics_section_and_postmortem_routing(tmp_path):
+    """Dir mode must route numerics-*.jsonl and postmortem-*.json to the
+    numerics section — NOT parse them as recorder event JSONLs."""
+    from tools.telemetry_report import collect_files, format_table, summarize
+
+    d = tmp_path / "run"
+    d.mkdir()
+    with open(d / "numerics-server.jsonl", "w") as f:
+        for i, gn in enumerate([1.0, 1.2, 0.9]):
+            f.write(json.dumps({"worker": "server", "applied": i * 10,
+                                "grad_norm": gn, "update_ratio": 1e-3,
+                                "nonfinite_total": i, "t": 0.0}) + "\n")
+    with open(d / "numerics-0.jsonl", "w") as f:
+        f.write(json.dumps({"worker": 0, "step": 5, "codec": "SignCodec",
+                            "rel_error": 0.6, "cosine": 0.8,
+                            "bits_per_param": 1.1, "t": 0.0}) + "\n")
+    with open(d / "postmortem-00-nonfinite.json", "w") as f:
+        json.dump({"kind": "numerics_postmortem", "reason": "nonfinite",
+                   "worker": 1, "applied": 17,
+                   "step_stats_ring": [{"push": 1}]}, f)
+    # a recorder jsonl beside them, to prove the split
+    with open(d / "server.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "recorder_meta", "worker": "server",
+                            "capacity": 64, "n_events": 1,
+                            "dropped": 0}) + "\n")
+        f.write(json.dumps({"name": "serve.update", "kind": "span",
+                            "ts": 0.0, "dur": 0.01}) + "\n")
+    summary = summarize(collect_files([str(d)]))
+    num = summary["numerics"]
+    assert num["trajectory"]["rows"] == 3
+    assert num["trajectory"]["grad_norm_last"] == 0.9
+    assert num["trajectory"]["nonfinite_total"] == 2
+    assert num["probes"][0]["codec"] == "SignCodec"
+    assert num["postmortems"][0]["reason"] == "nonfinite"
+    # the recorder span table is undisturbed by the numerics files
+    assert [s["name"] for s in summary["spans"]] == ["serve.update"]
+    text = format_table(summary)
+    assert "numerics:" in text
+    assert "postmortem" in text
+    assert "SignCodec" in text
+
+
+def test_ps_top_renders_numerics_columns_and_sort():
+    from tools.ps_top import render_table
+
+    def worker_row(wid, verdict, nonfinite, gnorm):
+        return {
+            "worker": wid, "verdict": verdict, "cause": None, "done": False,
+            "grads": 5,
+            "push_interarrival_s": {"ewma": 0.01, "p50": 0.01, "p95": 0.02,
+                                    "n": 5},
+            "staleness": {"ewma": 0.5, "last": 1},
+            "anomalies": 0, "last_anomaly": None,
+            "server_wait_ewma_s": 0.0, "compute_ewma_s": 0.0,
+            "wire_ewma_s": 0.0, "steps_beaconed": 0,
+            "straggle_total_s": 0.0, "retries": 0, "reconnects": 0,
+            "frames_rejected": 0, "last_seen_age_s": 0.1,
+            "gating": {"rounds": 0, "seconds": 0.0},
+            "numerics": {"nonfinite": nonfinite, "quarantined":
+                         verdict == "quarantined",
+                         "grad_norm_ewma": gnorm,
+                         "probe": {"rel_error": 0.25}},
+        }
+
+    doc = {"armed": True, "n_workers": 2, "uptime_s": 3.0,
+           "fleet": {"grads_received": 10, "stale_drops": 0,
+                     "staleness_p50": 0, "staleness_p95": 0,
+                     "staleness_p99": 0, "anomaly_total": 0, "rounds": 0},
+           "workers": [worker_row(0, "ok", 0, 1.0),
+                       worker_row(1, "quarantined", 4, 2.0)]}
+    frame = render_table(doc, sort="numerics")
+    assert "gnorm" in frame and "nan" in frame and "relerr" in frame
+    assert "quarantined" in frame
+    # numerics sort puts the NaN offender first
+    lines = [ln for ln in frame.splitlines() if ln.strip().startswith(("0", "1"))]
+    assert lines[0].strip().startswith("1")
+    # a doc with no numerics still renders (columns dashed) — the --once
+    # CI mode contract
+    for w in doc["workers"]:
+        w["numerics"] = None
+        w["verdict"] = "ok"
+    assert "gnorm" in render_table(doc, sort="worker")
+
+
+def test_nan_fault_kind_valid_and_deterministic():
+    from pytorch_ps_mpi_tpu.resilience import FaultInjector
+
+    inj = FaultInjector([{"at_step": 3, "worker": 1, "kind": "nan"}],
+                        role=1)
+    assert inj.faults_at(2) == []
+    faults = inj.faults_at(3)
+    assert len(faults) == 1 and faults[0]["kind"] == "nan"
+
+
+# ---------------------------------------------------------------------------
+# E2E: the serve loop quarantines the NaN worker (shm transport)
+# ---------------------------------------------------------------------------
+
+from pytorch_ps_mpi_tpu.parallel import dcn  # noqa: E402
+
+needs_native = pytest.mark.skipif(
+    dcn.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+@needs_native
+def test_serve_quarantines_nan_worker_policy_skip(tmp_path):
+    """The acceptance scenario: worker 1 pushes NaN gradients mid-run;
+    policy 'skip' quarantines exactly that worker, counts its frames
+    through _reject_frame, keeps the healthy worker converging, and
+    writes a postmortem the report tool can parse."""
+    from pytorch_ps_mpi_tpu.parallel.async_train import (
+        join_workers,
+        make_problem,
+        serve,
+        spawn_worker,
+    )
+
+    steps = 10
+    cfg = {
+        "model": "mlp", "model_kw": {"features": (16, 4)}, "in_shape": (8,),
+        "batch": 32, "seed": 3, "optim": "sgd", "hyper": {"lr": 0.05},
+        "steps": steps, "open_timeout": 60.0, "push_timeout": 60.0,
+        "frame_check": True,
+        "fault_plan": [{"at_step": s, "worker": 1, "kind": "nan"}
+                       for s in range(5, steps)],
+        "fault_seed": 1,
+        "numerics": True, "numerics_dir": str(tmp_path),
+        "numerics_kw": {"policy": "skip", "probe_every": 3},
+    }
+    _, params0, _, _ = make_problem(cfg)
+    name = f"/psq_numtest_{os.getpid()}"
+    server = dcn.ShmPSServer(name, num_workers=2, template=params0,
+                             max_staleness=10**9, frame=True)
+    procs = []
+    try:
+        procs = [spawn_worker(name, i, cfg) for i in range(2)]
+        _, m = serve(server, cfg, total_grads=0, total_received=2 * steps,
+                     timeout=180.0)
+        assert join_workers(procs, timeout=120.0) == [0, 0]
+    finally:
+        server.close()
+        join_workers(procs, timeout=5.0)
+    num = m["numerics"]
+    assert num["quarantined"] == [1]
+    assert num["nonfinite_total"] == steps - 5
+    assert m["nonfinite_total"] == float(steps - 5)  # canonical schema
+    assert m["frames_rejected_by_worker"] == {1: steps - 5}
+    assert m["loss_final"] < m["loss_initial"]
+    assert num["postmortems"]
+    pm = json.load(open(num["postmortems"][0]))
+    assert pm["reason"] == "nonfinite" and pm["worker"] == 1
+
+
+@needs_native
+def test_serve_abort_policy_stops_cleanly_with_postmortem(tmp_path):
+    """Policy 'abort': the first NaN push stops the serve loop cleanly
+    (no exception), returns the abort marker, and leaves the postmortem
+    on disk."""
+    from pytorch_ps_mpi_tpu.parallel.async_train import (
+        join_workers,
+        make_problem,
+        serve,
+        spawn_worker,
+    )
+
+    steps = 4
+    cfg = {
+        "model": "mlp", "model_kw": {"features": (16, 4)}, "in_shape": (8,),
+        "batch": 32, "seed": 3, "optim": "sgd", "hyper": {"lr": 0.05},
+        "steps": steps, "open_timeout": 60.0, "push_timeout": 5.0,
+        "frame_check": True,
+        "fault_plan": [{"at_step": 2, "worker": 0, "kind": "nan"}],
+        "fault_seed": 1,
+        "numerics": True, "numerics_dir": str(tmp_path),
+        "numerics_kw": {"policy": "abort"},
+    }
+    _, params0, _, _ = make_problem(cfg)
+    name = f"/psq_numabort_{os.getpid()}"
+    server = dcn.ShmPSServer(name, num_workers=1, template=params0,
+                             max_staleness=10**9, frame=True)
+    procs = []
+    try:
+        procs = [spawn_worker(name, 0, cfg)]
+        _, m = serve(server, cfg, total_grads=0,
+                     total_received=steps, timeout=120.0)
+    finally:
+        server.close()
+        # the worker's post-abort pushes time out; reap whatever is left
+        join_workers(procs, timeout=30.0)
+    assert m["numerics_abort"]["reason"] == "nonfinite"
+    assert m["numerics_abort"]["worker"] == 0
+    assert os.path.exists(m["numerics_abort"]["postmortem"])
+    # the loop stopped at the poison: only the healthy pushes applied
+    assert m["applied"] == 2

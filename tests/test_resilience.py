@@ -280,6 +280,76 @@ def test_tcp_size_mismatched_frame_rejected_not_fatal():
         server.close()
 
 
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
+def test_nan_push_counted_and_quarantined_both_transports(transport):
+    """Numerics quarantine (telemetry.numerics) on the live wires: a NaN
+    gradient push survives every frame check (the bytes are valid —
+    poison is a NUMERICS failure, not a wire one), is counted per worker
+    through the same _reject_frame machinery as corrupt frames, and
+    quarantines exactly the offending worker on both transports."""
+    from pytorch_ps_mpi_tpu.telemetry.numerics import NumericsMonitor
+
+    tpl = _template(16)
+    workers = []
+    if transport == "tcp":
+        from pytorch_ps_mpi_tpu.parallel import tcp
+
+        if tcp.get_lib() is None:
+            pytest.skip("native toolchain unavailable")
+        server = tcp.TcpPSServer(0, num_workers=2, template=tpl,
+                                 frame=True, max_staleness=10**9)
+        make = lambda wid: tcp.TcpPSWorker("127.0.0.1", server.port, wid,
+                                           tpl, frame=True)
+    else:
+        name = f"/psq_nan_{os.getpid()}"
+        server = dcn.ShmPSServer(name, num_workers=2, template=tpl,
+                                 frame=True, max_staleness=10**9)
+        make = lambda wid: dcn.ShmPSWorker(name, wid, tpl, frame=True)
+    try:
+        numon = NumericsMonitor(server, {"numerics_kw": {"policy": "skip"}})
+        server.publish({"w": np.zeros(16, np.float32)})
+        workers = [make(0), make(1)]
+
+        def push(wid, grad, n=1):
+            def body():
+                _, ver = workers[wid].read_params(timeout=30)
+                for _ in range(n):
+                    workers[wid].push_grad({"w": grad}, ver, timeout=30)
+
+            t = threading.Thread(target=body)
+            t.start()
+            items = []
+            deadline = time.time() + 30
+            while len(items) < n and time.time() < deadline:
+                item = server.poll_grad()
+                if item is not None:
+                    items.append(item)
+                time.sleep(0.002)
+            t.join(timeout=30)
+            assert len(items) == n
+            return items
+
+        # healthy push from worker 0, poisoned pushes from worker 1
+        (item,) = push(0, np.ones(16, np.float32))
+        assert numon.observe_push(item[0], item[2]) == "apply"
+        for item in push(1, np.full(16, np.nan, np.float32), n=2):
+            assert numon.observe_push(item[0], item[2]) == "skip"
+
+        assert numon.is_quarantined(1) and not numon.is_quarantined(0)
+        m = server.metrics()
+        assert m["nonfinite_total"] == 2.0
+        assert m["grad_norm"] == pytest.approx(4.0)  # ||ones(16)||
+        assert server.frames_rejected.get(1) == 2  # counted like corrupt
+        text = server.prometheus_text()
+        assert 'ps_worker_nonfinite_total{worker="1"} 2' in text
+        assert 'ps_worker_quarantined{worker="1"} 1' in text
+        assert "ps_nonfinite_total 2" in text
+    finally:
+        for w in workers:
+            w.close()
+        server.close()
+
+
 def test_tcp_never_connected_worker_reported_immediately():
     """Satellite fix for ``last_seen`` ageing: liveness clocks start at
     first CONNECT, not server start — a worker that never showed up is

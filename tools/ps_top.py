@@ -6,7 +6,10 @@ at ``/health`` (beside ``/metrics`` — both transports serve it now) and
 redraws one verdict row per worker: health verdict, straggler
 attribution (compute-bound / wire-bound / reconnect-churn), push
 interarrival EWMA + p95, staleness EWMA, anomaly count, sync-round
-gating bill, retry/reconnect counters, and last-seen age.
+gating bill, retry/reconnect counters, numerics columns (grad-norm
+EWMA, non-finite push count, codec rel-error — filled when the
+``NumericsMonitor`` is armed, ``-`` otherwise), and last-seen age.
+A numerics-quarantined worker renders the ``quarantined`` verdict.
 
 Usage::
 
@@ -15,8 +18,9 @@ Usage::
   python tools/ps_top.py 9100 --once                  # one frame, no tty
 
 Keybindings (when stdin is a tty): ``q`` quit · ``p`` pause/resume ·
-``s`` cycle the sort column (worker → verdict → interarrival → gating)
-· ``r`` force an immediate refresh.
+``s`` cycle the sort column (worker → verdict → interarrival → gating
+→ numerics) · ``n`` jump straight to the numerics sort (NaN count,
+then grad norm) · ``r`` force an immediate refresh.
 """
 
 from __future__ import annotations
@@ -28,11 +32,12 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-SORT_KEYS = ("worker", "verdict", "interarrival", "gating")
+SORT_KEYS = ("worker", "verdict", "interarrival", "gating", "numerics")
 
-_VERDICT_ORDER = {"missing": 0, "churning": 1, "slow": 2, "ok": 3}
+_VERDICT_ORDER = {"quarantined": 0, "missing": 1, "churning": 2, "slow": 3,
+                  "ok": 4}
 _COLOR = {"ok": "\x1b[32m", "slow": "\x1b[33m", "churning": "\x1b[35m",
-          "missing": "\x1b[31m"}
+          "missing": "\x1b[31m", "quarantined": "\x1b[31m"}
 _RESET = "\x1b[0m"
 
 
@@ -81,10 +86,24 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
         f"up={health.get('uptime_s', 0):.0f}s"
     )
     cols = ["wk", "verdict", "cause", "grads", "inter-ewma", "inter-p95",
-            "stale-ewma", "anom", "gate-rounds", "gate-s", "retry",
-            "reconn", "rej", "seen-ago"]
+            "stale-ewma", "gnorm", "nan", "relerr", "anom", "gate-rounds",
+            "gate-s", "retry", "reconn", "rej", "seen-ago"]
     rows = []
     workers = list(health.get("workers", []))
+
+    def _num(w) -> dict:
+        return w.get("numerics") or {}
+
+    def _nan_count(w):
+        return int(_num(w).get("nonfinite") or 0)
+
+    def _gnorm(w):
+        return _num(w).get("grad_norm_ewma")
+
+    def _relerr(w):
+        probe = _num(w).get("probe") or {}
+        return probe.get("rel_error")
+
     if sort == "verdict":
         workers.sort(key=lambda w: _VERDICT_ORDER.get(w["verdict"], 9))
     elif sort == "interarrival":
@@ -92,15 +111,22 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
                                      or 0.0))
     elif sort == "gating":
         workers.sort(key=lambda w: -w["gating"]["seconds"])
+    elif sort == "numerics":
+        # worst numbers first: NaN offenders, then the loudest gradients
+        workers.sort(key=lambda w: (-_nan_count(w), -(_gnorm(w) or 0.0)))
     for w in workers:
         inter = w["push_interarrival_s"]
         stale = w["staleness"]
         verdict = w["verdict"] + (" (done)" if w.get("done") else "")
+        gnorm, relerr = _gnorm(w), _relerr(w)
         rows.append([
             str(w["worker"]), verdict, w["cause"] or "-",
             str(w["grads"]), _fmt_s(inter.get("ewma")),
             _fmt_s(inter.get("p95")),
             "-" if stale.get("ewma") is None else f"{stale['ewma']:.2f}",
+            "-" if gnorm is None else f"{gnorm:.3g}",
+            str(_nan_count(w)) if _num(w) else "-",
+            "-" if relerr is None else f"{relerr:.3f}",
             str(w["anomalies"]), str(w["gating"]["rounds"]),
             f"{w['gating']['seconds']:.2f}", str(w["retries"]),
             str(w["reconnects"]), str(w["frames_rejected"]),
@@ -117,7 +143,8 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
         if color and w["verdict"] in _COLOR:
             line = _COLOR[w["verdict"]] + line + _RESET
         lines.append(line)
-    lines.append(f"[sort: {sort}]  q quit · p pause · s sort · r refresh")
+    lines.append(f"[sort: {sort}]  q quit · p pause · s sort · "
+                 "n numerics · r refresh")
     return "\n".join(lines)
 
 
@@ -201,6 +228,9 @@ def main(argv=None) -> int:
                     break
                 if k == "s":
                     sort_i = (sort_i + 1) % len(SORT_KEYS)
+                    break
+                if k == "n":
+                    sort_i = SORT_KEYS.index("numerics")
                     break
                 if k == "r":
                     break

@@ -26,7 +26,7 @@ import glob
 import json
 import os
 import sys
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -45,14 +45,20 @@ def collect_files(paths: List[str]) -> List[str]:
     for p in paths:
         if os.path.isdir(p):
             # faults-*.jsonl are injected-fault event logs (resilience
-            # layer) and beacon-*.jsonl are health-monitor side channels
-            # — not recorder files (their rows have no name/kind)
+            # layer), beacon-*.jsonl are health-monitor side channels,
+            # and numerics-*.jsonl are codec-fidelity/grad-norm
+            # trajectories — none are recorder files (their rows have no
+            # name/kind), so they must not enter the span merge.
+            # numerics-*.jsonl and postmortem-*.json ARE picked up here,
+            # routed to the numerics section by summarize().
             out.extend(sorted(
                 f for f in glob.glob(os.path.join(p, "*.jsonl"))
                 if not os.path.basename(f).startswith(
                     ("faults-", "beacon-"))
             ))
             out.extend(sorted(glob.glob(os.path.join(p, "*.prom"))))
+            out.extend(sorted(glob.glob(
+                os.path.join(p, "postmortem-*.json"))))
         else:
             out.append(p)
     if not out:
@@ -87,6 +93,46 @@ def parse_prometheus_text(text: str) -> List[Dict[str, Any]]:
     return series
 
 
+def _summarize_numerics(traj_rows: List[Dict[str, Any]],
+                        probe_rows: List[Dict[str, Any]],
+                        postmortems: List[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+    """The numerics section: grad-norm trajectory summary from the
+    server rows, latest codec-fidelity probe per (worker, codec), and
+    the postmortem dumps found in the directory."""
+    if not (traj_rows or probe_rows or postmortems):
+        return None
+    out: Dict[str, Any] = {"postmortems": postmortems}
+    norms = [r["grad_norm"] for r in traj_rows
+             if isinstance(r.get("grad_norm"), (int, float))]
+    if traj_rows:
+        last = traj_rows[-1]
+        out["trajectory"] = {
+            "rows": len(traj_rows),
+            "grad_norm_first": norms[0] if norms else None,
+            "grad_norm_last": norms[-1] if norms else None,
+            "grad_norm_min": min(norms) if norms else None,
+            "grad_norm_max": max(norms) if norms else None,
+            "update_ratio_last": last.get("update_ratio"),
+            "nonfinite_total": last.get("nonfinite_total", 0),
+        }
+    latest: Dict[Any, Dict[str, Any]] = {}
+    counts: Dict[Any, int] = {}
+    for r in probe_rows:  # file order == append order: keep the latest
+        k = (r.get("worker"), r.get("codec"))
+        latest[k] = r
+        counts[k] = counts.get(k, 0) + 1
+    out["probes"] = [
+        {"worker": k[0], "codec": k[1],
+         "rel_error": v.get("rel_error"), "cosine": v.get("cosine"),
+         "bits_per_param": v.get("bits_per_param"),
+         "ef_residual_norm": v.get("ef_residual_norm"),
+         "probes": counts[k]}
+        for k, v in sorted(latest.items(), key=lambda kv: str(kv[0]))
+    ]
+    return out
+
+
 def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     """Merged summary over every file: per-span-name stats, event counts,
     and recorder meta (dropped counts make truncation visible)."""
@@ -94,7 +140,40 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     events: Dict[Any, int] = {}
     meta: List[Dict[str, Any]] = []
     labeled: List[Dict[str, Any]] = []
+    traj_rows: List[Dict[str, Any]] = []
+    probe_rows: List[Dict[str, Any]] = []
+    postmortems: List[Dict[str, Any]] = []
     for path in files:
+        base = os.path.basename(path)
+        if base.startswith("postmortem-") and path.endswith(".json"):
+            # a divergence postmortem dump (telemetry.numerics) — one
+            # JSON document, NOT an event JSONL; surface its headline
+            try:
+                with open(path) as f:
+                    pm = json.load(f)
+            except ValueError:
+                continue
+            postmortems.append({
+                "file": base, "reason": pm.get("reason"),
+                "worker": pm.get("worker"), "applied": pm.get("applied"),
+                "ring_rows": len(pm.get("step_stats_ring") or []),
+            })
+            continue
+        if base.startswith("numerics-") and path.endswith(".jsonl"):
+            # numerics trajectories: the server's grad-norm/update-ratio
+            # rows and the workers' codec-fidelity probe rows
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        r = json.loads(line)
+                    except ValueError:
+                        continue
+                    (traj_rows if r.get("worker") == "server"
+                     else probe_rows).append(r)
+            continue
         if path.endswith(".prom"):
             with open(path) as f:
                 for s in parse_prometheus_text(f.read()):
@@ -151,6 +230,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
             (s for s in labeled if "le" not in s["labels"]),
             key=lambda s: (s["name"], sorted(s["labels"].items())),
         ),
+        "numerics": _summarize_numerics(traj_rows, probe_rows, postmortems),
         "dropped_total": sum(m.get("dropped") or 0 for m in meta),
     }
 
@@ -190,6 +270,43 @@ def format_table(summary: Dict[str, Any]) -> str:
             v = s["value"]
             v_txt = str(int(v)) if float(v).is_integer() else f"{v:.6g}"
             lines.append(f"  {s['name']}{{{labels}}}: {v_txt}")
+    num = summary.get("numerics")
+    if num:
+        lines.append("")
+        lines.append("numerics:")
+        traj = num.get("trajectory")
+        if traj:
+            ur = traj.get("update_ratio_last")
+            lines.append(
+                f"  grad-norm trajectory ({traj['rows']} rows): "
+                f"first={traj['grad_norm_first']:.4g} "
+                f"last={traj['grad_norm_last']:.4g} "
+                f"min={traj['grad_norm_min']:.4g} "
+                f"max={traj['grad_norm_max']:.4g}"
+                + (f"  update-ratio={ur:.3g}" if ur is not None else "")
+            )
+            lines.append(
+                f"  nonfinite pushes: {int(traj.get('nonfinite_total', 0))}"
+            )
+        def _g(v, spec=".4g"):
+            # a probe that landed on a poisoned gradient carries None
+            return "-" if v is None else format(v, spec)
+
+        for p in num.get("probes", []):
+            ef = p.get("ef_residual_norm")
+            lines.append(
+                f"  codec fidelity [worker {p['worker']}] {p['codec']}: "
+                f"rel-err={_g(p['rel_error'])} cos={_g(p['cosine'])} "
+                f"bits/param={_g(p['bits_per_param'], '.3g')} "
+                f"({p['probes']} probes)"
+                + (f" ef-residual={ef:.4g}" if ef is not None else "")
+            )
+        for pm in num.get("postmortems", []):
+            lines.append(
+                f"  postmortem {pm['file']}: reason={pm['reason']} "
+                f"worker={pm['worker']} applied={pm['applied']} "
+                f"ring={pm['ring_rows']} rows"
+            )
     if summary["dropped_total"]:
         lines.append("")
         lines.append(

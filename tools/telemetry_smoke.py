@@ -36,7 +36,7 @@ from pytorch_ps_mpi_tpu.models import MLP
 from pytorch_ps_mpi_tpu.trainer import Trainer
 
 
-def build_trainer(batch: int = 256):
+def build_trainer(batch: int = 256, numerics: bool = False):
     model = MLP(features=(128, 10))
     key = jax.random.key(0)
     x0 = jnp.zeros((batch, 64), jnp.float32)
@@ -55,7 +55,8 @@ def build_trainer(batch: int = 256):
         logp = jax.nn.log_softmax(model.apply(p, x))
         return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
 
-    opt = MPI_PS(params, optim="sgd", lr=0.05, average=True)
+    opt = MPI_PS(params, optim="sgd", lr=0.05, average=True,
+                 numerics=numerics)
     return Trainer(opt, loss_fn), batches()
 
 
@@ -80,10 +81,15 @@ def main(argv=None) -> int:
                     help="max allowed recorder overhead fraction")
     ap.add_argument("--out", default="/tmp/telemetry_smoke",
                     help="directory for the JSONL + report artifacts")
+    ap.add_argument("--numerics", action="store_true",
+                    help="run the trainer with MPI_PS(numerics=True) — "
+                         "the fused grad-norm/NaN/update-ratio stats in "
+                         "every step — and hold it to the SAME <=5% "
+                         "recorder-overhead budget")
     args = ap.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
 
-    trainer, data = build_trainer()
+    trainer, data = build_trainer(numerics=args.numerics)
     trainer.fit(data, 3)  # compile warmup, outside every measurement
 
     off, on = [], []
