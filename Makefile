@@ -3,9 +3,11 @@
 # mesh set up by tests/conftest.py — no cluster, no MPI.
 
 # Default test path includes the bucketing parity + launch-count suite
-# (tests/test_bucketing.py); `make bucket-smoke` runs just that gate.
+# (tests/test_bucketing.py; `make bucket-smoke` runs just that gate) and
+# the gradient-lineage completeness gate (`make trace-smoke`).
 test:
 	python -m pytest tests/ -q
+	$(MAKE) trace-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -48,6 +50,19 @@ diag-smoke:
 	JAX_PLATFORMS=cpu python tools/diag_smoke.py
 	python tools/telemetry_smoke.py
 
+# Gradient-lineage gate (in the default `make test` path): a 2-worker
+# async run with lineage armed must account for EVERY consumed push
+# with a complete trace-ID row, the exact staleness rebuilt from the
+# lineage must equal the serve loop's own accounting, the merged
+# Chrome trace must contain cross-process flow arrows (worker push ->
+# server consume, clock-skew corrected), and the lineage bookkeeping
+# must fit the standing <=5% telemetry budget (the second command
+# re-asserts the recorder half of that budget). Appends a bench_gate
+# trajectory row to benchmarks/results/trace_smoke.jsonl.
+trace-smoke:
+	JAX_PLATFORMS=cpu python tools/trace_smoke.py
+	python tools/telemetry_smoke.py
+
 # Numerics gate (beside diag-smoke; tests/test_numerics.py covers the
 # same paths in the default `make test` run): a NaN-injecting worker
 # must be quarantined — exactly that worker — with a parseable
@@ -80,4 +95,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke

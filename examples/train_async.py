@@ -97,6 +97,21 @@ def main(argv=None):
                          "run (implies --health-port 0; with --supervise "
                          "pass an explicit --health-port so the pinned "
                          "port survives server restarts)")
+    ap.add_argument("--trace", action="store_true", default=None,
+                    help="arm end-to-end gradient lineage tracing: every "
+                         "framed push carries a causal trace ID (worker, "
+                         "step, seq) + encode timestamp, every published "
+                         "version gets a lineage-server.jsonl row naming "
+                         "its composing pushes, exact per-push e2e/"
+                         "staleness land in /metrics, and the merged "
+                         "trace.json gains cross-process flow arrows "
+                         "(worker push span -> server consume span, "
+                         "clock-skew corrected). Needs --telemetry-dir "
+                         "(artifacts land there) and frame checking "
+                         "(the trace ID rides the v2 frame header)")
+    ap.add_argument("--no-trace", dest="trace", action="store_false",
+                    help="disable lineage tracing (it is otherwise "
+                         "implied by --telemetry-dir)")
     ap.add_argument("--numerics", action="store_true",
                     help="arm the NumericsMonitor: every consumed push "
                          "is validated (NaN/Inf counted per worker, the "
@@ -223,6 +238,20 @@ def main(argv=None):
         cfg["telemetry_dir"] = args.telemetry_dir
         if args.metrics_port is None:
             args.metrics_port = 0
+    # lineage tracing: explicit --trace demands its prerequisites; the
+    # default (no flag) arms it whenever they are already met — one
+    # --telemetry-dir flag keeps meaning "full telemetry"
+    if args.trace:
+        if not args.telemetry_dir:
+            ap.error("--trace needs --telemetry-dir (lineage rows and "
+                     "the flow-event trace land there)")
+        if not cfg["frame_check"]:
+            ap.error("--trace needs frame checking (the trace ID rides "
+                     "the v2 frame header); drop --no-frame-check")
+    if (args.trace or (args.trace is None and args.telemetry_dir
+                       and cfg["frame_check"])):
+        cfg["lineage"] = True
+        cfg["lineage_dir"] = args.telemetry_dir
     if args.numerics:
         import tempfile
 
@@ -395,34 +424,56 @@ def _parse_fault_plan(spec: str):
 
 def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     """Merge every process's JSONL (+ the server's device trace) into
-    trace.json, print the per-phase report, return artifact paths."""
+    trace.json, print the per-phase report, return artifact paths.
+
+    When lineage files are present (``--trace``), the worker JSONLs are
+    first shifted onto the server's clock by the per-worker offsets
+    fitted from the frame send/recv timestamp pairs, and the trace gains
+    cross-process flow events (arrows) linking each worker push span to
+    its server consume span."""
     import glob
 
-    from pytorch_ps_mpi_tpu.telemetry import export_chrome_trace, load_jsonl
+    from pytorch_ps_mpi_tpu.telemetry import (
+        clock_offsets_from_rows,
+        export_chrome_trace,
+        load_jsonl,
+        load_lineage_rows,
+    )
     from tools.telemetry_report import format_table, summarize
 
     # faults-*.jsonl are injected-fault logs (resilience layer),
-    # beacon-*.jsonl are health-monitor side channels, and
-    # numerics-*.jsonl are codec-fidelity/grad-norm trajectories — not
-    # flight-recorder files, so exclude them from the merged trace
-    # (telemetry_report's dir mode routes them to its numerics section)
+    # beacon-*.jsonl are health-monitor side channels, numerics-*.jsonl
+    # are codec-fidelity/grad-norm trajectories, and lineage-*.jsonl are
+    # per-version push compositions — not flight-recorder files, so
+    # exclude them from the merged trace (telemetry_report's dir mode
+    # routes them to its numerics/lineage sections)
     files = sorted(f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
                    if not os.path.basename(f).startswith(
-                       ("faults-", "beacon-", "numerics-")))
+                       ("faults-", "beacon-", "numerics-", "lineage-")))
     events = []
     for f in files:
         events.extend(load_jsonl(f)[1])
+    lineage_files = sorted(glob.glob(os.path.join(tdir, "lineage-*.jsonl")))
+    lineage_rows = []
+    for f in lineage_files:
+        lineage_rows.extend(load_lineage_rows(f))
+    offsets = clock_offsets_from_rows(lineage_rows) if lineage_rows else None
     trace_path, counts = export_chrome_trace(
         os.path.join(tdir, "trace.json"), events,
         device_trace_dir=device_trace_dir, device_t0_wall=device_t0_wall,
+        lineage_rows=lineage_rows or None, clock_offsets=offsets,
     )
-    print(format_table(summarize(files, by_worker=False)))
-    return {
+    print(format_table(summarize(files + lineage_files, by_worker=False)))
+    out = {
         "telemetry_trace": trace_path,
         "telemetry_trace_host_events": counts["host"],
         "telemetry_trace_device_events": counts["device"],
         "telemetry_files": files,
     }
+    if lineage_rows:
+        out["telemetry_trace_flow_events"] = counts["flow"]
+        out["clock_offsets"] = offsets
+    return out
 
 
 if __name__ == "__main__":

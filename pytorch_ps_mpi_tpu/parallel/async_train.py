@@ -267,6 +267,10 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
 
     inj = FaultInjector.from_cfg(cfg, role=worker_id)
     push_timeout = float(cfg.get("push_timeout", 60.0))
+    # monotonic push seq — the third leg of the (worker, step, seq)
+    # trace ID stamped into every framed push at THIS encode site;
+    # duplicates get their own seq (both frames really travel)
+    push_seq = 0
     prober = None
     probe_every = 0
     if cfg.get("numerics_dir") and getattr(w, "wire", None) is not None:
@@ -375,13 +379,20 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                               dur=straggle_s, step=step)
             if not drop:
                 t0 = time.monotonic()
-                w.push_grad(grads, version, timeout=push_timeout)
+                seq0 = push_seq
+                w.push_grad(grads, version, timeout=push_timeout,
+                            lineage=(step, push_seq))
+                push_seq += 1
                 if duplicate:
-                    w.push_grad(grads, version, timeout=push_timeout)
+                    w.push_grad(grads, version, timeout=push_timeout,
+                                lineage=(step, push_seq))
+                    push_seq += 1
                 if rec is not None:
+                    # seq joins the span so trace export can tie this
+                    # push span to the server's consume span (flow arrow)
                     rec.event("worker.push_grad", kind="span", ts=t0,
                               dur=time.monotonic() - t0, step=step,
-                              version=version)
+                              version=version, seq=seq0)
             pushed += 1
             if beacon is not None:
                 # step accounting for straggler ATTRIBUTION: the
@@ -544,6 +555,20 @@ def serve(
     divergence capture. An abort lands in the returned metrics as
     ``numerics_abort``.
 
+    Gradient lineage (``telemetry.lineage``): ``lineage: true`` (or
+    ``lineage_dir``) arms a :class:`LineageTracker` — every framed push
+    carries a causal trace ID (worker, step, seq) + encode-site
+    timestamp from the v2 frame header, ``framed_poll`` feeds the
+    tracker per consumed push, and every published version gets a
+    recorded lineage row (the exact composing pushes with staleness,
+    bytes and per-stage wall times) in ``lineage-server.jsonl``. Exact
+    per-push e2e latency/staleness join the canonical metrics
+    (``push_e2e_p50_ms`` etc) and the scrape registry
+    (``ps_push_e2e_seconds`` histogram), sync rounds get stage-level
+    critical-path rows, and the snapshot rides the returned metrics as
+    ``lineage``. Requires ``frame_check`` (the trace ID rides the frame
+    header); skipped with a printed notice otherwise.
+
     Resilience hooks:
 
     - ``on_tick``: called from INSIDE the loop (same thread as every
@@ -634,6 +659,22 @@ def serve(
         # below BEFORE it can touch the optimizer
         numon = NumericsMonitor(server, cfg)
     numerics_probe_every = int(numon.knobs["probe_every"]) if numon else 0
+    lint = None
+    if cfg.get("lineage") or cfg.get("lineage_dir"):
+        if getattr(server, "frame", False):
+            from pytorch_ps_mpi_tpu.telemetry.lineage import LineageTracker
+
+            # attaches itself to server.lineage_tracker: framed_poll
+            # feeds it every consumed push's frame-carried trace ID, the
+            # canonical metrics grow lineage_pushes / push_e2e_p*_ms,
+            # and every publish below is billed with its composing
+            # pushes into lineage-server.jsonl
+            lint = LineageTracker(server, cfg)
+        else:
+            # the trace ID rides the v2 frame header — without frames
+            # there is nothing on the wire to trace
+            print("lineage tracing requires frame_check=True; not armed",
+                  flush=True)
     metrics_http_port = None
     http_port = cfg.get("metrics_port")
     if http_port is None:
@@ -713,7 +754,7 @@ def serve(
         if crash is not None:
             raise InjectedServerCrash(crash)
 
-    def _post_update(up_t0: float) -> None:
+    def _post_update(up_t0: float, lineage_workers=None) -> None:
         server.publish(jax.tree.map(np.asarray, params))
         up_dur = time.perf_counter() - up_t0
         h_update.observe(up_dur)
@@ -721,6 +762,13 @@ def serve(
         if rec is not None:
             rec.event("serve.update", kind="span", ts=up_t0, dur=up_dur,
                       step=applied, version=server.version)
+        if lint is not None:
+            # bill the just-published version with its composing pushes
+            # (one per active worker in sync-barrier mode — mirroring
+            # the pending[w].popleft() above — everything pending in
+            # async mode, i.e. exactly the push just applied)
+            lint.observe_publish(server.version, up_dur,
+                                 workers=lineage_workers)
         if cadence:
             cadence.maybe_save(params, state, server, applied_before + applied)
         _fire_server_faults()
@@ -788,7 +836,7 @@ def serve(
             if rec is not None:
                 rec.event("serve.degraded_round", step=applied,
                           absent=sorted(dead_workers))
-        _post_update(up_t0)
+        _post_update(up_t0, lineage_workers=active)
         wait_t0 = round_t0 = time.perf_counter()
         return True
 
@@ -830,8 +878,15 @@ def serve(
             action = numon.observe_push(wid, grad, applied_before + applied)
             if action == "abort":
                 numerics_stop = True
+                if lint is not None:
+                    # the consumed push will never compose a version —
+                    # give it its own drop row instead of leaking it
+                    # into the next publish's lineage
+                    lint.discard_last(wid, reason="numerics")
                 break
             if action == "skip":
+                if lint is not None:
+                    lint.discard_last(wid, reason="numerics")
                 wait_t0 = time.perf_counter()
                 continue
             if action == "zero":
@@ -902,6 +957,9 @@ def serve(
         if numerics_stop:
             m["numerics_abort"] = numon.aborted
         numon.close()
+    if lint is not None:
+        m["lineage"] = lint.snapshot()
+        lint.close()
     if cfg.get("telemetry_dir"):
         # final scrape snapshot for offline tooling: telemetry_report
         # tabulates the labeled series (per-worker rejections, anomaly

@@ -541,6 +541,9 @@ class ShmPSWorker:
         # is computed from THIS side's config — drift fails the compare
         self.frame = bool(frame)
         self._tamper = None  # one-shot outgoing-bytes hook (fault injection)
+        # monotonic push sequence for the frame trace ID — the fallback
+        # when the caller doesn't pass an explicit lineage=(step, seq)
+        self._auto_seq = 0
         if self.frame:
             from pytorch_ps_mpi_tpu.resilience import frames as _frames
 
@@ -582,7 +585,12 @@ class ShmPSWorker:
         )
 
     def push_grad(self, grad: PyTree, version: int,
-                  timeout: float = 30.0) -> None:
+                  timeout: float = 30.0,
+                  lineage: Optional[Tuple[int, int]] = None) -> None:
+        """``lineage=(step, seq)`` stamps the push's trace ID into the
+        v2 frame header (worker id travels in the transport); without it
+        a per-transport auto-incrementing seq is used. Ignored on the
+        unframed wire — there is nowhere to carry it."""
         if self.wire:
             # encode-before-send (reference ps.py:94): only payload bytes
             # ever enter the mailbox. encode_to_bytes hands back its
@@ -592,8 +600,11 @@ class ShmPSWorker:
         else:
             flat = _flatten(grad)
         if self.frame:
+            step, seq = lineage if lineage is not None else (0, self._auto_seq)
+            self._auto_seq += 1
             flat = self._frames.seal_frame(self._frame_buf, flat,
-                                           self._fingerprint)
+                                           self._fingerprint,
+                                           step=step, seq=seq)
         if self._tamper is not None:
             # fault injection: corrupt the outgoing bytes AFTER sealing,
             # so the CRC no longer matches what travels

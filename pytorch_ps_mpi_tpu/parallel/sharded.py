@@ -135,6 +135,16 @@ def server_main(shard_id: int, n_shards: int, port: int,
                 or cfg.get("metrics_port") is not None):
             health_port = server.start_metrics_http(0)
 
+    # per-shard gradient lineage: each shard tracks the trace IDs its
+    # own framed pushes carry (staleness is shard-local, so lineage is
+    # too) into lineage-shard<i>.jsonl — same arming rule as serve()
+    tracker = None
+    if ((cfg.get("lineage") or cfg.get("lineage_dir"))
+            and cfg.get("frame_check")):
+        from pytorch_ps_mpi_tpu.telemetry.lineage import LineageTracker
+
+        tracker = LineageTracker(server, cfg, name=f"shard{shard_id}")
+
     ckpt = None
     applied_before = 0
     checkpoint_every = int(cfg.get("checkpoint_every", 50))
@@ -187,11 +197,15 @@ def server_main(shard_id: int, n_shards: int, port: int,
             wid, ver, grad = item
             if monitor is not None:
                 monitor.observe_grad(wid, max(0, server.version - ver))
+            up_t0 = time.perf_counter()
             params, state = update(params, grad, state)
             applied += 1
             if slow_ms:
                 time.sleep(slow_ms / 1e3)
             server.publish(jax.tree.map(np.asarray, params))
+            if tracker is not None:
+                tracker.observe_publish(server.version,
+                                        time.perf_counter() - up_t0)
             if cadence:
                 cadence.maybe_save(params, state, server,
                                    applied_before + applied)
@@ -213,8 +227,12 @@ def server_main(shard_id: int, n_shards: int, port: int,
                 {int(k): int(v) for k, v in server.staleness_seen.items()}
             ),
             health=(monitor.render_json() if monitor is not None else "{}"),
+            lineage=json.dumps(tracker.snapshot()
+                               if tracker is not None else {}),
         )
     finally:
+        if tracker is not None:
+            tracker.close()
         server.close()
 
 
@@ -292,8 +310,11 @@ def worker_main_sharded(addrs: Sequence[str], worker_id: int,
                 time.sleep(slow_ms / 1e3)
             g_flat = _flatten(grads)
             for (start, stop), ver, w in zip(plan, versions, conns):
+                # one push per shard per step: the step doubles as the
+                # monotonic per-connection push seq in the trace ID
                 w.push_grad({"flat": g_flat[start:stop]}, ver,
-                            timeout=float(cfg.get("push_timeout", 60.0)))
+                            timeout=float(cfg.get("push_timeout", 60.0)),
+                            lineage=(step, step))
             pushed += 1
     finally:
         for w in conns:

@@ -377,6 +377,9 @@ class TcpPSWorker:
         # is computed from THIS side's config — drift fails the compare
         self.frame = bool(frame)
         self._tamper = None  # one-shot outgoing-bytes hook (fault injection)
+        # monotonic push sequence for the frame trace ID — the fallback
+        # when the caller doesn't pass an explicit lineage=(step, seq)
+        self._auto_seq = 0
         if self.frame:
             from pytorch_ps_mpi_tpu.resilience import frames as _frames
 
@@ -414,7 +417,10 @@ class TcpPSWorker:
         )
 
     def push_grad(self, grad: PyTree, version: int,
-                  timeout: float = 30.0) -> None:
+                  timeout: float = 30.0,
+                  lineage: Optional[Tuple[int, int]] = None) -> None:
+        """``lineage=(step, seq)`` stamps the push's trace ID into the
+        v2 frame header — same contract as ``ShmPSWorker.push_grad``."""
         if self.wire:
             # encode_to_bytes returns its preallocated ping-pong wire
             # buffer (one contiguous bucket payload per push) — the native
@@ -423,8 +429,11 @@ class TcpPSWorker:
         else:
             flat = _flatten(grad)
         if self.frame:
+            step, seq = lineage if lineage is not None else (0, self._auto_seq)
+            self._auto_seq += 1
             flat = self._frames.seal_frame(self._frame_buf, flat,
-                                           self._fingerprint)
+                                           self._fingerprint,
+                                           step=step, seq=seq)
         if self._tamper is not None:
             # fault injection: corrupt the outgoing bytes AFTER sealing,
             # so the CRC no longer matches what travels

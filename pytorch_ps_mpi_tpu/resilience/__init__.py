@@ -13,12 +13,14 @@ package closes that gap with four cooperating pieces:
   crash_server) consulted by the worker loop and the serve loop, so every
   chaos scenario is a reproducible test, not a flake: the same plan and
   seed produce the same injected-event log, byte-for-byte.
-- :mod:`.frames` — self-verifying wire frames: a 20-byte header (magic,
-  payload length, CRC32, config fingerprint hashing codec name/kw +
-  bucket layout + template treedef) on every gradient push, so payload
-  corruption and codec/bucket config drift — documented as
-  "undetectable" by the flat-bucket wire — fail loudly as a counted,
-  per-worker rejection instead of a silent mis-decode or a PS crash.
+- :mod:`.frames` — self-verifying wire frames: a 36-byte v2 header
+  (magic/version, payload length, CRC32, config fingerprint hashing
+  codec name/kw + bucket layout + template treedef, plus the lineage
+  trace-ID fields step/seq/send_wall) on every gradient push, so
+  payload corruption, codec/bucket config drift — documented as
+  "undetectable" by the flat-bucket wire — and stale-format peers all
+  fail loudly as a counted, per-worker rejection instead of a silent
+  mis-decode or a PS crash.
 - :mod:`.worker` — :class:`ResilientWorker`, wrapping ``ShmPSWorker`` /
   ``TcpPSWorker`` with exponential backoff + deterministic jitter on
   timeouts and a full reconnect on EOF/transport errors, so a server
@@ -46,8 +48,11 @@ from pytorch_ps_mpi_tpu.resilience.faults import (
 )
 from pytorch_ps_mpi_tpu.resilience.frames import (
     FRAME_MAGIC,
+    FRAME_MAGIC_V1,
     HEADER_BYTES,
+    HEADER_BYTES_V1,
     open_frame,
+    read_lineage,
     seal_frame,
     wire_fingerprint,
 )
@@ -62,8 +67,11 @@ __all__ = [
     "load_fault_log",
     "normalize_plan",
     "FRAME_MAGIC",
+    "FRAME_MAGIC_V1",
     "HEADER_BYTES",
+    "HEADER_BYTES_V1",
     "open_frame",
+    "read_lineage",
     "seal_frame",
     "wire_fingerprint",
     "Supervisor",

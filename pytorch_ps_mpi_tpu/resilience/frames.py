@@ -5,14 +5,20 @@ is enforced only through a total-byte-count check, so a codec/bucket
 config mismatch that happens to preserve the byte count (identity codec
 over a mixed-dtype tree, same-size codec-kw drift) silently mis-decodes,
 and a size mismatch killed the PS with a ``RuntimeError`` from
-``poll_grad``. This module closes both holes with a 20-byte header
-prepended to every gradient push when frame checking is enabled
+``poll_grad``. This module closes both holes — and, since the v2 format,
+carries the **push trace ID** the lineage layer
+(:mod:`pytorch_ps_mpi_tpu.telemetry.lineage`) consumes — with a 36-byte
+header prepended to every gradient push when frame checking is enabled
 (``frame=True`` on the servers/workers, ``cfg["frame_check"]`` on the
 async fleet):
 
-``magic u32 | payload_len u32 | crc32 u32 | fingerprint u64``
+``magic u32 | payload_len u32 | crc32 u32 | fingerprint u64 |``
+``step u32 | seq u32 | send_wall f64``
 
 - **magic** rejects garbage and framing drift (a peer without frames);
+  the magic doubles as the format VERSION — a v1 (``PSF1``, 20-byte
+  header, PR 3) frame against a v2 server is rejected with the explicit
+  reason ``"version"``, counted but never fatal;
 - **payload_len** rejects truncation inside an otherwise valid slot;
 - **crc32** (of the payload bytes) rejects corruption — the chaos
   injector's ``corrupt`` fault and any real bit-rot on the path;
@@ -23,6 +29,13 @@ async fleet):
   template treedef. Worker and server compute it independently from
   their own config; any drift — even byte-count-preserving — fails the
   compare.
+- **step / seq / send_wall** are the lineage extension (v2): the
+  worker's training step, its monotonic push sequence number, and the
+  wall-clock instant the frame was sealed at the encode site. Together
+  with the transport-carried worker id they form the causal trace ID
+  ``(worker, step, seq)`` every published version's lineage is built
+  from, and the (send_wall, recv_wall) pair per frame is what the
+  cross-process clock-skew fit consumes.
 
 A failed check is a **counted, per-worker rejection**
 (``PSServerTelemetry._reject_frame`` → ``ps_frames_rejected_total``),
@@ -46,16 +59,27 @@ from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
+from pytorch_ps_mpi_tpu.telemetry.recorder import record_event
+
 PyTree = Any
 
-#: Header magic ("PSF1" little-endian). Distinct from the TCP transport's
-#: outer 'TPS1' op-frame magic — this header travels INSIDE the payload of
-#: a transport frame / shm mailbox slot.
-FRAME_MAGIC = 0x31465350
+#: Header magic ("PSF2" little-endian) — the magic IS the format version.
+#: Distinct from the TCP transport's outer 'TPS1' op-frame magic — this
+#: header travels INSIDE the payload of a transport frame / shm mailbox
+#: slot.
+FRAME_MAGIC = 0x32465350
+#: The PR 3 v1 magic ("PSF1", 20-byte header without the lineage
+#: extension). Recognized only to reject it with reason ``"version"``.
+FRAME_MAGIC_V1 = 0x31465350
 
-_HEADER = struct.Struct("<IIIQ")  # magic, payload_len, crc32, fingerprint
+# magic, payload_len, crc32, fingerprint, step, seq, send_wall
+_HEADER = struct.Struct("<IIIQIId")
 HEADER_BYTES = _HEADER.size
-assert HEADER_BYTES == 20
+assert HEADER_BYTES == 36
+HEADER_BYTES_V1 = 20
+#: offset of the lineage extension inside the header (step u32 onward)
+_LINEAGE = struct.Struct("<IId")
+_LINEAGE_OFF = 20
 
 
 def _codec_desc(code) -> dict:
@@ -108,18 +132,24 @@ def wire_fingerprint(wire, template: PyTree) -> int:
     )
 
 
-def seal_frame(out: np.ndarray, payload: np.ndarray,
-               fingerprint: int) -> np.ndarray:
+def seal_frame(out: np.ndarray, payload: np.ndarray, fingerprint: int,
+               step: int = 0, seq: int = 0,
+               send_wall: Optional[float] = None) -> np.ndarray:
     """Write header + payload into the preallocated uint8 buffer ``out``
     (sized ``HEADER_BYTES + payload.nbytes`` by the caller) and return
-    the exact-length view. One extra memcpy per push versus the unframed
-    wire — the price of the end-to-end check."""
+    the exact-length view. ``step``/``seq`` are the push's trace-ID
+    fields (the transport carries the worker id); ``send_wall`` defaults
+    to now — THE encode-site timestamp lineage e2e latency and clock-
+    skew estimation are measured from. One extra memcpy per push versus
+    the unframed wire — the price of the end-to-end check."""
     if payload.dtype != np.uint8:
         payload = payload.view(np.uint8)
     payload = payload.reshape(-1)
     n = payload.nbytes
     _HEADER.pack_into(out, 0, FRAME_MAGIC, n,
-                      zlib.crc32(payload) & 0xFFFFFFFF, fingerprint)
+                      zlib.crc32(payload) & 0xFFFFFFFF, fingerprint,
+                      int(step) & 0xFFFFFFFF, int(seq) & 0xFFFFFFFF,
+                      time.time() if send_wall is None else float(send_wall))
     out[HEADER_BYTES:HEADER_BYTES + n] = payload
     return out[:HEADER_BYTES + n]
 
@@ -131,15 +161,23 @@ def open_frame(
 ) -> Tuple[Optional[np.ndarray], Optional[str]]:
     """Validate a received frame. Returns ``(payload_view, None)`` on
     success or ``(None, reason)`` where reason is one of ``"short"``
-    (no room for a header), ``"magic"``, ``"size"`` (declared/expected
-    length mismatch — the misconfigured-worker case), ``"config"``
+    (no room for a header), ``"version"`` (a v1 frame from a peer
+    running the pre-lineage format — old frames are rejected, never
+    mis-parsed), ``"magic"``, ``"size"`` (declared/expected length
+    mismatch — the misconfigured-worker case), ``"config"``
     (fingerprint drift), ``"corrupt"`` (CRC failure). The payload is a
-    zero-copy view into ``buf``."""
-    if buf.nbytes < HEADER_BYTES:
+    zero-copy view into ``buf``. Lineage fields are NOT returned here —
+    callers read them from a validated frame via :func:`read_lineage`."""
+    if buf.nbytes < 4:
         return None, "short"
-    magic, plen, crc, fp = _HEADER.unpack_from(buf)
+    (magic,) = struct.unpack_from("<I", buf)
+    if magic == FRAME_MAGIC_V1:
+        return None, "version"
     if magic != FRAME_MAGIC:
         return None, "magic"
+    if buf.nbytes < HEADER_BYTES:
+        return None, "short"
+    _, plen, crc, fp, _, _, _ = _HEADER.unpack_from(buf)
     if plen != buf.nbytes - HEADER_BYTES or (
             expected_payload is not None and plen != expected_payload):
         return None, "size"
@@ -151,6 +189,14 @@ def open_frame(
     return payload, None
 
 
+def read_lineage(buf: np.ndarray) -> Tuple[int, int, float]:
+    """``(step, seq, send_wall)`` from a VALIDATED v2 frame — the trace
+    ID the worker's encode site stamped (plus the worker id the
+    transport itself carries)."""
+    step, seq, send_wall = _LINEAGE.unpack_from(buf, _LINEAGE_OFF)
+    return int(step), int(seq), float(send_wall)
+
+
 def framed_poll(
     server, pop_once: Callable[[], Tuple[int, int, int]]
 ) -> Optional[Tuple[int, int, PyTree]]:
@@ -159,13 +205,18 @@ def framed_poll(
     returns ``(nbytes, worker, version)`` with ``nbytes <= 0`` meaning
     nothing pending, the frame bytes landing in ``server._grad_buf``).
 
-    Every popped frame is validated (magic, size, fingerprint, CRC)
-    BEFORE any gradient bookkeeping; a bad frame is a counted per-worker
-    rejection (``server._reject_frame``) and polling continues — one
-    corrupting or misconfigured worker can never kill the PS serving
-    everyone else. Valid frames then get the standard bounded-staleness
-    treatment (count, drop-if-over, decode via
-    ``server._decode_payload``)."""
+    Every popped frame is validated (magic/version, size, fingerprint,
+    CRC) BEFORE any gradient bookkeeping; a bad frame is a counted
+    per-worker rejection (``server._reject_frame``) and polling
+    continues — one corrupting or misconfigured worker can never kill
+    the PS serving everyone else. Valid frames then get the standard
+    bounded-staleness treatment (count, drop-if-over, decode via
+    ``server._decode_payload``) — and their lineage fields (step, seq,
+    send_wall from the header; recv time, staleness, decode wall
+    measured here) feed ``server.lineage_tracker`` when one is attached
+    and land on ``server.last_push_meta`` either way, so the serve loop
+    can read the consumed push's trace ID without re-parsing anything."""
+    lt = getattr(server, "lineage_tracker", None)
     while True:
         n, wid, version = pop_once()
         if n <= 0:
@@ -179,12 +230,36 @@ def framed_poll(
         if err is not None:
             server._reject_frame(wid, err)
             continue
+        recv_wall = time.time()
+        lstep, lseq, send_wall = read_lineage(server._grad_buf)
         staleness = max(0, server.version - version)
         server.staleness_seen[staleness] = (
             server.staleness_seen.get(staleness, 0) + 1
         )
         server.grads_received += 1
         server.bytes_received += payload.nbytes
+        meta = {
+            "worker": int(wid), "step": lstep, "seq": lseq,
+            "version_read": int(version), "staleness": int(staleness),
+            "bytes": int(payload.nbytes),
+            "send_wall": send_wall, "recv_wall": recv_wall,
+        }
         if staleness <= server.max_staleness:
-            return wid, version, server._decode_payload(payload)
+            t_dec = time.monotonic()
+            grad = server._decode_payload(payload)
+            meta["decode_s"] = round(time.monotonic() - t_dec, 6)
+            server.last_push_meta = meta
+            # the server-side anchor of the cross-process flow arrow:
+            # a span carrying the same (worker, step, seq) trace ID the
+            # worker's push span carries
+            record_event("serve.consume", kind="span", ts=t_dec,
+                         dur=meta["decode_s"], step=lstep,
+                         src_worker=int(wid), seq=lseq,
+                         staleness=int(staleness))
+            if lt is not None:
+                lt.observe_consume(meta)
+            return wid, version, grad
         server.stale_drops += 1
+        if lt is not None:
+            meta["stale_drop"] = True
+            lt.observe_consume(meta)

@@ -68,6 +68,11 @@ class ResilientWorker:
         self._rng = random.Random((int(seed) << 16) ^ (worker_id + 1))
         self.retries = 0
         self.reconnects = 0
+        # fallback push seq for the lineage trace ID, owned HERE so it
+        # survives reconnects (a factory-built replacement transport
+        # restarts its own counter at 0, which would reuse trace IDs
+        # the server already consumed)
+        self._auto_seq = 0
         self._tamper = None
         self._w: Optional[Any] = None
         self._w = self._build(initial=True)
@@ -174,8 +179,17 @@ class ResilientWorker:
         return self._call("read_params", timeout=timeout)
 
     def push_grad(self, grad: PyTree, version: int,
-                  timeout: float = 30.0) -> None:
-        out = self._call("push_grad", grad, version, timeout=timeout)
+                  timeout: float = 30.0, lineage=None) -> None:
+        # the trace ID is pinned BEFORE the retry loop: a retransmitted
+        # frame is the SAME push, so every retry (and any reconnect in
+        # between) re-seals with the same (step, seq) — without this,
+        # the inner transport's per-connection auto-seq would mint a
+        # fresh id per retry and restart at 0 after a reconnect
+        if lineage is None:
+            lineage = (0, self._auto_seq)
+            self._auto_seq += 1
+        out = self._call("push_grad", grad, version, timeout=timeout,
+                         lineage=lineage)
         # the transport consumed any one-shot tamper with the push
         self._tamper = getattr(self._w, "_tamper", None)
         return out

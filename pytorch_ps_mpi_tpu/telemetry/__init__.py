@@ -22,6 +22,15 @@ dicts). One system, three faces:
   MAD anomaly flags, compute/wire/churn straggler attribution, sync-
   round critical-path gating) served as ``/health`` JSON beside
   ``/metrics`` and rendered live by ``tools/ps_top.py``.
+- :mod:`lineage <.lineage>` — the layer that makes the streams CAUSAL:
+  every framed gradient push carries a trace ID (worker, step, seq) +
+  encode-site timestamp from the v2 frame header; the
+  :class:`LineageTracker` bills every published version with the exact
+  pushes that composed it, measures exact per-push e2e latency and
+  staleness (replacing the PR 4 EWMA estimates), extracts stage-level
+  sync-round critical paths, and feeds cross-process clock-skew
+  estimation so the merged Chrome trace can draw flow arrows from a
+  worker's push span to the server's consume span.
 - :mod:`numerics <.numerics>` — the layer that watches the NUMBERS:
   :class:`NumericsMonitor` fuses gradient statistics into the lowered
   step programs (grad norms, NaN/Inf counts, update-to-weight ratio),
@@ -60,6 +69,13 @@ from pytorch_ps_mpi_tpu.telemetry.diagnosis import (
     BeaconWriter,
     HealthMonitor,
 )
+from pytorch_ps_mpi_tpu.telemetry.lineage import (
+    LineageTracker,
+    clock_offsets_from_rows,
+    estimate_clock_offset,
+    load_lineage_rows,
+    trace_id,
+)
 from pytorch_ps_mpi_tpu.telemetry.numerics import (
     NumericsMonitor,
     ProbeWriter,
@@ -92,6 +108,11 @@ __all__ = [
     "MetricsHTTPServer",
     "BeaconWriter",
     "HealthMonitor",
+    "LineageTracker",
+    "clock_offsets_from_rows",
+    "estimate_clock_offset",
+    "load_lineage_rows",
+    "trace_id",
     "NumericsMonitor",
     "ProbeWriter",
     "tree_stats",

@@ -406,6 +406,7 @@ class HealthMonitor:
         # ONE numerics snapshot, indexed per worker below — the verdict
         # section and the per-worker rows can never drift apart
         nsnap = nm.snapshot() if nm is not None else None
+        lt = getattr(self.server, "lineage_tracker", None)
         workers = []
         for wid in range(self.num_workers):
             h = self._w[wid]
@@ -442,6 +443,11 @@ class HealthMonitor:
                 "gating": {"rounds": h.gated_rounds,
                            "seconds": round(h.gating_s, 6)},
                 "numerics": num_row,
+                # exact per-push staleness/e2e from the frame trace IDs
+                # (telemetry.lineage) — the measured numbers beside the
+                # EWMA estimates above; None when lineage is unarmed
+                "lineage": (lt.worker_summary(wid)
+                            if lt is not None else None),
             })
         fleet: Dict[str, Any] = {
             "anomaly_total": sum(h.anomalies for h in self._w),
@@ -470,6 +476,10 @@ class HealthMonitor:
             # the numerics verdict section: quarantine state, grad-norm
             # trajectory summary, latest codec-fidelity probe, postmortems
             out["numerics"] = nsnap
+        if lt is not None:
+            # the lineage section: exact e2e/staleness distributions,
+            # composition counters, stage-level critical paths
+            out["lineage"] = lt.snapshot()
         return out
 
     def render_json(self) -> str:

@@ -451,6 +451,19 @@ class NumericsMonitor:
             },
             "recorder_tail": events,
         }
+        lt = getattr(self.server, "lineage_tracker", None)
+        if lt is not None:
+            # the causal half of the capture (telemetry.lineage): the
+            # offending push's trace ID, the offender's recent composed
+            # pushes, and the pushes that composed the last published
+            # version — "which worker pushes made this version bad"
+            # answered from data, not inference
+            doc["lineage"] = {
+                "offending_push": getattr(self.server, "last_push_meta",
+                                          None),
+                "offender_recent": lt.recent(8, worker=worker),
+                "last_publish": lt.last_publish,
+            }
         os.makedirs(self.dir, exist_ok=True)
         import glob as _glob
 

@@ -320,6 +320,15 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "update_ratio",
     "codec_rel_error",
     "ef_residual_norm",
+    # gradient lineage (telemetry.lineage.LineageTracker): all 0.0 when
+    # lineage is unarmed. lineage_pushes counts pushes billed to a
+    # published version; push_e2e_p*_ms are EXACT per-push end-to-end
+    # latencies (worker encode -> version published) measured from the
+    # v2 frame headers' trace IDs — the measured numbers the PR 4
+    # interarrival EWMAs only estimate
+    "lineage_pushes",
+    "push_e2e_p50_ms",
+    "push_e2e_p95_ms",
 )
 
 
@@ -365,6 +374,7 @@ def ps_server_metrics(server) -> Dict[str, float]:
         # the no-codec wire ships ONE concatenated f32 buffer per push
         units = 1.0 if jax.tree.leaves(server.template) else 0.0
     nm = getattr(server, "numerics_monitor", None)
+    lt = getattr(server, "lineage_tracker", None)
     return {
         "grads_received": float(server.grads_received),
         "bytes_received": float(server.bytes_received),
@@ -387,6 +397,11 @@ def ps_server_metrics(server) -> Dict[str, float]:
             nm.codec_rel_error if nm is not None else 0.0),
         "ef_residual_norm": float(
             nm.ef_residual_norm if nm is not None else 0.0),
+        "lineage_pushes": float(lt.composed if lt is not None else 0.0),
+        "push_e2e_p50_ms": float(
+            lt.e2e_ms_quantile(0.50) if lt is not None else 0.0),
+        "push_e2e_p95_ms": float(
+            lt.e2e_ms_quantile(0.95) if lt is not None else 0.0),
     }
 
 
@@ -484,6 +499,15 @@ class PSServerTelemetry:
     #: section), set by ``serve()`` when numerics is armed — see
     #: :mod:`.numerics`
     numerics_monitor: Optional[Any] = None
+    #: the attached gradient-lineage tracker (trace-ID consumer — the
+    #: exact e2e-latency/staleness source for the canonical schema, fed
+    #: by ``resilience.frames.framed_poll``), set by ``serve()`` when
+    #: lineage is armed — see :mod:`.lineage`
+    lineage_tracker: Optional[Any] = None
+    #: the last consumed push's frame-carried lineage meta (worker,
+    #: step, seq, staleness, send/recv walls, decode_s), refreshed by
+    #: ``framed_poll`` on every successful pop
+    last_push_meta: Optional[Dict[str, Any]] = None
 
     @property
     def frames_rejected(self) -> Dict[int, int]:

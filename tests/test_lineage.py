@@ -1,0 +1,470 @@
+"""Gradient lineage: trace IDs end to end, clock-skew estimation,
+composition tracking, critical-path extraction, flow-event export,
+report/ps_top surfaces.
+
+The exactness contract under test: every consumed push is accounted for
+by exactly one lineage row (publish composition, stale drop, or
+numerics drop), the staleness those rows carry is the serve loop's own
+version arithmetic (not an estimate), and the merged Chrome trace links
+a worker's push span to the server's consume span through the shared
+(worker, step, seq) trace ID after clock-skew correction.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.telemetry.lineage import (
+    LineageTracker,
+    clock_offsets_from_rows,
+    estimate_clock_offset,
+    lineage_path,
+    load_lineage_rows,
+    trace_id,
+)
+
+
+def _meta(worker=0, step=0, seq=0, staleness=0, send=100.0, recv=100.01,
+          **kw):
+    return {"worker": worker, "step": step, "seq": seq,
+            "version_read": 1, "staleness": staleness, "bytes": 64,
+            "send_wall": send, "recv_wall": recv, "decode_s": 0.001,
+            **kw}
+
+
+# ---------------------------------------------------------------------------
+# clock-skew estimation
+# ---------------------------------------------------------------------------
+
+def test_clock_offset_recovers_synthetic_skew():
+    """A synthetic 5 s offset + nonnegative jitter is recovered within
+    the jitter bound (the lower-envelope estimator is biased by at most
+    the MINIMUM latency, not the mean)."""
+    rng = np.random.RandomState(7)
+    offset = 5.0
+    send = np.cumsum(rng.uniform(0.001, 0.05, size=200))
+    latency = rng.uniform(0.0, 0.02, size=200)  # jitter, >= 0
+    pairs = [(s, s + offset + l) for s, l in zip(send, latency)]
+    est = estimate_clock_offset(pairs)
+    assert offset <= est <= offset + 0.02 + 1e-9
+
+    # negative offset (receiver clock BEHIND sender) works identically
+    pairs = [(s, s - 3.0 + l) for s, l in zip(send, latency)]
+    est = estimate_clock_offset(pairs)
+    assert -3.0 <= est <= -3.0 + 0.02 + 1e-9
+
+
+def test_clock_offset_degenerate_cases():
+    """One sample returns that sample's difference; empty input is a
+    loud error, never a silent 0.0 (0.0 is a valid offset)."""
+    assert estimate_clock_offset([(10.0, 12.5)]) == pytest.approx(2.5)
+    with pytest.raises(ValueError):
+        estimate_clock_offset([])
+
+
+def test_clock_offsets_from_rows_per_worker():
+    rows = [
+        {"kind": "publish", "pushes": [
+            _meta(worker=0, send=100.0, recv=100.010),
+            _meta(worker=1, send=100.0, recv=107.020),
+        ]},
+        {"kind": "drop", "push": _meta(worker=1, send=101.0, recv=108.005)},
+    ]
+    offs = clock_offsets_from_rows(rows)
+    assert offs[0] == pytest.approx(0.010)
+    assert offs[1] == pytest.approx(7.005)  # min over both pairs
+
+
+# ---------------------------------------------------------------------------
+# tracker: composition, drops, exactness
+# ---------------------------------------------------------------------------
+
+def test_tracker_async_composition_and_file_rows(tmp_path):
+    """Async mode: each publish is billed with exactly the push just
+    consumed; rows land on disk with complete trace IDs and measured
+    e2e; the exact staleness histogram mirrors what was fed."""
+    lt = LineageTracker(num_workers=2, cfg={"lineage_dir": str(tmp_path)})
+    lt.observe_consume(_meta(worker=0, step=3, seq=7, staleness=1,
+                             send=100.0, recv=100.010))
+    row = lt.observe_publish(version=5, apply_s=0.002, now=100.020)
+    assert [p["seq"] for p in row["pushes"]] == [7]
+    assert row["pushes"][0]["e2e_s"] == pytest.approx(0.020)
+    assert row["pushes"][0]["wire_s"] == pytest.approx(0.010)
+
+    # a stale-dropped push gets its own row, never composes
+    lt.observe_consume(_meta(worker=1, step=0, seq=0, staleness=9,
+                             stale_drop=True))
+    row2 = lt.observe_publish(version=6, apply_s=0.001, now=100.040)
+    assert row2["pushes"] == []
+    lt.close()
+
+    rows = load_lineage_rows(lineage_path(str(tmp_path), "server"))
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["publish", "drop", "publish"]
+    assert rows[1]["reason"] == "stale"
+    assert rows[1]["push"]["staleness"] == 9
+    assert lt.staleness_exact == {1: 1, 9: 1}
+    assert lt.consumed == 2 and lt.composed == 1 and lt.drops == 1
+    s = lt.worker_summary(0)
+    assert s["pushes"] == 1 and s["stale_last"] == 1
+    assert s["e2e_ms_last"] == pytest.approx(20.0)
+
+
+def test_tracker_numerics_discard(tmp_path):
+    """A numerics-skipped push is pulled back out of the composition
+    queue: the next publish must NOT claim it."""
+    lt = LineageTracker(num_workers=1, cfg={"lineage_dir": str(tmp_path)})
+    lt.observe_consume(_meta(seq=0))
+    lt.discard_last(0, reason="numerics")
+    lt.observe_consume(_meta(seq=1))
+    row = lt.observe_publish(version=2, apply_s=0.001)
+    assert [p["seq"] for p in row["pushes"]] == [1]
+    lt.close()
+    rows = load_lineage_rows(lineage_path(str(tmp_path), "server"))
+    assert rows[0] == {**rows[0], "kind": "drop", "reason": "numerics"}
+    assert rows[0]["push"]["seq"] == 0
+
+
+def test_tracker_sync_round_critical_path(tmp_path):
+    """Sync-barrier mode: one push per listed worker composes the
+    round; the LAST-arriving push's dominant stage is the round's
+    critical path (here: worker 1, wire-bound)."""
+    lt = LineageTracker(num_workers=2, cfg={"lineage_dir": str(tmp_path)})
+    # warmup round so worker 1 has a previous send (produce gap known);
+    # the 100 ms produce gap must lose to the 500 ms wire stage below
+    lt.observe_consume(_meta(worker=0, seq=0, send=99.9, recv=99.901))
+    lt.observe_consume(_meta(worker=1, seq=0, send=99.9, recv=99.902))
+    lt.observe_publish(version=1, apply_s=0.001, workers=[0, 1],
+                       now=99.91)
+    # round 2: worker 1's push spends 500 ms on the wire and arrives last
+    lt.observe_consume(_meta(worker=0, step=1, seq=1, send=100.0,
+                             recv=100.001))
+    lt.observe_consume(_meta(worker=1, step=1, seq=1, send=100.0,
+                             recv=100.5))
+    row = lt.observe_publish(version=2, apply_s=0.001, workers=[0, 1],
+                             now=100.51)
+    assert len(row["pushes"]) == 2
+    lt.close()
+    rounds = [r for r in load_lineage_rows(
+        lineage_path(str(tmp_path), "server")) if r["kind"] == "round"]
+    assert rounds, "no round row written for a 2-push publish"
+    last = rounds[-1]
+    assert last["gating_worker"] == 1
+    assert last["stage"] == "wire"
+    assert last["stage_s"] == pytest.approx(0.5, abs=1e-3)
+    assert last["trace"] == trace_id(1, 1, 1)
+    assert lt.critical_path[(1, "wire")] >= 1
+    # sync composition pops ONE per worker, FIFO — queues are drained
+    assert all(not q for q in lt._uncomposed.values())
+
+
+def test_tracker_scrape_instruments_and_canonical_keys():
+    """The tracker's exact quantiles ride the canonical server metrics
+    and the scrape registry on any PSServerTelemetry server."""
+    from pytorch_ps_mpi_tpu.telemetry.registry import (
+        PS_SERVER_METRIC_KEYS,
+        PSServerTelemetry,
+    )
+
+    class FakeServer(PSServerTelemetry):
+        num_workers = 2
+        max_staleness = 4
+        version = 3
+        wire = None
+        template = {"w": np.zeros(4, np.float32)}
+        grads_received = 0
+        bytes_received = 0
+        stale_drops = 0
+        staleness_seen = {}
+
+    server = FakeServer()
+    lt = LineageTracker(server, cfg={})
+    assert server.lineage_tracker is lt
+    lt.observe_consume(_meta(worker=0, staleness=2, send=10.0, recv=10.1))
+    lt.observe_publish(version=4, apply_s=0.001, now=10.2)
+    m = server.metrics()
+    assert set(PS_SERVER_METRIC_KEYS) <= set(m)
+    assert m["lineage_pushes"] == 1.0
+    assert m["push_e2e_p50_ms"] == pytest.approx(200.0, rel=1e-6)
+    text = server.prometheus_text()
+    assert "ps_push_e2e_seconds_count 1" in text
+    assert "ps_lineage_pushes_total 1" in text
+    assert "ps_staleness_exact_p95 2" in text
+
+
+def test_numerics_postmortem_embeds_lineage(tmp_path):
+    """PR 5's postmortems gain the causal half: the offending push's
+    trace ID, the offender's recent composed pushes, and the last
+    published version's composition."""
+    from pytorch_ps_mpi_tpu.telemetry.numerics import NumericsMonitor
+    from pytorch_ps_mpi_tpu.telemetry.registry import PSServerTelemetry
+
+    class FakeServer(PSServerTelemetry):
+        num_workers = 2
+        max_staleness = 4
+        version = 3
+        wire = None
+        template = {"w": np.zeros(4, np.float32)}
+        grads_received = 0
+        bytes_received = 0
+        stale_drops = 0
+        staleness_seen = {}
+
+    server = FakeServer()
+    lt = LineageTracker(server, cfg={"lineage_dir": str(tmp_path)})
+    numon = NumericsMonitor(server, {"numerics_dir": str(tmp_path)})
+    # one healthy composed push from worker 1, then its NaN push
+    lt.observe_consume(_meta(worker=1, step=0, seq=0))
+    lt.observe_publish(version=4, apply_s=0.001, now=100.02)
+    bad_meta = _meta(worker=1, step=1, seq=1, staleness=2)
+    lt.observe_consume(bad_meta)
+    server.last_push_meta = bad_meta
+    action = numon.observe_push(1, {"w": np.full(4, np.nan, np.float32)})
+    assert action == "skip"
+    lt.discard_last(1, reason="numerics")
+
+    pm_files = [f for f in os.listdir(tmp_path)
+                if f.startswith("postmortem-")]
+    assert pm_files, "no postmortem written"
+    with open(tmp_path / pm_files[0]) as f:
+        doc = json.load(f)
+    lin = doc["lineage"]
+    assert lin["offending_push"]["seq"] == 1
+    assert lin["offending_push"]["staleness"] == 2
+    assert [p["seq"] for p in lin["offender_recent"]] == [0]
+    assert lin["last_publish"]["version"] == 4
+    lt.close()
+    numon.close()
+
+
+# ---------------------------------------------------------------------------
+# flow events in the merged trace
+# ---------------------------------------------------------------------------
+
+def _span(name, worker, step, seq_attr, wall, dur=0.002, **attrs):
+    return {"name": name, "kind": "span", "ts": wall, "wall": wall,
+            "dur": dur, "worker": worker, "step": step,
+            "attrs": {"seq": seq_attr, **attrs}}
+
+
+def test_flow_events_link_push_to_consume(tmp_path):
+    """The merged trace carries one matched s→f flow pair per composed
+    push whose both anchor spans exist, with the trace ID as the flow
+    id and the two halves on different tracks."""
+    from pytorch_ps_mpi_tpu.telemetry.trace_export import (
+        export_chrome_trace,
+        merged_trace_events,
+    )
+
+    events = [
+        _span("worker.push_grad", 0, 5, 9, wall=100.000),
+        _span("serve.consume", "server", 5, 9, wall=100.010,
+              src_worker=0),
+        # an unrelated span must not anchor anything
+        _span("worker.grad", 0, 5, 9, wall=99.0),
+    ]
+    rows = [{"kind": "publish", "version": 2, "t": 100.02, "pushes": [
+        _meta(worker=0, step=5, seq=9, send=100.0, recv=100.01),
+        _meta(worker=1, step=5, seq=9, send=100.0, recv=100.01),  # no spans
+    ]}]
+    out = merged_trace_events(events, lineage_rows=rows)
+    flows = [e for e in out if e.get("cat") == "lineage"]
+    assert {e["ph"] for e in flows} == {"s", "f"}
+    s = next(e for e in flows if e["ph"] == "s")
+    f = next(e for e in flows if e["ph"] == "f")
+    assert s["id"] == f["id"] == "0/5/9"
+    assert s["tid"] != f["tid"]  # worker track vs server track
+    assert f["bp"] == "e"
+    # worker 1's push has no recorder spans: skipped, not guessed
+    assert len(flows) == 2
+
+    path, counts = export_chrome_trace(
+        str(tmp_path / "trace.json"), events, lineage_rows=rows)
+    assert counts["flow"] == 1
+    with open(path) as fh:
+        json.load(fh)  # valid JSON artifact
+
+
+def test_flow_events_clock_correction_shifts_worker_rows():
+    """A worker whose clock runs 7 s behind the server's lands BESIDE
+    the server spans (not 7 s away) once the lineage-fitted offset is
+    applied; the server's own rows stay put."""
+    from pytorch_ps_mpi_tpu.telemetry.trace_export import (
+        apply_clock_offsets,
+        merged_trace_events,
+    )
+
+    worker_wall, server_wall = 100.0, 107.010
+    events = [
+        _span("worker.push_grad", 0, 0, 0, wall=worker_wall),
+        _span("serve.consume", "server", 0, 0, wall=server_wall,
+              src_worker=0),
+    ]
+    rows = [{"kind": "publish", "version": 1, "t": server_wall + 0.01,
+             "pushes": [_meta(worker=0, step=0, seq=0, send=worker_wall,
+                              recv=server_wall)]}]
+    offsets = clock_offsets_from_rows(rows)
+    assert offsets[0] == pytest.approx(7.010)
+    shifted = apply_clock_offsets(events, offsets)
+    assert shifted[0]["wall"] == pytest.approx(worker_wall + 7.010)
+    assert shifted[1]["wall"] == server_wall  # reference clock untouched
+    out = merged_trace_events(events, lineage_rows=rows,
+                              clock_offsets=offsets)
+    spans = {e["name"]: e for e in out if e.get("ph") == "X"}
+    # corrected: push sits at t=0, consume right at t=0 too (the push
+    # WAS the fastest frame), not 7 s later
+    assert abs(spans["worker.push_grad"]["ts"]
+               - spans["serve.consume"]["ts"]) < 1e3  # < 1 ms in us
+
+
+# ---------------------------------------------------------------------------
+# report + ps_top surfaces
+# ---------------------------------------------------------------------------
+
+def test_report_lineage_section_and_routing(tmp_path):
+    """Dir mode routes lineage-*.jsonl away from the recorder-span merge
+    and into the lineage section: per-worker latency/staleness, the
+    composition summary, and critical-path stages."""
+    from tools.telemetry_report import format_table, summarize
+
+    # a recorder file AND a lineage file in one dir
+    rec = tmp_path / "server.jsonl"
+    with open(rec, "w") as f:
+        f.write(json.dumps({"kind": "recorder_meta", "n_events": 1,
+                            "dropped": 0, "worker": "server"}) + "\n")
+        f.write(json.dumps({"name": "serve.update", "kind": "span",
+                            "ts": 0.0, "wall": 100.0, "dur": 0.01}) + "\n")
+    lin = tmp_path / "lineage-server.jsonl"
+    with open(lin, "w") as f:
+        f.write(json.dumps({"kind": "publish", "version": 1, "t": 100.0,
+                            "apply_s": 0.001, "pushes": [
+                                _meta(worker=0, e2e_s=0.02, wire_s=0.01),
+                                _meta(worker=1, staleness=3, e2e_s=0.5,
+                                      wire_s=0.4)]}) + "\n")
+        f.write(json.dumps({"kind": "drop", "reason": "stale", "t": 100.1,
+                            "push": _meta(worker=1, staleness=9)}) + "\n")
+        f.write(json.dumps({"kind": "round", "round": 1, "version": 1,
+                            "t": 100.0, "gating_worker": 1,
+                            "stage": "wire", "stage_s": 0.4,
+                            "stages": {}, "trace": "1/0/0"}) + "\n")
+
+    summary = summarize([str(rec), str(lin)])
+    # lineage rows never polluted the span table
+    assert all(r["name"] != "publish" for r in summary["spans"])
+    lin_sec = summary["lineage"]
+    assert lin_sec["publishes"] == 1
+    assert lin_sec["pushes_composed"] == 2
+    assert lin_sec["drops"] == 1
+    w1 = next(w for w in lin_sec["workers"] if w["worker"] == 1)
+    assert w1["pushes"] == 2  # composed + dropped
+    assert w1["stale_max"] == 9
+    assert w1["e2e_ms_p50"] == pytest.approx(500.0)
+    assert lin_sec["critical_path"] == [
+        {"worker": 1, "stage": "wire", "rounds": 1}]
+    table = format_table(summary)
+    assert "lineage:" in table
+    assert "critical path: worker 1 [wire] gated 1 rounds" in table
+
+
+def test_ps_top_lineage_columns_and_sort():
+    """stale(exact) + e2e ms columns render from the /health lineage
+    rows; the e2e sort puts the slowest-push worker first."""
+    from tools.ps_top import SORT_KEYS, render_table
+
+    def wrow(wid, e2e_p50, stale_last):
+        return {
+            "worker": wid, "verdict": "ok", "cause": None, "done": False,
+            "grads": 10,
+            "push_interarrival_s": {"ewma": 0.01, "p50": 0.01,
+                                    "p95": 0.02, "n": 10},
+            "staleness": {"ewma": 0.4, "last": 0},
+            "anomalies": 0, "last_anomaly": None,
+            "server_wait_ewma_s": 0.0, "compute_ewma_s": 0.0,
+            "wire_ewma_s": 0.0, "steps_beaconed": 0,
+            "straggle_total_s": 0.0, "retries": 0, "reconnects": 0,
+            "frames_rejected": 0, "last_seen_age_s": 0.1,
+            "gating": {"rounds": 0, "seconds": 0.0},
+            "numerics": None,
+            "lineage": {"pushes": 10, "stale_last": stale_last,
+                        "stale_p50": float(stale_last),
+                        "e2e_ms_last": e2e_p50, "e2e_ms_p50": e2e_p50,
+                        "gated_rounds": 0},
+        }
+
+    health = {"armed": True, "n_workers": 2, "uptime_s": 5.0,
+              "fleet": {"grads_received": 20, "stale_drops": 0,
+                        "staleness_p50": 0, "staleness_p95": 1,
+                        "staleness_p99": 1, "anomaly_total": 0,
+                        "rounds": 0},
+              "workers": [wrow(0, 12.5, 0), wrow(1, 480.0, 3)]}
+    assert "e2e" in SORT_KEYS
+    frame = render_table(health, sort="e2e")
+    lines = frame.splitlines()
+    assert "stale-x" in lines[1] and "e2e-ms" in lines[1]
+    first_row = lines[3]
+    assert first_row.strip().startswith("1")  # slowest e2e first
+    assert "480.0" in first_row and "3" in first_row.split()
+
+    # unarmed lineage renders dashes, not a crash
+    health["workers"][0]["lineage"] = None
+    frame = render_table(health, sort="worker")
+    assert frame.splitlines()[3].count("-") >= 2
+
+
+# ---------------------------------------------------------------------------
+# live wire: trace IDs travel the v2 frames end to end (shm)
+# ---------------------------------------------------------------------------
+
+def test_shm_trace_id_travels_encode_to_serve():
+    """A push sealed with lineage=(step, seq) at the worker's encode
+    site arrives server-side with the same trace ID on
+    ``server.last_push_meta`` and composes the published version's
+    lineage row — the wire half of the tentpole, without spawning
+    processes."""
+    from pytorch_ps_mpi_tpu.parallel import dcn
+
+    if dcn.get_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    tpl = {"w": np.zeros((8,), np.float32)}
+    name = f"/psq_lin_{os.getpid()}"
+    server = dcn.ShmPSServer(name, num_workers=1, template=tpl,
+                             frame=True, max_staleness=10**9)
+    lt = LineageTracker(server, cfg={})
+    w = dcn.ShmPSWorker(name, 0, tpl, frame=True)
+    try:
+        server.publish({"w": np.zeros(8, np.float32)})
+        done = {}
+
+        def body():
+            _, ver = w.read_params(timeout=30)
+            t0 = time.time()
+            w.push_grad({"w": np.ones(8, np.float32)}, ver, timeout=30,
+                        lineage=(4, 11))
+            done["sent_after"] = t0
+
+        t = threading.Thread(target=body)
+        t.start()
+        item = None
+        deadline = time.time() + 30
+        while item is None and time.time() < deadline:
+            item = server.poll_grad()
+            time.sleep(0.002)
+        t.join(timeout=30)
+        assert item is not None and item[0] == 0
+        meta = server.last_push_meta
+        assert (meta["worker"], meta["step"], meta["seq"]) == (0, 4, 11)
+        assert meta["staleness"] == max(0, server.version - item[1])
+        assert meta["send_wall"] >= done["sent_after"] - 1.0
+        assert meta["recv_wall"] >= meta["send_wall"] - 0.1
+        assert meta["decode_s"] >= 0.0
+        row = lt.observe_publish(server.version + 1, apply_s=0.001)
+        assert [(p["worker"], p["step"], p["seq"])
+                for p in row["pushes"]] == [(0, 4, 11)]
+        assert row["pushes"][0]["e2e_s"] is not None
+    finally:
+        w.close()
+        server.close()

@@ -24,11 +24,14 @@ import pytest
 from pytorch_ps_mpi_tpu.parallel import dcn
 from pytorch_ps_mpi_tpu.resilience import (
     CRASH_EXIT_CODE,
+    FRAME_MAGIC_V1,
     FaultInjector,
     HEADER_BYTES,
+    HEADER_BYTES_V1,
     ResilientWorker,
     Supervisor,
     open_frame,
+    read_lineage,
     seal_frame,
     wire_fingerprint,
 )
@@ -75,6 +78,80 @@ def test_frame_roundtrip_and_rejection_reasons():
     bad[0] ^= 0xFF
     assert open_frame(bad, fp, payload.nbytes)[1] == "magic"
     assert open_frame(frame[:4], fp, None)[1] == "short"
+
+    # the lineage trace-ID fields ride the v2 header and round-trip
+    frame2 = seal_frame(buf, payload, fp, step=9, seq=123,
+                        send_wall=1234.5)
+    assert open_frame(frame2, fp, payload.nbytes)[1] is None
+    assert read_lineage(frame2) == (9, 123, 1234.5)
+
+
+def _v1_frame(payload: np.ndarray, fingerprint: int) -> np.ndarray:
+    """A PR 3 v1 frame (20-byte header, no lineage fields) as an
+    old-format worker would emit it."""
+    import struct
+    import zlib
+
+    buf = np.empty(HEADER_BYTES_V1 + payload.nbytes, np.uint8)
+    struct.pack_into("<IIIQ", buf, 0, FRAME_MAGIC_V1, payload.nbytes,
+                     zlib.crc32(payload.view(np.uint8)) & 0xFFFFFFFF,
+                     fingerprint)
+    buf[HEADER_BYTES_V1:] = payload.view(np.uint8)
+    return buf
+
+
+def test_v1_frame_rejected_with_version_reason():
+    """Frame-format version bump done right: a v1 frame — even one that
+    was perfectly valid under the old format, correct CRC and
+    fingerprint included — is rejected with the EXPLICIT reason
+    ``"version"`` (not misread as garbage, size or corruption)."""
+    payload = np.arange(6, dtype=np.float32)
+    fp = 0x1234ABCD5678EF90
+    old = _v1_frame(payload, fp)
+    got, err = open_frame(old, fp, payload.nbytes)
+    assert got is None and err == "version"
+    # a v1 frame SHORTER than a v2 header is still identified by magic
+    tiny = _v1_frame(np.zeros(2, np.float32), fp)
+    assert tiny.nbytes < HEADER_BYTES
+    assert open_frame(tiny, fp, None)[1] == "version"
+
+
+def test_v1_frame_against_v2_server_rejected_not_fatal():
+    """Wire compat on the live transport: an old-format worker pushing
+    v1 frames at a v-new server becomes a counted per-worker rejection
+    — the PS keeps serving its v2 workers."""
+    import ctypes
+
+    tpl = _template()
+    name = f"/psq_v1_{os.getpid()}"
+    server = dcn.ShmPSServer(name, num_workers=2, template=tpl, frame=True,
+                             max_staleness=10**9)
+    w = dcn.ShmPSWorker(name, 0, tpl, frame=True)
+    try:
+        server.publish({"w": np.arange(8, dtype=np.float32)})
+        _, ver = w.read_params(timeout=30)
+
+        # worker id 1 speaks the OLD frame format (correct payload size
+        # and fingerprint under v1 — only the format version is stale)
+        old = _v1_frame(np.ones(8, np.float32), server._fingerprint)
+        rc = server._lib.psq_push_grad(
+            server._h, 1, old.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)), old.nbytes, int(ver))
+        assert rc == 1
+        assert server.poll_grad() is None  # rejected, not raised
+        assert server.frames_rejected == {1: 1}
+        assert server.grads_received == 0  # never entered accounting
+
+        # the v2 worker is unaffected
+        w.push_grad({"w": np.full(8, 5.0, np.float32)}, ver,
+                    lineage=(3, 4))
+        item = server.poll_grad()
+        assert item is not None and item[0] == 0
+        assert (server.last_push_meta["step"],
+                server.last_push_meta["seq"]) == (3, 4)
+    finally:
+        w.close()
+        server.close()
 
 
 def test_wire_fingerprint_detects_config_drift():

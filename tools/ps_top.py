@@ -5,11 +5,15 @@
 at ``/health`` (beside ``/metrics`` — both transports serve it now) and
 redraws one verdict row per worker: health verdict, straggler
 attribution (compute-bound / wire-bound / reconnect-churn), push
-interarrival EWMA + p95, staleness EWMA, anomaly count, sync-round
-gating bill, retry/reconnect counters, numerics columns (grad-norm
-EWMA, non-finite push count, codec rel-error — filled when the
-``NumericsMonitor`` is armed, ``-`` otherwise), and last-seen age.
-A numerics-quarantined worker renders the ``quarantined`` verdict.
+interarrival EWMA + p95, staleness EWMA, lineage columns (``stale-x``
+— the EXACT last per-push staleness from the frame trace IDs — and
+``e2e-ms`` — exact p50 end-to-end push latency, worker encode to
+published version; filled when the ``LineageTracker`` is armed, ``-``
+otherwise), anomaly count, sync-round gating bill, retry/reconnect
+counters, numerics columns (grad-norm EWMA, non-finite push count,
+codec rel-error — filled when the ``NumericsMonitor`` is armed, ``-``
+otherwise), and last-seen age. A numerics-quarantined worker renders
+the ``quarantined`` verdict.
 
 Usage::
 
@@ -18,9 +22,10 @@ Usage::
   python tools/ps_top.py 9100 --once                  # one frame, no tty
 
 Keybindings (when stdin is a tty): ``q`` quit · ``p`` pause/resume ·
-``s`` cycle the sort column (worker → verdict → interarrival → gating
-→ numerics) · ``n`` jump straight to the numerics sort (NaN count,
-then grad norm) · ``r`` force an immediate refresh.
+``s`` cycle the sort column (worker → verdict → interarrival → e2e →
+gating → numerics) · ``n`` jump straight to the numerics sort (NaN
+count, then grad norm) · ``e`` jump to the exact-e2e-latency sort ·
+``r`` force an immediate refresh.
 """
 
 from __future__ import annotations
@@ -32,7 +37,8 @@ import time
 import urllib.request
 from typing import Any, Dict, List, Optional
 
-SORT_KEYS = ("worker", "verdict", "interarrival", "gating", "numerics")
+SORT_KEYS = ("worker", "verdict", "interarrival", "e2e", "gating",
+             "numerics")
 
 _VERDICT_ORDER = {"quarantined": 0, "missing": 1, "churning": 2, "slow": 3,
                   "ok": 4}
@@ -86,8 +92,9 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
         f"up={health.get('uptime_s', 0):.0f}s"
     )
     cols = ["wk", "verdict", "cause", "grads", "inter-ewma", "inter-p95",
-            "stale-ewma", "gnorm", "nan", "relerr", "anom", "gate-rounds",
-            "gate-s", "retry", "reconn", "rej", "seen-ago"]
+            "stale-ewma", "stale-x", "e2e-ms", "gnorm", "nan", "relerr",
+            "anom", "gate-rounds", "gate-s", "retry", "reconn", "rej",
+            "seen-ago"]
     rows = []
     workers = list(health.get("workers", []))
 
@@ -104,11 +111,20 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
         probe = _num(w).get("probe") or {}
         return probe.get("rel_error")
 
+    def _lin(w) -> dict:
+        return w.get("lineage") or {}
+
+    def _e2e(w):
+        return _lin(w).get("e2e_ms_p50")
+
     if sort == "verdict":
         workers.sort(key=lambda w: _VERDICT_ORDER.get(w["verdict"], 9))
     elif sort == "interarrival":
         workers.sort(key=lambda w: -(w["push_interarrival_s"]["ewma"]
                                      or 0.0))
+    elif sort == "e2e":
+        # slowest exact end-to-end push latency first (lineage-measured)
+        workers.sort(key=lambda w: -(_e2e(w) or 0.0))
     elif sort == "gating":
         workers.sort(key=lambda w: -w["gating"]["seconds"])
     elif sort == "numerics":
@@ -119,11 +135,15 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
         stale = w["staleness"]
         verdict = w["verdict"] + (" (done)" if w.get("done") else "")
         gnorm, relerr = _gnorm(w), _relerr(w)
+        stale_x = _lin(w).get("stale_last")
+        e2e = _e2e(w)
         rows.append([
             str(w["worker"]), verdict, w["cause"] or "-",
             str(w["grads"]), _fmt_s(inter.get("ewma")),
             _fmt_s(inter.get("p95")),
             "-" if stale.get("ewma") is None else f"{stale['ewma']:.2f}",
+            "-" if stale_x is None else str(stale_x),
+            "-" if e2e is None else f"{e2e:.1f}",
             "-" if gnorm is None else f"{gnorm:.3g}",
             str(_nan_count(w)) if _num(w) else "-",
             "-" if relerr is None else f"{relerr:.3f}",
@@ -144,7 +164,7 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
             line = _COLOR[w["verdict"]] + line + _RESET
         lines.append(line)
     lines.append(f"[sort: {sort}]  q quit · p pause · s sort · "
-                 "n numerics · r refresh")
+                 "n numerics · e e2e · r refresh")
     return "\n".join(lines)
 
 
@@ -231,6 +251,9 @@ def main(argv=None) -> int:
                     break
                 if k == "n":
                     sort_i = SORT_KEYS.index("numerics")
+                    break
+                if k == "e":
+                    sort_i = SORT_KEYS.index("e2e")
                     break
                 if k == "r":
                     break

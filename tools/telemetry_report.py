@@ -12,6 +12,12 @@ per name; point events are counted. ``--by-worker`` splits rows per
 worker id — the straggler view. ``--json`` emits the same summary as a
 machine-readable dict (what ``bench.py`` embeds).
 
+Gradient-lineage files (``lineage-*.jsonl``, ``telemetry.lineage``) get
+their own section — exact push-latency/staleness tables per worker,
+per-version composition summary, critical-path stage counts — and are
+routed AWAY from the recorder-span merge like the beacon/faults/numerics
+side channels.
+
 Prometheus scrape snapshots (``*.prom`` — ``serve()`` drops
 ``metrics.prom`` into the telemetry dir at exit) are parsed too,
 INCLUDING worker-labeled series (``ps_frames_rejected_total{worker="1"}``,
@@ -46,11 +52,13 @@ def collect_files(paths: List[str]) -> List[str]:
         if os.path.isdir(p):
             # faults-*.jsonl are injected-fault event logs (resilience
             # layer), beacon-*.jsonl are health-monitor side channels,
-            # and numerics-*.jsonl are codec-fidelity/grad-norm
-            # trajectories — none are recorder files (their rows have no
-            # name/kind), so they must not enter the span merge.
-            # numerics-*.jsonl and postmortem-*.json ARE picked up here,
-            # routed to the numerics section by summarize().
+            # numerics-*.jsonl are codec-fidelity/grad-norm
+            # trajectories, and lineage-*.jsonl are per-version push
+            # compositions — none are recorder files (their rows have no
+            # recorder name/kind), so they must not enter the span merge.
+            # numerics-*.jsonl, lineage-*.jsonl and postmortem-*.json
+            # ARE picked up here, routed to their own sections by
+            # summarize().
             out.extend(sorted(
                 f for f in glob.glob(os.path.join(p, "*.jsonl"))
                 if not os.path.basename(f).startswith(
@@ -133,6 +141,65 @@ def _summarize_numerics(traj_rows: List[Dict[str, Any]],
     return out
 
 
+def _summarize_lineage(rows: List[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """The lineage section: exact push-latency/staleness tables,
+    per-version composition summary, and critical-path stage counts —
+    aggregated from ``lineage-*.jsonl`` publish/drop/round rows."""
+    if not rows:
+        return None
+    publishes = [r for r in rows if r.get("kind") == "publish"]
+    drops = [r for r in rows if r.get("kind") == "drop"]
+    rounds = [r for r in rows if r.get("kind") == "round"]
+    per_worker: Dict[Any, Dict[str, List[float]]] = {}
+    sizes: List[int] = []
+    for r in publishes:
+        pushes = r.get("pushes") or []
+        sizes.append(len(pushes))
+        for p in pushes:
+            d = per_worker.setdefault(p.get("worker"),
+                                      {"e2e": [], "stale": []})
+            if p.get("e2e_s") is not None:
+                d["e2e"].append(float(p["e2e_s"]))
+            d["stale"].append(float(p.get("staleness", 0)))
+    for r in drops:
+        p = r.get("push") or {}
+        d = per_worker.setdefault(p.get("worker"),
+                                  {"e2e": [], "stale": []})
+        if "staleness" in p:
+            d["stale"].append(float(p["staleness"]))
+    workers = []
+    for w, d in sorted(per_worker.items(), key=lambda kv: str(kv[0])):
+        e2e, stale = sorted(d["e2e"]), sorted(d["stale"])
+        workers.append({
+            "worker": w, "pushes": len(stale),
+            "e2e_ms_p50": 1e3 * _percentile(e2e, 0.50) if e2e else None,
+            "e2e_ms_p95": 1e3 * _percentile(e2e, 0.95) if e2e else None,
+            "stale_p50": _percentile(stale, 0.50) if stale else None,
+            "stale_max": stale[-1] if stale else None,
+        })
+    critical: Dict[Any, int] = {}
+    for r in rounds:
+        k = (r.get("gating_worker"), r.get("stage"))
+        critical[k] = critical.get(k, 0) + 1
+    return {
+        "publishes": len(publishes),
+        "pushes_composed": sum(sizes),
+        "drops": len(drops),
+        "composition": {
+            "mean_pushes_per_version": (sum(sizes) / len(sizes)
+                                        if sizes else 0.0),
+            "max_pushes_per_version": max(sizes) if sizes else 0,
+        },
+        "workers": workers,
+        "critical_path": [
+            {"worker": w, "stage": s, "rounds": n}
+            for (w, s), n in sorted(critical.items(),
+                                    key=lambda kv: -kv[1])
+        ],
+    }
+
+
 def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     """Merged summary over every file: per-span-name stats, event counts,
     and recorder meta (dropped counts make truncation visible)."""
@@ -143,6 +210,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     traj_rows: List[Dict[str, Any]] = []
     probe_rows: List[Dict[str, Any]] = []
     postmortems: List[Dict[str, Any]] = []
+    lineage_rows: List[Dict[str, Any]] = []
     for path in files:
         base = os.path.basename(path)
         if base.startswith("postmortem-") and path.endswith(".json"):
@@ -158,6 +226,15 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
                 "worker": pm.get("worker"), "applied": pm.get("applied"),
                 "ring_rows": len(pm.get("step_stats_ring") or []),
             })
+            continue
+        if base.startswith("lineage-") and path.endswith(".jsonl"):
+            # per-version push compositions (telemetry.lineage) — routed
+            # to the lineage section, never the recorder-span merge
+            from pytorch_ps_mpi_tpu.telemetry.lineage import (
+                load_lineage_rows,
+            )
+
+            lineage_rows.extend(load_lineage_rows(path))
             continue
         if base.startswith("numerics-") and path.endswith(".jsonl"):
             # numerics trajectories: the server's grad-norm/update-ratio
@@ -231,6 +308,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
             key=lambda s: (s["name"], sorted(s["labels"].items())),
         ),
         "numerics": _summarize_numerics(traj_rows, probe_rows, postmortems),
+        "lineage": _summarize_lineage(lineage_rows),
         "dropped_total": sum(m.get("dropped") or 0 for m in meta),
     }
 
@@ -306,6 +384,37 @@ def format_table(summary: Dict[str, Any]) -> str:
                 f"  postmortem {pm['file']}: reason={pm['reason']} "
                 f"worker={pm['worker']} applied={pm['applied']} "
                 f"ring={pm['ring_rows']} rows"
+            )
+    lin = summary.get("lineage")
+    if lin:
+        lines.append("")
+        lines.append("lineage:")
+        comp = lin["composition"]
+        lines.append(
+            f"  {lin['publishes']} published versions composed of "
+            f"{lin['pushes_composed']} pushes "
+            f"(mean {comp['mean_pushes_per_version']:.2f}/version, "
+            f"max {comp['max_pushes_per_version']}); "
+            f"{lin['drops']} pushes dropped"
+        )
+
+        def _ms(v):
+            return "-" if v is None else f"{v:.1f}ms"
+
+        for w in lin.get("workers", []):
+            stale50 = w.get("stale_p50")
+            lines.append(
+                f"  worker {w['worker']}: {w['pushes']} pushes  "
+                f"e2e p50/p95={_ms(w.get('e2e_ms_p50'))}/"
+                f"{_ms(w.get('e2e_ms_p95'))}  "
+                f"stale p50/max="
+                f"{'-' if stale50 is None else f'{stale50:.0f}'}/"
+                f"{'-' if w.get('stale_max') is None else int(w['stale_max'])}"
+            )
+        for c in lin.get("critical_path", []):
+            lines.append(
+                f"  critical path: worker {c['worker']} "
+                f"[{c['stage']}] gated {c['rounds']} rounds"
             )
     if summary["dropped_total"]:
         lines.append("")
