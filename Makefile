@@ -3,11 +3,13 @@
 # mesh set up by tests/conftest.py — no cluster, no MPI.
 
 # Default test path includes the bucketing parity + launch-count suite
-# (tests/test_bucketing.py; `make bucket-smoke` runs just that gate) and
-# the gradient-lineage completeness gate (`make trace-smoke`).
+# (tests/test_bucketing.py; `make bucket-smoke` runs just that gate),
+# the gradient-lineage completeness gate (`make trace-smoke`), and the
+# parameter-serving read-tier gate (`make read-smoke`).
 test:
 	python -m pytest tests/ -q
 	$(MAKE) trace-smoke
+	$(MAKE) read-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -73,6 +75,31 @@ trace-smoke:
 numerics-smoke:
 	JAX_PLATFORMS=cpu python tools/numerics_smoke.py
 
+# Parameter-serving read-tier gate (in the default `make test` path):
+# a burst of identical-version reads must coalesce onto ONE delta
+# encode, the admission queue must shed past its configured depth with
+# every reader completing via retry-after, delta-tracked state must be
+# bit-exact vs a full read, an aged-out ring base must fall back to a
+# full snapshot, and the armed snapshot ring must cost <=5% of the
+# transport publish. Appends a bench_gate trajectory row to
+# benchmarks/results/read_smoke.jsonl; the second command re-asserts
+# the standing <=5% recorder-overhead budget with the tier armed.
+read-smoke:
+	JAX_PLATFORMS=cpu python tools/read_smoke.py
+	python tools/telemetry_smoke.py
+
+# Read-tier load bench: open-loop fleet of simulated readers — delta
+# bytes economics (>=5x reduction gate), saturation sweep with bounded
+# served p99 past the admission limit. Full scale; `--quick` inside
+# read-smoke-scale CI runs. Trajectory rows in
+# benchmarks/results/read_bench.jsonl.
+read-bench:
+	JAX_PLATFORMS=cpu python benchmarks/read_bench.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/read_bench.jsonl \
+		--metric 'read_bench.delta_reduction_x:higher:0.5' \
+		--metric 'read_bench.p99_max_load_ms:lower:2.0'
+
 bench:
 	python bench.py
 
@@ -82,9 +109,9 @@ tpu-watch:
 	python tools/tpu_watch.py
 
 native:
-	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libwirecodec.so native/wirecodec.cpp
-	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp
-	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libtcpps.so native/tcpps.cpp
+	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libwirecodec.so native/wirecodec.cpp -lrt
+	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp -lrt
+	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libtcpps.so native/tcpps.cpp -lrt
 
 # CPU-runnable protocol/convergence benches (the TPU-window stages run
 # via tpu-watch); each emits JSON lines for benchmarks/results/
@@ -95,4 +122,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench

@@ -1,0 +1,153 @@
+"""Read-only parameter-serving tier from a checkpoint directory.
+
+The :class:`~pytorch_ps_mpi_tpu.serving.ServingCore` without a trainer
+loop, without workers, without a transport server: restore the latest PS
+checkpoint (the ``_PSCheckpointCadence`` snapshots ``serve()`` /
+``Supervisor`` write), publish it into the snapshot ring, and serve
+version-conditional reads (not-modified / delta / full, with coalescing
+and admission control) plus ``/metrics`` + ``/health`` — the deployment
+shape where inference replicas read a trained model without ever
+touching the training fleet.
+
+With ``--follow`` the tier keeps polling the checkpoint directory and
+republishes whenever the trainer lands a newer step, so readers track a
+LIVE training run through cheap delta reads.
+
+Examples::
+
+  # train with checkpoints, then serve them read-only
+  python examples/train_async.py --model mlp --workers 2 --steps 50 \\
+      --checkpoint-dir /tmp/ps_ckpt
+  python examples/serve_readonly.py --checkpoint-dir /tmp/ps_ckpt \\
+      --model mlp --read-port 7070 --metrics-port 9100
+
+  # a reader
+  python - <<'PY'
+  from pytorch_ps_mpi_tpu.serving import ServingReader
+  from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+  cfg = {"model": "mlp", "model_kw": {"features": (64, 8)},
+         "in_shape": [8], "batch": 1, "seed": 0}
+  _, tmpl, _, _ = make_problem(cfg)
+  r = ServingReader("127.0.0.1", 7070, tmpl)
+  params, version = r.read_params()
+  print("got version", version)
+  PY
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def restore_latest(checkpoint_dir: str, cfg: dict):
+    """(params, version, step) from the newest PS checkpoint."""
+    from pytorch_ps_mpi_tpu.optim import OPTIMIZERS
+    from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+    from pytorch_ps_mpi_tpu.utils.checkpoint import CheckpointManager
+
+    _, params0, _, _ = make_problem(cfg)
+    _, init_state, _ = OPTIMIZERS[cfg.get("optim", "sgd")]
+    template = {"params": params0, "opt_state": init_state(params0),
+                "version": 0, "applied_total": 0, "checkpoint_every": 0}
+    ckpt = CheckpointManager(checkpoint_dir)
+    step = ckpt.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints in {checkpoint_dir}")
+    restored = ckpt.restore(template, step=step)
+    return restored["params"], int(restored["version"]), int(step), params0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--checkpoint-dir", required=True,
+                    help="directory of _PSCheckpointCadence snapshots")
+    ap.add_argument("--model", choices=["mlp", "resnet18", "resnet50"],
+                    default="mlp",
+                    help="model the checkpoint was trained with (defines "
+                         "the parameter template — must match training)")
+    ap.add_argument("--read-port", type=int, default=0,
+                    help="read-tier port (0 = auto; printed on stdout)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="/metrics + /health port (0 = auto)")
+    ap.add_argument("--tenant", default="default",
+                    help="tenant namespace this checkpoint serves under")
+    ap.add_argument("--ring", type=int, default=8,
+                    help="snapshot ring depth (versions kept for deltas)")
+    ap.add_argument("--admission-depth", type=int, default=64)
+    ap.add_argument("--follow", type=float, default=0.0,
+                    help="poll the checkpoint dir every N seconds and "
+                         "republish newer steps (0 = serve one snapshot)")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="exit after this many seconds (0 = forever)")
+    args = ap.parse_args(argv)
+
+    cfg = {
+        "model": args.model,
+        "model_kw": {"num_classes": 10} if args.model != "mlp" else
+                    {"features": (64, 8)},
+        "in_shape": [8] if args.model == "mlp" else [32, 32, 3],
+        "batch": 1,
+        "seed": 0,
+    }
+    params, version, step, template = restore_latest(
+        args.checkpoint_dir, cfg)
+
+    from pytorch_ps_mpi_tpu.serving import ServingCore
+
+    serve_cfg = {
+        "read_port": args.read_port,
+        "metrics_port": args.metrics_port,
+        "serving_kw": {"ring": args.ring,
+                       "admission_depth": args.admission_depth},
+    }
+    core = ServingCore(None, serve_cfg, template=template,
+                       tenant=args.tenant)
+    core.publish(params, version=max(version, 1), tenant=args.tenant)
+    hello = {"read_port": core.read_port, "tenant": args.tenant,
+             "version": max(version, 1), "checkpoint_step": step}
+    if core.metrics_http_port is not None:
+        hello["metrics_port"] = core.metrics_http_port
+    print(json.dumps(hello), flush=True)
+
+    deadline = time.time() + args.duration if args.duration else None
+    last_step = step
+    try:
+        while deadline is None or time.time() < deadline:
+            time.sleep(min(args.follow, 1.0) if args.follow else 0.25)
+            if args.follow:
+                try:
+                    params, version, step, _ = restore_latest(
+                        args.checkpoint_dir, cfg)
+                except (FileNotFoundError, ValueError, OSError):
+                    continue  # trainer mid-write; next poll gets it
+                if step > last_step:
+                    v = core.publish(params, version=max(version, 1),
+                                     tenant=args.tenant)
+                    last_step = step
+                    print(json.dumps({"republished": v,
+                                      "checkpoint_step": step}),
+                          flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        snap = core.serving_snapshot()
+        core.close()
+        print(json.dumps({"final_serving": {
+            k: snap[k] for k in ("reads_total", "reads_delta",
+                                 "reads_not_modified", "reads_shed",
+                                 "coalesce_hits")}}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

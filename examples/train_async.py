@@ -128,6 +128,18 @@ def main(argv=None):
     ap.add_argument("--numerics-probe-every", type=int, default=25,
                     help="codec-fidelity probe / trajectory-row cadence "
                          "(steps)")
+    ap.add_argument("--read-port", type=int, default=None,
+                    help="arm the parameter-serving read tier on this "
+                         "port (0 = auto; bound port in the final "
+                         "metrics line as read_port): versioned "
+                         "snapshot ring, version-conditional reads "
+                         "(not-modified / delta / full), request "
+                         "coalescing, admission-control load shedding. "
+                         "Readers: pytorch_ps_mpi_tpu.serving."
+                         "ServingReader")
+    ap.add_argument("--snapshot-ring", type=int, default=None,
+                    help="with --read-port: versions kept for delta "
+                         "reads (default 8)")
     ap.add_argument("--no-frame-check", action="store_true",
                     help="disable the self-verifying wire frames (CRC + "
                          "config fingerprint on every push; on by default "
@@ -264,6 +276,13 @@ def main(argv=None):
                               "probe_every": args.numerics_probe_every}
     if args.metrics_port is not None:
         cfg["metrics_port"] = args.metrics_port
+    if args.read_port is not None:
+        cfg["read_port"] = args.read_port
+        if args.snapshot_ring is not None:
+            cfg["serving_kw"] = {"ring": args.snapshot_ring}
+    elif args.snapshot_ring is not None:
+        ap.error("--snapshot-ring needs --read-port (it sizes the read "
+                 "tier's snapshot ring)")
     if args.ps_top and args.health_port is None:
         if args.supervise:
             ap.error("--ps-top with --supervise needs an explicit "

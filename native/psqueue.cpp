@@ -208,6 +208,15 @@ int64_t psq_read_params(void* hv, uint8_t* buf, uint64_t cap,
   return -2;  // writer wedged
 }
 
+// Cheap version peek (one atomic load, no snapshot copy): lets a reader
+// holding version v skip the full seqlock read when nothing was
+// published since — the shm analog of the TCP not-modified reply. The
+// value may be mid-publish stale by one version; the follow-up full
+// read resolves it, so a reader can never act on a torn snapshot.
+uint64_t psq_params_version(void* hv) {
+  return hdr((Handle*)hv)->param_version.load(std::memory_order_acquire);
+}
+
 // Worker: push a gradient into this worker's mailbox. Returns 0 if the
 // slot still holds an unconsumed gradient (caller retries/backs off).
 int psq_push_grad(void* hv, uint32_t worker, const uint8_t* buf, uint64_t len,

@@ -100,6 +100,10 @@ struct Server {
   std::deque<GradMsg> grads;
   std::vector<uint8_t> params;
   uint64_t param_version = 0;
+  // read-path accounting (served by the pump thread, mirrored into the
+  // Python server's scrape registry via tps_server_read_stats)
+  uint64_t reads_total = 0;
+  uint64_t reads_not_modified = 0;
 };
 
 struct Worker {
@@ -209,8 +213,20 @@ bool handle_frames(Server* s, Conn* c) {
         c->worker = (int32_t)h.worker;
         break;
       case GET_PARAMS:
-        append_frame(c->tx, PARAMS, 0, s->param_version, s->params.data(),
-                     s->params.size());
+        // version-conditional read: the request's version field carries
+        // the worker's "I have v" (0 = unconditional, the legacy form).
+        // An unchanged snapshot gets a cheap zero-payload PARAMS reply
+        // echoing the version instead of re-shipping the full snapshot
+        // — distinguishable from "nothing published yet" because a
+        // published version is never 0.
+        ++s->reads_total;
+        if (h.version != 0 && h.version == s->param_version) {
+          ++s->reads_not_modified;
+          append_frame(c->tx, PARAMS, 0, s->param_version, nullptr, 0);
+        } else {
+          append_frame(c->tx, PARAMS, 0, s->param_version, s->params.data(),
+                       s->params.size());
+        }
         break;
       case PUSH_GRAD: {
         if (s->grads.size() >= queue_cap(s)) {
@@ -418,6 +434,16 @@ int tps_server_connected(void* sv, uint32_t worker) {
   return 0;
 }
 
+// Read-path counters: total GET_PARAMS served and how many were answered
+// with the cheap not-modified reply. Written only by the pump (the serve
+// thread); callers read them from that same thread and mirror into
+// Python-side state for scrape threads.
+void tps_server_read_stats(void* sv, uint64_t* total, uint64_t* not_modified) {
+  Server* s = (Server*)sv;
+  if (total) *total = s->reads_total;
+  if (not_modified) *not_modified = s->reads_not_modified;
+}
+
 void tps_server_close(void* sv) {
   Server* s = (Server*)sv;
   if (!s) return;
@@ -467,11 +493,15 @@ void* tps_worker_connect(const char* host, uint16_t port, uint32_t worker_id,
   return w;
 }
 
-// Request + receive the latest snapshot. Returns byte length (0 until the
-// server's first publish) and fills version; -1 error, -2 timeout, -3 if
-// the reply exceeds cap.
+// Request + receive the latest snapshot. ``have_version`` is the
+// version-conditional "I have v" (0 = unconditional): when the server's
+// snapshot still IS that version it replies without the payload and this
+// returns -4 ("not modified" — the caller's cached copy is current).
+// Otherwise returns byte length (0 until the server's first publish) and
+// fills version; -1 error, -2 timeout, -3 if the reply exceeds cap.
 int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
-                               uint64_t* version_out, int timeout_ms) {
+                               uint64_t* version_out, int timeout_ms,
+                               uint64_t have_version) {
   Worker* w = (Worker*)wv;
   // one deadline for the whole call: header + payload reads share the
   // caller's budget instead of each getting timeout_ms (which made the
@@ -480,7 +510,7 @@ int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
   clock_gettime(CLOCK_MONOTONIC, &t0);
   wan_delay_oneway();  // request propagation (WAN emulation; usually 0)
   std::vector<uint8_t> tx;
-  append_frame(tx, GET_PARAMS, w->id, 0, nullptr, 0);
+  append_frame(tx, GET_PARAMS, w->id, have_version, nullptr, 0);
   if (write_full(w->fd, tx.data(), tx.size()) != 0) return -1;
   FrameHdr h;
   // header read gets the REMAINING budget (the emulated request delay
@@ -499,6 +529,12 @@ int64_t tps_worker_read_params(void* wv, uint8_t* buf, uint64_t cap,
                      (int)hleft);
   if (rc != 0) return rc;
   if (h.magic != kMagic || h.op != PARAMS) return -1;
+  if (h.len == 0 && have_version != 0 && h.version == have_version) {
+    // not modified: the server confirmed our cached version is current
+    wan_delay_oneway();  // reply propagation
+    if (version_out) *version_out = h.version;
+    return -4;
+  }
   if (h.len > cap) return -3;
   if (h.len) {
     struct timespec now;

@@ -569,6 +569,24 @@ def serve(
     ``lineage``. Requires ``frame_check`` (the trace ID rides the frame
     header); skipped with a printed notice otherwise.
 
+    Parameter serving (:mod:`pytorch_ps_mpi_tpu.serving`): the loop now
+    sits on a :class:`~pytorch_ps_mpi_tpu.serving.ServingCore` that owns
+    the monitor plumbing above plus — when ``cfg["serving"]`` or
+    ``cfg["read_port"]`` (0 = auto) arms it — the read tier: every
+    publish lands an immutable refcounted snapshot in a ring of the last
+    K versions; readers issue version-conditional reads answered as
+    not-modified / codec-encoded delta / full snapshot, identical
+    requests coalesce onto one encode, and a bounded admission queue
+    sheds overload with explicit retry-after replies
+    (``cfg["serving_kw"]`` tunes ring/admission/delta knobs). Read-tier
+    counters join the canonical metrics (``reads_total``,
+    ``read_p50_ms/p95_ms``, ``delta_bytes_saved``, ``reads_shed``,
+    ``coalesce_hits``, ``reads_not_modified``) and ``/health`` gains a
+    ``serving`` section; the bound port rides the returned metrics as
+    ``read_port`` and the listener lives until ``server.close()``,
+    exactly like the metrics endpoint. Unarmed, publishes degrade to the
+    transport's own publish — the legacy path pays nothing.
+
     Resilience hooks:
 
     - ``on_tick``: called from INSIDE the loop (same thread as every
@@ -639,50 +657,20 @@ def serve(
     g_applied = reg.gauge(
         "ps_applied_total", "gradients applied this serve call"
     )
-    monitor = None
-    if (cfg.get("health") or cfg.get("health_dir")
-            or cfg.get("health_port") is not None):
-        from pytorch_ps_mpi_tpu.telemetry.diagnosis import HealthMonitor
+    # the reusable serving core owns everything that is NOT the trainer
+    # loop: monitor plumbing (health / numerics / lineage — construction
+    # unchanged, just extracted), the /metrics + /health endpoint, and —
+    # when cfg["serving"] / cfg["read_port"] arm it — the snapshot ring
+    # + delta/coalescing/admission read tier that serves readers without
+    # this loop's involvement (see pytorch_ps_mpi_tpu.serving)
+    from pytorch_ps_mpi_tpu.serving import ServingCore
 
-        # attaches itself to server.health_monitor (the /health route)
-        # and registers its instruments on the scrape registry
-        monitor = HealthMonitor(server, cfg)
-    numon = None
-    if (cfg.get("numerics") or cfg.get("numerics_dir")
-            or cfg.get("numerics_kw")):
-        from pytorch_ps_mpi_tpu.telemetry.numerics import NumericsMonitor
-
-        # attaches itself to server.numerics_monitor: the canonical
-        # metrics grow grad_norm / nonfinite_total / update_ratio /
-        # codec_rel_error / ef_residual_norm, /health gains the
-        # "numerics" section, and every consumed push is validated
-        # below BEFORE it can touch the optimizer
-        numon = NumericsMonitor(server, cfg)
+    core = ServingCore(server, cfg)
+    monitor = core.health
+    numon = core.numerics
+    lint = core.lineage
+    metrics_http_port = core.metrics_http_port
     numerics_probe_every = int(numon.knobs["probe_every"]) if numon else 0
-    lint = None
-    if cfg.get("lineage") or cfg.get("lineage_dir"):
-        if getattr(server, "frame", False):
-            from pytorch_ps_mpi_tpu.telemetry.lineage import LineageTracker
-
-            # attaches itself to server.lineage_tracker: framed_poll
-            # feeds it every consumed push's frame-carried trace ID, the
-            # canonical metrics grow lineage_pushes / push_e2e_p*_ms,
-            # and every publish below is billed with its composing
-            # pushes into lineage-server.jsonl
-            lint = LineageTracker(server, cfg)
-        else:
-            # the trace ID rides the v2 frame header — without frames
-            # there is nothing on the wire to trace
-            print("lineage tracing requires frame_check=True; not armed",
-                  flush=True)
-    metrics_http_port = None
-    http_port = cfg.get("metrics_port")
-    if http_port is None:
-        http_port = cfg.get("health_port")  # same endpoint serves both
-    if http_port is not None and hasattr(server, "start_metrics_http"):
-        metrics_http_port = server.start_metrics_http(int(http_port))
-        print(f"prometheus /metrics + /health on port {metrics_http_port}",
-              flush=True)
 
     from pytorch_ps_mpi_tpu.resilience.faults import (
         FaultInjector,
@@ -692,7 +680,7 @@ def serve(
     inj = FaultInjector.from_cfg(cfg, role="server")
 
     loss0 = float(eval_loss(params, eval_batch))
-    server.publish(params)
+    core.publish(params)
     applied = 0
     degraded_rounds = 0
     last_applied_total = applied_before
@@ -755,7 +743,10 @@ def serve(
             raise InjectedServerCrash(crash)
 
     def _post_update(up_t0: float, lineage_workers=None) -> None:
-        server.publish(jax.tree.map(np.asarray, params))
+        # through the serving core: the transport publish plus — when the
+        # read tier is armed — one snapshot into the refcounted ring
+        # (same single flatten either way)
+        core.publish(jax.tree.map(np.asarray, params))
         up_dur = time.perf_counter() - up_t0
         h_update.observe(up_dur)
         g_applied.set(float(applied))
@@ -846,10 +837,8 @@ def serve(
             next_tick = now + tick_interval
             if on_tick is not None:
                 on_tick()
-            if monitor is not None:
-                monitor.tick()  # tail worker beacons, same thread
-            if numon is not None:
-                numon.tick()  # tail worker codec-fidelity probes
+            # monitor upkeep (beacon/probe tailing), same thread
+            core.tick()
             if stop_when is not None and not draining and stop_when():
                 draining = True  # consume what's queued, then return
             if sync_barrier and now - round_t0 > degrade_after:
@@ -950,6 +939,13 @@ def serve(
     )
     if metrics_http_port is not None:
         m["metrics_port"] = metrics_http_port
+    if core.armed:
+        # read-tier rollup (ring occupancy, read counts, shed/coalesce);
+        # the read server itself stays up until server.close(), exactly
+        # like the /metrics endpoint
+        m["serving"] = core.serving_snapshot()
+        if core.read_port is not None:
+            m["read_port"] = core.read_port
     if monitor is not None:
         m["health"] = monitor.snapshot()
     if numon is not None:

@@ -67,6 +67,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.psq_grad_pending.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.psq_reset_slot.restype = ctypes.c_int
     lib.psq_reset_slot.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.psq_params_version.restype = ctypes.c_uint64
+    lib.psq_params_version.argtypes = [ctypes.c_void_p]
     _lib = lib
     return _lib
 
@@ -365,7 +367,12 @@ class ShmPSServer(PSServerTelemetry):
         self._t0 = time.time()
 
     def publish(self, params: PyTree) -> None:
-        flat = _flatten(params)
+        self.publish_flat(_flatten(params))
+
+    def publish_flat(self, flat: np.ndarray) -> None:
+        """Publish a pre-flattened f32 snapshot (the serving-core path:
+        one flatten feeds the transport AND the snapshot ring)."""
+        flat = np.ascontiguousarray(flat, np.float32)
         self.version += 1
         rc = self._lib.psq_publish_params(
             self._h, _u8(flat.view(np.uint8)), flat.nbytes, self.version
@@ -494,8 +501,12 @@ class ShmPSServer(PSServerTelemetry):
 
     def close(self):
         # the /metrics + /health endpoint (PSServerTelemetry mixin) dies
-        # with the server — a supervisor restart can never leak a socket
+        # with the server — a supervisor restart can never leak a socket;
+        # the serving core's read tier follows the same rule
         self.close_metrics_http()
+        sc = getattr(self, "serving_core", None)
+        if sc is not None:
+            sc.close()
         if self._h:
             self._lib.psq_close(self._h)
             self._h = None
@@ -513,7 +524,8 @@ class ShmPSWorker:
 
     def __init__(self, name: str, worker_id: int, template: PyTree,
                  timeout: float = 30.0, code=None, seed: int = 0,
-                 bucket_mb: float = 0.0, frame: bool = False):
+                 bucket_mb: float = 0.0, frame: bool = False,
+                 cached_reads: bool = False):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -555,10 +567,34 @@ class ShmPSWorker:
                 _frames.HEADER_BYTES + payload_bytes, np.uint8
             )
         self._param_buf = np.empty(_flat_size(template), np.float32)
+        # version-conditional read cache (OPT-IN here, unlike TCP where
+        # it defaults on): when the published version is unchanged (one
+        # atomic peek — psq_params_version) the full seqlock copy +
+        # unflatten is skipped and the cached tree returned, counted in
+        # reads_not_modified. Off by default because a shm read is
+        # already just a local memcpy — making it ~free changes the
+        # pacing of tight read→push training loops (more same-version
+        # pushes between publishes), whereas on TCP the request/reply
+        # RTT still paces the reader and only the payload is saved.
+        self.cached_reads = bool(cached_reads)
+        self._cached_tree: Optional[PyTree] = None
+        self._cached_version = 0
+        self.reads_total = 0
+        self.reads_not_modified = 0
 
     def read_params(self, timeout: float = 30.0) -> Tuple[PyTree, int]:
         """Latest published snapshot (blocks until the server's first
-        publish; after that, never blocks on the writer — seqlock)."""
+        publish; after that, never blocks on the writer — seqlock).
+        With ``cached_reads=True`` (opt-in — see the constructor note)
+        an unchanged version costs one atomic load instead of a full
+        snapshot copy, and the SAME cached tree object is returned —
+        callers opting in must not mutate it."""
+        self.reads_total += 1
+        if self.cached_reads and self._cached_tree is not None:
+            v = int(self._lib.psq_params_version(self._h))
+            if v == self._cached_version and v > 0:
+                self.reads_not_modified += 1
+                return self._cached_tree, v
         version = ctypes.c_uint64()
         deadline = time.time() + timeout
         while True:
@@ -580,9 +616,10 @@ class ShmPSWorker:
             if time.time() > deadline:
                 raise TimeoutError("no parameter snapshot published yet")
             time.sleep(0.002)
-        return _unflatten(self._param_buf[: n // 4].copy(), self.template), int(
-            version.value
-        )
+        tree = _unflatten(self._param_buf[: n // 4].copy(), self.template)
+        if self.cached_reads:
+            self._cached_tree, self._cached_version = tree, int(version.value)
+        return tree, int(version.value)
 
     def push_grad(self, grad: PyTree, version: int,
                   timeout: float = 30.0,

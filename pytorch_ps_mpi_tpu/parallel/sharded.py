@@ -145,6 +145,23 @@ def server_main(shard_id: int, n_shards: int, port: int,
 
         tracker = LineageTracker(server, cfg, name=f"shard{shard_id}")
 
+    # per-shard read tier (the ServingCore extraction's point): each
+    # shard serves ITS slice under a per-tenant namespace — no trainer
+    # loop involved, readers hit the shard's own read port with tenant
+    # "shard<i>" (the bound port rides the stdout handshake). monitors
+    # stay the shard's own (built above), so monitors=False here.
+    core = None
+    if cfg.get("serving") or cfg.get("read_port") is not None:
+        from pytorch_ps_mpi_tpu.serving import ServingCore
+
+        # S shards on one host cannot share a pinned read port: each
+        # shard auto-assigns and reports it in the handshake line
+        scfg = dict(cfg)
+        if cfg.get("read_port") is not None:
+            scfg["read_port"] = 0
+        core = ServingCore(server, scfg, monitors=False,
+                           tenant=f"shard{shard_id}")
+
     ckpt = None
     applied_before = 0
     checkpoint_every = int(cfg.get("checkpoint_every", 50))
@@ -168,9 +185,18 @@ def server_main(shard_id: int, n_shards: int, port: int,
     hello = {"shard": shard_id, "port": server.port}
     if health_port is not None:
         hello["health_port"] = health_port
+    if core is not None and core.read_port is not None:
+        hello["read_port"] = core.read_port
     print(json.dumps(hello), flush=True)
+
+    def _publish(p):
+        if core is not None:
+            core.publish(p)
+        else:
+            server.publish(p)
+
     try:
-        server.publish(params)
+        _publish(params)
         applied = 0
         cadence = None
         if ckpt:
@@ -202,7 +228,7 @@ def server_main(shard_id: int, n_shards: int, port: int,
             applied += 1
             if slow_ms:
                 time.sleep(slow_ms / 1e3)
-            server.publish(jax.tree.map(np.asarray, params))
+            _publish(jax.tree.map(np.asarray, params))
             if tracker is not None:
                 tracker.observe_publish(server.version,
                                         time.perf_counter() - up_t0)
@@ -229,6 +255,8 @@ def server_main(shard_id: int, n_shards: int, port: int,
             health=(monitor.render_json() if monitor is not None else "{}"),
             lineage=json.dumps(tracker.snapshot()
                                if tracker is not None else {}),
+            serving=json.dumps(core.serving_snapshot()
+                               if core is not None else {}),
         )
     finally:
         if tracker is not None:

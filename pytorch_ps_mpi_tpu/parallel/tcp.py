@@ -84,6 +84,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.tps_server_pending.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.tps_server_connected.restype = ctypes.c_int
     lib.tps_server_connected.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.tps_server_read_stats.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
     lib.tps_server_close.argtypes = [ctypes.c_void_p]
     lib.tps_worker_connect.restype = ctypes.c_void_p
     lib.tps_worker_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
@@ -91,7 +95,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.tps_worker_read_params.restype = ctypes.c_int64
     lib.tps_worker_read_params.argtypes = [
         ctypes.c_void_p, u8p, ctypes.c_uint64,
-        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_uint64,
     ]
     lib.tps_worker_push_grad.restype = ctypes.c_int
     lib.tps_worker_push_grad.argtypes = [ctypes.c_void_p, u8p,
@@ -176,9 +180,25 @@ class TcpPSServer(PSServerTelemetry):
         # /metrics + /health HTTP: start_metrics_http / close_metrics_http
         # live on PSServerTelemetry (shared with the shm server)
         self._metrics_http = None
+        # native GET_PARAMS accounting (total, not_modified) — refreshed
+        # from the pump thread only (poll_grad/publish), so scrape
+        # threads read a plain Python tuple, never the native handle
+        self._native_read_stats = (0, 0)
+
+    def _refresh_read_stats(self) -> None:
+        total = ctypes.c_uint64()
+        nm = ctypes.c_uint64()
+        self._lib.tps_server_read_stats(self._h, ctypes.byref(total),
+                                        ctypes.byref(nm))
+        self._native_read_stats = (int(total.value), int(nm.value))
 
     def publish(self, params: PyTree) -> None:
-        flat = _flatten(params)
+        self.publish_flat(_flatten(params))
+
+    def publish_flat(self, flat: np.ndarray) -> None:
+        """Publish a pre-flattened f32 snapshot (the serving-core path:
+        one flatten feeds the transport AND the snapshot ring)."""
+        flat = np.ascontiguousarray(flat, np.float32)
         self.version += 1
         rc = self._lib.tps_server_publish(
             self._h, _u8(flat.view(np.uint8)), flat.nbytes, self.version
@@ -186,6 +206,7 @@ class TcpPSServer(PSServerTelemetry):
         if rc != 0:
             raise RuntimeError("tps_server_publish failed")
         self._lib.tps_server_pump(self._h)  # serve waiting readers promptly
+        self._refresh_read_stats()
 
     def _decode_payload(self, payload: np.ndarray) -> PyTree:
         """Payload bytes (a view into the receive buffer) → gradient
@@ -217,6 +238,7 @@ class TcpPSServer(PSServerTelemetry):
         worker = ctypes.c_uint32()
         version = ctypes.c_uint64()
         self._lib.tps_server_pump(self._h)
+        self._refresh_read_stats()
 
         def pop_once():
             n = self._lib.tps_server_pop_grad(
@@ -243,6 +265,7 @@ class TcpPSServer(PSServerTelemetry):
         worker = ctypes.c_uint32()
         version = ctypes.c_uint64()
         self._lib.tps_server_pump(self._h)
+        self._refresh_read_stats()
         expected = self.wire.wire_bytes if self.wire else _flat_size(self.template) * 4
         while True:
             n = self._lib.tps_server_pop_grad(
@@ -328,6 +351,11 @@ class TcpPSServer(PSServerTelemetry):
 
     def close(self):
         self.close_metrics_http()
+        # the read tier dies with the server (same rule as the /metrics
+        # endpoint): a supervisor restart can never leak its listener
+        sc = getattr(self, "serving_core", None)
+        if sc is not None:
+            sc.close()
         if self._h:
             self._lib.tps_server_close(self._h)
             self._h = None
@@ -346,7 +374,8 @@ class TcpPSWorker:
 
     def __init__(self, host: str, port: int, worker_id: int, template: PyTree,
                  timeout: float = 30.0, code=None, seed: int = 0,
-                 bucket_mb: float = 0.0, frame: bool = False):
+                 bucket_mb: float = 0.0, frame: bool = False,
+                 cached_reads: bool = True):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native tcpps unavailable (no g++?)")
@@ -391,18 +420,44 @@ class TcpPSWorker:
                 _frames.HEADER_BYTES + payload_bytes, np.uint8
             )
         self._param_buf = np.empty(_flat_size(template), np.float32)
+        # version-conditional read cache: the request carries "I have v"
+        # and an unchanged snapshot comes back as a cheap zero-payload
+        # not-modified reply instead of the full re-shipped snapshot —
+        # the fix for read_params re-shipping identical bytes every call.
+        # Only the FLAT bytes are cached; every return still builds a
+        # fresh tree, so callers that mutate returned params in place
+        # (legal before this cache existed) stay correct.
+        self.cached_reads = bool(cached_reads)
+        self._cached_flat: Optional[np.ndarray] = None
+        self._cached_version = 0
+        self.reads_total = 0
+        self.reads_not_modified = 0
 
     def read_params(self, timeout: float = 30.0) -> Tuple[PyTree, int]:
         """Latest published snapshot (blocks until the server's first
-        publish, then one request/reply round trip per read)."""
+        publish, then one request/reply round trip per read). With
+        ``cached_reads`` (default) the request is version-conditional:
+        an unchanged snapshot costs a 28-byte header reply, not the full
+        payload — the tree is rebuilt locally from the cached bytes."""
+        self.reads_total += 1
         version = ctypes.c_uint64()
         deadline = time.time() + timeout
+        have = (self._cached_version
+                if self.cached_reads and self._cached_flat is not None
+                else 0)
         while True:
             left_ms = max(1, int((deadline - time.time()) * 1000))
             n = self._lib.tps_worker_read_params(
                 self._h, _u8(self._param_buf.view(np.uint8)),
                 self._param_buf.nbytes, ctypes.byref(version), left_ms,
+                have,
             )
+            if n == -4:
+                # not modified: the server confirmed our cached version;
+                # fresh arrays from the cached bytes (mutation-safe)
+                self.reads_not_modified += 1
+                return (_unflatten(self._cached_flat, self.template),
+                        self._cached_version)
             if n == -2:
                 raise TimeoutError("tps_worker_read_params timed out")
             if n < 0:
@@ -412,9 +467,10 @@ class TcpPSWorker:
             if time.time() > deadline:
                 raise TimeoutError("no parameter snapshot published yet")
             time.sleep(0.002)
-        return _unflatten(self._param_buf[: n // 4].copy(), self.template), int(
-            version.value
-        )
+        flat = self._param_buf[: n // 4].copy()
+        if self.cached_reads:
+            self._cached_flat, self._cached_version = flat, int(version.value)
+        return _unflatten(flat, self.template), int(version.value)
 
     def push_grad(self, grad: PyTree, version: int,
                   timeout: float = 30.0,

@@ -1,0 +1,45 @@
+"""Parameter-serving read tier: versioned snapshots, delta reads,
+admission control, and the reusable :class:`ServingCore`.
+
+The write (gradient) path got PRs 1–6 of attention; this package is the
+read side the north star's "millions of users" actually hit:
+
+- :mod:`.snapshots` — immutable, refcounted, versioned snapshots in a
+  ring of the last K publishes, fanned out zero-copy (``memoryview``);
+- :mod:`.delta` — "I have v, give me v→latest" answered with a
+  dtype-bucketed exact sparse delta (lossy codecs opt-in behind a
+  fidelity probe), falling back to a full snapshot when v aged out;
+- :mod:`.net` — the request/reply wire, an event-loop read server with
+  bounded-admission load shedding + request coalescing, and the
+  :class:`~.net.ServingReader` client;
+- :mod:`.core` — :class:`ServingCore`, the extraction that lets the
+  trainer serve loop, the sharded PS, and a read-only replica all run
+  the same read tier (with per-tenant namespaces) and the same
+  monitor/metrics plumbing.
+"""
+
+from pytorch_ps_mpi_tpu.serving.core import (
+    DEFAULT_TENANT,
+    SERVING_KNOBS,
+    ServingCore,
+)
+from pytorch_ps_mpi_tpu.serving.delta import DELTA_KNOBS, DeltaCodec
+from pytorch_ps_mpi_tpu.serving.net import (
+    ReadClient,
+    ReadTierServer,
+    ServingReader,
+)
+from pytorch_ps_mpi_tpu.serving.snapshots import Snapshot, SnapshotStore
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "SERVING_KNOBS",
+    "ServingCore",
+    "DELTA_KNOBS",
+    "DeltaCodec",
+    "ReadClient",
+    "ReadTierServer",
+    "ServingReader",
+    "Snapshot",
+    "SnapshotStore",
+]

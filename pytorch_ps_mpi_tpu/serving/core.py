@@ -1,0 +1,558 @@
+"""ServingCore: the reusable parameter-serving core the trainer loop sits on.
+
+Before this module, ``async_train.serve()`` owned everything: the
+poll→update→publish trainer loop, the monitor plumbing (health,
+numerics, lineage), the metrics endpoint, AND the only read path (the
+blocking full-snapshot ``read_params``). That made the read side
+inseparable from training — a sharded PS or a read-only replica could
+not serve parameters without dragging the trainer loop along.
+
+:class:`ServingCore` is the extraction. It owns:
+
+- the **snapshot store(s)** (:class:`~.snapshots.SnapshotStore`) — one
+  refcounted ring of immutable versions per *tenant* namespace, so one
+  core (and one sharded PS fleet) serves many jobs;
+- the **read path** — version-conditional reads answered as
+  not-modified / delta (:class:`~.delta.DeltaCodec`) / full, with an
+  **encode cache** that coalesces identical-version requests into one
+  encode per (base, latest) pair per published version;
+- the **admission knobs** the network loop (:class:`~.net.ReadTierServer`)
+  enforces — bounded backlog depth, retry-after period — plus every
+  read-tier counter (``reads_total``, ``reads_shed``,
+  ``coalesce_hits``, ``delta_bytes_saved``, latency histogram) surfaced
+  through the canonical server metrics and the scrape registry;
+- the **monitor plumbing** previously inlined in ``serve()`` — the
+  HealthMonitor / NumericsMonitor / LineageTracker construction and the
+  ``/metrics`` + ``/health`` HTTP endpoint — so every consumer of the
+  core (trainer serve loop, shard server, read-only replica) gets the
+  same observability surface from the same code.
+
+``serve()`` is now a *user* of this core (zero behavior change: unarmed,
+``publish`` degrades to the transport's own publish and no store
+exists); ``parallel/sharded.server_main`` arms it per shard under a
+per-shard tenant; ``examples/serve_readonly.py`` runs it with no server
+and no trainer loop at all.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from pytorch_ps_mpi_tpu.serving.delta import DELTA_KNOBS, DeltaCodec
+from pytorch_ps_mpi_tpu.serving.net import (
+    KIND_DELTA,
+    KIND_ERROR,
+    KIND_FULL,
+    KIND_NOT_MODIFIED,
+    KIND_RETRY,
+)
+from pytorch_ps_mpi_tpu.serving.snapshots import SnapshotStore
+
+PyTree = Any
+
+DEFAULT_TENANT = "default"
+
+#: serving knobs and their defaults (overridable via ``cfg["serving_kw"]``)
+SERVING_KNOBS: Dict[str, Any] = {
+    "ring": 8,              # snapshot ring depth (versions kept)
+    "admission_depth": 64,  # read backlog bound; past it requests shed
+    "retry_after_s": 0.05,  # suggested client backoff on a shed reply
+    "rate_window_s": 5.0,   # reads/s window for the /health section
+    **DELTA_KNOBS,
+}
+
+# read-latency buckets: 10 us in-process hits through multi-second stalls
+_READ_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class ServingCore:
+    """Snapshots + read path + monitor plumbing, independent of any loop.
+
+    ``server`` is a PS transport server (Shm/Tcp) or ``None`` for a
+    standalone (read-only / test) core; ``cfg`` is the fleet config dict
+    serve() already threads everywhere. The read tier arms on
+    ``cfg["serving"]`` (in-process store only) or ``cfg["read_port"]``
+    (store + network read server; 0 = auto-assign, read back via
+    ``.read_port``). ``monitors=False`` skips the health/numerics/
+    lineage construction for callers that build their own (the sharded
+    shard-server does).
+    """
+
+    def __init__(self, server=None, cfg: Optional[Dict[str, Any]] = None,
+                 *, template: PyTree = None, monitors: bool = True,
+                 tenant: str = DEFAULT_TENANT, registry=None,
+                 read_host: str = "0.0.0.0"):
+        cfg = cfg or {}
+        self.cfg = cfg
+        self.server = server
+        self.default_tenant = str(tenant)
+        self.template = (template if template is not None
+                         else getattr(server, "template", None))
+        self.knobs = dict(SERVING_KNOBS)
+        self.knobs.update(cfg.get("serving_kw") or {})
+        self.armed = bool(cfg.get("serving")
+                          or cfg.get("read_port") is not None)
+        self.admission_depth = int(self.knobs["admission_depth"])
+        self.retry_after_s = float(self.knobs["retry_after_s"])
+
+        # -- monitor plumbing (the serve() extraction) --------------------
+        self.health = None
+        self.numerics = None
+        self.lineage = None
+        self.metrics_http_port: Optional[int] = None
+        if server is not None:
+            server.serving_core = self
+            if monitors:
+                self._build_monitors(cfg)
+
+        if server is not None:
+            self._reg = server.scrape_registry()
+        else:
+            from pytorch_ps_mpi_tpu.telemetry import MetricsRegistry
+
+            self._reg = registry if registry is not None else MetricsRegistry()
+
+        # -- read-path state ----------------------------------------------
+        self._lock = threading.Lock()
+        self._stores: Dict[str, SnapshotStore] = {}
+        self._templates: Dict[str, PyTree] = {}
+        self._deltas: Dict[str, DeltaCodec] = {}
+        self._versions: Dict[str, int] = {}
+        self._tenant_reads: Dict[str, int] = {}
+        self._encode_cache: Dict[Tuple[str, int, int], np.ndarray] = {}
+        self._rate: Dict[int, int] = {}  # monotonic-second -> read count
+        self.reads_total = 0
+        self.reads_full = 0
+        self.reads_delta = 0
+        self.reads_not_modified = 0
+        self.reads_shed = 0
+        self.coalesce_hits = 0
+        self.delta_bytes_saved = 0
+        self.ring_ageouts = 0
+        self.delta_full_fallbacks = 0
+        self._read_hist = self._reg.histogram(
+            "ps_read_seconds", _READ_BUCKETS,
+            "read-tier request service time (parse -> reply queued)")
+        self._t0 = time.monotonic()
+
+        if self.armed and self.template is not None:
+            # the default tenant's store exists from construction so the
+            # first publish and the first read cannot race its creation
+            self._ensure_tenant(self.default_tenant, self.template)
+
+        self.read_server = None
+        self.read_port: Optional[int] = None
+        if self.armed and cfg.get("read_port") is not None:
+            from pytorch_ps_mpi_tpu.serving.net import ReadTierServer
+
+            self.read_server = ReadTierServer(
+                self, port=int(cfg["read_port"]), host=read_host)
+            self.read_port = self.read_server.port
+
+        # standalone core (no transport server): serve /metrics + /health
+        # from an endpoint of our own, same routes as PSServerTelemetry
+        self._own_http = None
+        if server is None:
+            http_port = cfg.get("metrics_port")
+            if http_port is None:
+                http_port = cfg.get("health_port")
+            if http_port is not None:
+                from pytorch_ps_mpi_tpu.telemetry.http_server import (
+                    MetricsHTTPServer,
+                )
+
+                self._own_http = MetricsHTTPServer(
+                    self._reg.prometheus_text, port=int(http_port),
+                    routes={"/health": lambda: (json.dumps(
+                        {"armed": False, "workers": [],
+                         "serving": self.serving_snapshot()}),
+                        "application/json")},
+                )
+                self.metrics_http_port = self._own_http.port
+        self._register_scrape()
+
+    # -- monitor plumbing -------------------------------------------------
+    def _build_monitors(self, cfg: Dict[str, Any]) -> None:
+        """Health / numerics / lineage monitors + the metrics endpoint —
+        verbatim the construction ``serve()`` used to inline, so every
+        core-based server wires observability identically."""
+        server = self.server
+        if (cfg.get("health") or cfg.get("health_dir")
+                or cfg.get("health_port") is not None):
+            from pytorch_ps_mpi_tpu.telemetry.diagnosis import HealthMonitor
+
+            # attaches itself to server.health_monitor (the /health
+            # route) and registers its instruments on the scrape registry
+            self.health = HealthMonitor(server, cfg)
+        if (cfg.get("numerics") or cfg.get("numerics_dir")
+                or cfg.get("numerics_kw")):
+            from pytorch_ps_mpi_tpu.telemetry.numerics import NumericsMonitor
+
+            # attaches itself to server.numerics_monitor: canonical
+            # metrics grow the numerics keys, /health gains "numerics",
+            # and the serve loop validates every consumed push
+            self.numerics = NumericsMonitor(server, cfg)
+        if cfg.get("lineage") or cfg.get("lineage_dir"):
+            if getattr(server, "frame", False):
+                from pytorch_ps_mpi_tpu.telemetry.lineage import (
+                    LineageTracker,
+                )
+
+                # attaches itself to server.lineage_tracker: framed_poll
+                # feeds it every consumed push's trace ID
+                self.lineage = LineageTracker(server, cfg)
+            else:
+                # the trace ID rides the v2 frame header — without
+                # frames there is nothing on the wire to trace
+                print("lineage tracing requires frame_check=True; "
+                      "not armed", flush=True)
+        http_port = cfg.get("metrics_port")
+        if http_port is None:
+            http_port = cfg.get("health_port")  # same endpoint serves both
+        if http_port is not None and hasattr(server, "start_metrics_http"):
+            self.metrics_http_port = server.start_metrics_http(
+                int(http_port))
+            print(f"prometheus /metrics + /health on port "
+                  f"{self.metrics_http_port}", flush=True)
+
+    def tick(self) -> None:
+        """Monitor upkeep at the owning loop's tick cadence (same-thread
+        with the transport pumps, like the monitors require)."""
+        if self.health is not None:
+            self.health.tick()
+        if self.numerics is not None:
+            self.numerics.tick()
+
+    # -- publish ----------------------------------------------------------
+    def _ensure_tenant(self, tenant: str, template: PyTree
+                       ) -> SnapshotStore:
+        with self._lock:
+            store = self._stores.get(tenant)
+            if store is None:
+                store = SnapshotStore(int(self.knobs["ring"]))
+                self._stores[tenant] = store
+                if template is not None:
+                    self._templates[tenant] = template
+                self._tenant_reads.setdefault(tenant, 0)
+            return store
+
+    def publish(self, params: PyTree = None, *, flat: np.ndarray = None,
+                tenant: Optional[str] = None,
+                version: Optional[int] = None,
+                template: PyTree = None) -> int:
+        """Publish one version: through the transport server (primary
+        tenant) and/or into the snapshot ring (when the read tier is
+        armed). Returns the published version.
+
+        Unarmed with a server this is EXACTLY ``server.publish(params)``
+        — the legacy trainer path pays nothing for the read tier it
+        isn't running. Side tenants (``tenant != default``) and
+        serverless cores version locally (pass ``version=`` to pin, e.g.
+        a restored checkpoint's version).
+        """
+        tenant = tenant or self.default_tenant
+        primary = (self.server is not None
+                   and tenant == self.default_tenant)
+        if not self.armed:
+            if not primary:
+                raise ValueError(
+                    "read tier is unarmed: side-tenant/serverless publish "
+                    "has nowhere to go (set cfg['serving'] or "
+                    "cfg['read_port'])")
+            self.server.publish(params)
+            return self.server.version
+        if flat is None:
+            from pytorch_ps_mpi_tpu.parallel.dcn import _flatten
+
+            flat = _flatten(params)
+        if primary:
+            self.server.publish_flat(flat)
+            version = self.server.version
+        elif version is None:
+            version = self._versions.get(tenant, 0) + 1
+        version = int(version)
+        self._versions[tenant] = version
+        store = self._stores.get(tenant)
+        if store is None:
+            store = self._ensure_tenant(
+                tenant, template if template is not None
+                else (params if params is not None else self.template))
+        store.put(version, flat)
+        with self._lock:
+            # new latest ends the coalescing window: cached encodes
+            # against the previous latest can never be served again
+            for k in [k for k in self._encode_cache if k[0] == tenant]:
+                del self._encode_cache[k]
+        return version
+
+    # -- read path --------------------------------------------------------
+    def _delta(self, tenant: str) -> DeltaCodec:
+        dc = self._deltas.get(tenant)
+        if dc is None:
+            tmpl = self._templates.get(tenant)
+            if tmpl is None:
+                raise ValueError(f"no template recorded for tenant "
+                                 f"{tenant!r}")
+            dc = DeltaCodec.from_knobs(tmpl, self.knobs)
+            with self._lock:  # scrape threads iterate _deltas under it
+                dc = self._deltas.setdefault(tenant, dc)
+        return dc
+
+    def latest_version(self, tenant: Optional[str] = None) -> int:
+        store = self._stores.get(tenant or self.default_tenant)
+        if store is None:
+            return 0
+        snap = store.latest()
+        return snap.version if snap is not None else 0
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self.reads_shed += 1
+
+    def observe_read(self, dur_s: float) -> None:
+        self._read_hist.observe(float(dur_s))
+
+    def handle_read(self, have_version: int = 0, want_delta: bool = True,
+                    tenant: Optional[str] = None):
+        """Answer one read: ``(kind, version, base, payload, done)``.
+
+        ``payload`` is ``None`` (not-modified / retry), a frozen flat
+        snapshot array (full — send zero-copy, call ``done()`` when the
+        bytes are out to release the ring pin), or a cached delta buffer
+        (shared by every coalesced reader; kept alive by its reference).
+        Safe from any thread — only store/cache/counter state is touched,
+        never a native transport handle.
+        """
+        tenant = tenant or self.default_tenant
+        store = self._stores.get(tenant)
+        if store is None:
+            return (KIND_ERROR, 0, 0,
+                    f"unknown tenant {tenant!r}".encode(), None)
+        latest = store.acquire(None)
+        if latest is None:
+            # nothing published yet: ask the reader to come back
+            return KIND_RETRY, 0, 0, None, None
+        try:
+            return self._answer_read(store, latest, int(have_version),
+                                     want_delta, tenant)
+        except BaseException:
+            # never leak the ring pin: an encode error (template drift,
+            # size mismatch) surfaces to the caller, not as a permanently
+            # held snapshot
+            store.release(latest)
+            raise
+
+    def _answer_read(self, store, latest, have: int, want_delta: bool,
+                     tenant: str):
+        version = latest.version
+        now_s = int(time.monotonic())
+        with self._lock:
+            self.reads_total += 1
+            self._tenant_reads[tenant] = (
+                self._tenant_reads.get(tenant, 0) + 1)
+            # per-second rate buckets: no cap, unlike a bounded timestamp
+            # deque which silently under-reports rates past maxlen/window.
+            # Pruned HERE too (not just on /health reads) so a server
+            # scraped only via /metrics never accumulates old buckets.
+            self._rate[now_s] = self._rate.get(now_s, 0) + 1
+            if len(self._rate) > int(self.knobs["rate_window_s"]) + 2:
+                cutoff = now_s - int(self.knobs["rate_window_s"])
+                for sec in [s for s in self._rate if s < cutoff]:
+                    del self._rate[sec]
+        if have == version:
+            store.release(latest)
+            with self._lock:
+                self.reads_not_modified += 1
+            return KIND_NOT_MODIFIED, version, have, None, None
+        full_bytes = latest.nbytes
+        if want_delta and have > 0:
+            key = (tenant, have, version)
+            with self._lock:
+                payload = self._encode_cache.get(key)
+            if payload is not None:
+                # coalesced: same (base -> latest) ask within this
+                # version's window rides the one existing encode
+                store.release(latest)
+                with self._lock:
+                    self.reads_delta += 1
+                    self.coalesce_hits += 1
+                    self.delta_bytes_saved += max(
+                        0, full_bytes - payload.nbytes)
+                return KIND_DELTA, version, have, payload, None
+            base = store.acquire(have)
+            if base is None:
+                with self._lock:
+                    self.ring_ageouts += 1  # aged out: full fallback
+            else:
+                try:
+                    payload = self._delta(tenant).encode(
+                        base.flat, latest.flat)
+                finally:
+                    store.release(base)
+                if payload is None:
+                    with self._lock:
+                        self.delta_full_fallbacks += 1
+                else:
+                    with self._lock:
+                        self._encode_cache[key] = payload
+                        self.reads_delta += 1
+                        self.delta_bytes_saved += max(
+                            0, full_bytes - payload.nbytes)
+                    store.release(latest)
+                    return KIND_DELTA, version, have, payload, None
+        with self._lock:
+            self.reads_full += 1
+        done = (lambda s=latest, st=store: st.release(s))
+        return KIND_FULL, version, 0, latest.flat, done
+
+    def acquire_latest(self, tenant: Optional[str] = None):
+        """In-process zero-copy read: pin and return the latest
+        :class:`~.snapshots.Snapshot` (``.view()`` is the shared bytes)
+        — release with :meth:`release` when done. None before the first
+        publish."""
+        store = self._stores.get(tenant or self.default_tenant)
+        return store.acquire(None) if store is not None else None
+
+    def release(self, snap, tenant: Optional[str] = None) -> None:
+        store = self._stores.get(tenant or self.default_tenant)
+        if store is not None:
+            store.release(snap)
+
+    # -- accounting -------------------------------------------------------
+    def reads_per_s(self) -> float:
+        window = max(1.0, float(self.knobs["rate_window_s"]))
+        now = time.monotonic()
+        cutoff = int(now - window)
+        with self._lock:
+            for sec in [s for s in self._rate if s < cutoff]:
+                del self._rate[sec]
+            n = sum(self._rate.values())
+        span = min(window, max(now - self._t0, 1e-6))
+        return n / span if span > 0 else 0.0
+
+    def _quantile_ms(self, q: float) -> float:
+        import math
+
+        v = self._read_hist.approx_quantile(q)
+        return 0.0 if math.isnan(v) else v * 1e3
+
+    def read_metrics(self) -> Dict[str, float]:
+        """The canonical serving keys (all float; zeros before traffic)."""
+        with self._lock:
+            out = {
+                "reads_total": float(self.reads_total),
+                "delta_bytes_saved": float(self.delta_bytes_saved),
+                "reads_shed": float(self.reads_shed),
+                "coalesce_hits": float(self.coalesce_hits),
+                "reads_not_modified": float(self.reads_not_modified),
+            }
+        out["read_p50_ms"] = self._quantile_ms(0.50)
+        out["read_p95_ms"] = self._quantile_ms(0.95)
+        return out
+
+    def serving_snapshot(self) -> Dict[str, Any]:
+        """The ``/health`` ``serving`` section: ring occupancy, queue
+        depth, per-tenant read counts, shed/coalesce counters."""
+        with self._lock:
+            tenants = {
+                t: {**store.snapshot(),
+                    "reads": self._tenant_reads.get(t, 0)}
+                for t, store in self._stores.items()
+            }
+            counters = {
+                "reads_total": self.reads_total,
+                "reads_full": self.reads_full,
+                "reads_delta": self.reads_delta,
+                "reads_not_modified": self.reads_not_modified,
+                "reads_shed": self.reads_shed,
+                "coalesce_hits": self.coalesce_hits,
+                "delta_bytes_saved": self.delta_bytes_saved,
+                "ring_ageouts": self.ring_ageouts,
+                "delta_full_fallbacks": self.delta_full_fallbacks,
+            }
+            lossy_fallbacks = sum(d.lossy_fallbacks
+                                  for d in self._deltas.values())
+        out = {
+            "armed": self.armed,
+            "read_port": self.read_port,
+            "admission_depth": self.admission_depth,
+            "retry_after_s": self.retry_after_s,
+            "queue_depth": (self.read_server.queue_depth()
+                            if self.read_server is not None else 0),
+            "connections": (self.read_server.connections()
+                            if self.read_server is not None else 0),
+            "reads_per_s": round(self.reads_per_s(), 3),
+            "read_p50_ms": round(self._quantile_ms(0.50), 4),
+            "read_p95_ms": round(self._quantile_ms(0.95), 4),
+            "lossy_fallbacks": lossy_fallbacks,
+            "tenants": tenants,
+            **counters,
+        }
+        nat = getattr(self.server, "_native_read_stats", None)
+        if nat is not None:
+            # the transport's own GET_PARAMS path (worker reads): total
+            # + cheap not-modified replies, counted natively
+            out["native_reads"] = {"total": int(nat[0]),
+                                   "not_modified": int(nat[1])}
+        return out
+
+    def _register_scrape(self) -> None:
+        def collect(r) -> None:
+            m = self.read_metrics()
+            r.counter("ps_reads_total",
+                      "read-tier requests served (all kinds)").set(
+                          m["reads_total"])
+            r.counter("ps_reads_shed_total",
+                      "read requests shed by admission control").set(
+                          m["reads_shed"])
+            r.counter("ps_coalesce_hits_total",
+                      "delta reads served from an existing encode").set(
+                          m["coalesce_hits"])
+            r.counter("ps_delta_bytes_saved_total",
+                      "payload bytes saved by delta replies vs full "
+                      "snapshots").set(m["delta_bytes_saved"])
+            r.counter("ps_reads_not_modified_total",
+                      "version-conditional reads answered without a "
+                      "payload").set(m["reads_not_modified"])
+            r.gauge("ps_read_p50_ms",
+                    "read-tier service time p50 (ms)").set(
+                        m["read_p50_ms"])
+            r.gauge("ps_read_p95_ms",
+                    "read-tier service time p95 (ms)").set(
+                        m["read_p95_ms"])
+            r.gauge("ps_read_queue_depth",
+                    "read requests awaiting service").set(
+                        float(self.read_server.queue_depth()
+                              if self.read_server is not None else 0))
+            with self._lock:
+                occ = sum(len(s._order) for s in self._stores.values())
+                tenants = len(self._stores)
+            r.gauge("ps_serving_ring_occupancy",
+                    "snapshots resident across all tenant rings").set(
+                        float(occ))
+            r.gauge("ps_serving_tenants",
+                    "tenant namespaces with a snapshot ring").set(
+                        float(tenants))
+
+        self._reg.add_collector(collect)
+
+    @property
+    def registry(self):
+        return self._reg
+
+    def close(self) -> None:
+        """Tear down the network read server and any standalone HTTP
+        endpoint. Monitors are closed by their owner (serve() closes
+        numerics/lineage exactly as before the extraction)."""
+        if self.read_server is not None:
+            self.read_server.close()
+            self.read_server = None
+        if self._own_http is not None:
+            self._own_http.close()
+            self._own_http = None

@@ -1,0 +1,335 @@
+"""Version-to-version parameter deltas for the read tier.
+
+A reader holding version ``v`` asks the server for "v → latest"; this
+module builds (and applies) the answer. The flat f32 parameter vector is
+segmented into dtype-bucketed ~MB-scale sections via
+:func:`~pytorch_ps_mpi_tpu.bucketing.plan_buckets` (the published
+snapshot wire is all-f32, so the plan degenerates to contiguous
+leaf-order segments — the point is that section boundaries follow layer
+boundaries, so an update that touched two layers ships two sections,
+not the whole model), and each *changed* section is encoded either
+sparse (index+value of changed elements, the SparCML index-merge shape)
+or dense (the section's new values verbatim), whichever is smaller.
+Unchanged sections ship nothing.
+
+**Exact by default**: changed elements are detected by *bit* compare
+(u32 views — NaN- and -0.0-proof) and the payload carries the NEW values
+verbatim, so ``apply(base, encode(base, latest)) == latest`` bit for
+bit. **Lossy opt-in**: pass a codec (``codecs.get_codec`` name) and
+sections ride its encoded form of the dense diff — guarded by a PR 5
+style fidelity probe: at probe cadence the encoder measures the
+decode-after-encode relative L2 error of the diff it is about to ship
+and *sticky-disables* the lossy path (falling back to exact, counted)
+the moment it exceeds ``max_rel_error``. Both ends must construct the
+same ``DeltaCodec`` config — it joins the wire agreement exactly like
+``CodecWire``'s codec/bucket config.
+
+Payload format (little-endian)::
+
+  u32 magic 'PSD1' | u32 n_sections | u64 total_elems
+  per section:
+    u32 mode (0 sparse / 1 dense / 2 lossy) | u32 start | u32 count
+    | u32 n  (sparse: nnz; dense: count; lossy: payload bytes)
+    | body   (sparse: u32 idx[n] then f32 val[n]; dense: f32 val[count];
+              lossy: packed codec payload arrays for a (count,) f32 diff)
+
+``encode`` returns ``None`` when the delta would not beat the full
+snapshot (the caller then serves a full read — counted, never silent).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+MAGIC = 0x31445350  # "PSD1"
+_HEADER = struct.Struct("<IIQ")
+_SECTION = struct.Struct("<IIII")
+MODE_SPARSE, MODE_DENSE, MODE_LOSSY = 0, 1, 2
+
+#: tuning knobs and their defaults (overridable via ``cfg["serving_kw"]``)
+DELTA_KNOBS: Dict[str, Any] = {
+    "delta_bucket_mb": 4.0,     # section granularity (0 = one section)
+    "delta_codec": None,        # codec registry name; None = exact only
+    "delta_codec_kw": {},       # constructor kwargs for the lossy codec
+    "delta_max_rel_error": 0.05,  # fidelity gate for the lossy path
+    "delta_probe_every": 16,    # lossy fidelity probe cadence (encodes)
+    "delta_min_saving": 0.9,    # ship delta only if < this x full bytes
+}
+
+
+def _flat_segments(template: PyTree, bucket_mb: float,
+                   total: int) -> List[Tuple[int, int]]:
+    """Contiguous ``(start, count)`` segments of the flat f32 vector,
+    derived from the dtype-bucket plan over an all-f32 view of the
+    template (one dtype group → buckets keep leaf/flatten order, so the
+    cumulative sizes ARE the flat offsets)."""
+    if total == 0:
+        return []
+    if bucket_mb is None or bucket_mb <= 0:
+        return [(0, total)]
+    import jax
+
+    from pytorch_ps_mpi_tpu.bucketing import plan_buckets
+
+    f32_tmpl = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(tuple(np.shape(l)), np.float32),
+        template,
+    )
+    plan = plan_buckets(f32_tmpl, bucket_mb)
+    segs, off = [], 0
+    for b in plan.buckets:
+        segs.append((off, int(b.size)))
+        off += int(b.size)
+    assert off == total, f"segment plan covers {off} of {total} elements"
+    return segs
+
+
+class DeltaCodec:
+    """Encode/apply exact (or guarded-lossy) flat-vector deltas.
+
+    Construct with the SAME arguments on server and reader — the config
+    is part of the wire agreement (exact mode is self-describing, but
+    the lossy mode's codec payload layout is not).
+    """
+
+    def __init__(self, template: PyTree, bucket_mb: float = 4.0,
+                 codec: Optional[str] = None,
+                 codec_kw: Optional[dict] = None,
+                 max_rel_error: float = 0.05, probe_every: int = 16,
+                 min_saving: float = 0.9):
+        from pytorch_ps_mpi_tpu.parallel.dcn import _flat_size
+
+        self.total = int(_flat_size(template))
+        self.full_bytes = self.total * 4
+        self.segments = _flat_segments(template, bucket_mb, self.total)
+        self.max_rel_error = float(max_rel_error)
+        self.probe_every = max(1, int(probe_every))
+        self.min_saving = float(min_saving)
+        self.code = None
+        if codec:
+            from pytorch_ps_mpi_tpu.codecs import get_codec
+
+            self.code = get_codec(codec, **(codec_kw or {}))
+        #: sticky lossy state: True until a fidelity probe fails
+        self.lossy_ok = self.code is not None
+        self.lossy_fallbacks = 0
+        self.last_probe_rel_error: Optional[float] = None
+        self._encodes = 0
+        self._codec_specs: Dict[int, List[Tuple[tuple, np.dtype]]] = {}
+
+    # -- lossy helpers ----------------------------------------------------
+    def _specs_for(self, count: int) -> List[Tuple[tuple, np.dtype]]:
+        """Flat payload specs of the lossy codec on a (count,) f32 input
+        (cached per section size) — the same eval_shape derivation
+        ``CodecWire`` uses."""
+        specs = self._codec_specs.get(count)
+        if specs is None:
+            import jax
+            import jax.numpy as jnp
+
+            struct_ = jax.eval_shape(
+                lambda: self.code.encode(
+                    jnp.zeros((count,), jnp.float32),
+                    self.code.init_state((count,), jnp.float32),
+                    jax.random.key(0) if self.code.needs_rng else None,
+                )
+            )[0]
+            specs = [(tuple(x.shape), np.dtype(x.dtype))
+                     for x in jax.tree.leaves(struct_)]
+            self._codec_specs[count] = specs
+        return specs
+
+    def _lossy_encode(self, diff: np.ndarray,
+                      probe: bool) -> Optional[bytes]:
+        """Codec-encode one section's dense diff; None when the fidelity
+        probe rejects it (sticky) or the codec errors."""
+        import jax
+
+        try:
+            rng = (jax.random.key(0x5EED) if self.code.needs_rng else None)
+            payload, _ = self.code.encode(
+                diff, self.code.init_state(diff.shape, diff.dtype), rng)
+            if probe:
+                rec = np.asarray(
+                    self.code.decode(payload, diff.shape, diff.dtype),
+                    np.float32)
+                dn = float(np.linalg.norm(diff))
+                rel = float(np.linalg.norm(rec - diff) / max(dn, 1e-30))
+                self.last_probe_rel_error = rel
+                if rel > self.max_rel_error:
+                    # the codec measurably mangles THIS distribution of
+                    # diffs — disable lossy for the rest of the run
+                    self.lossy_ok = False
+                    self.lossy_fallbacks += 1
+                    return None
+            parts = [np.ascontiguousarray(np.asarray(x)).reshape(-1)
+                     .view(np.uint8)
+                     for x in jax.tree.leaves(payload)]
+            return b"".join(p.tobytes() for p in parts)
+        except Exception:
+            self.lossy_ok = False
+            self.lossy_fallbacks += 1
+            return None
+
+    def _lossy_apply(self, base_seg: np.ndarray,
+                     body: memoryview) -> np.ndarray:
+        import jax
+
+        from pytorch_ps_mpi_tpu.utils.serialization import read_arrays
+
+        count = base_seg.size
+        specs = self._specs_for(count)
+        arrays = read_arrays(body, specs, copy=False)
+        struct_ = jax.tree.structure(
+            jax.eval_shape(
+                lambda: self.code.encode(
+                    np.zeros((count,), np.float32),
+                    self.code.init_state((count,), np.float32),
+                    jax.random.key(0) if self.code.needs_rng else None,
+                )
+            )[0]
+        )
+        payload = jax.tree.unflatten(struct_, arrays)
+        diff = np.asarray(
+            self.code.decode(payload, (count,), np.float32), np.float32)
+        return base_seg + diff
+
+    # -- encode -----------------------------------------------------------
+    def encode(self, base: np.ndarray,
+               latest: np.ndarray) -> Optional[np.ndarray]:
+        """Delta payload bytes (uint8 ndarray) for base → latest, or
+        ``None`` when a full snapshot is the better answer."""
+        if base.size != self.total or latest.size != self.total:
+            raise ValueError(
+                f"flat size mismatch: template {self.total}, "
+                f"base {base.size}, latest {latest.size}")
+        self._encodes += 1
+        probe = (self._encodes % self.probe_every) == 1 or self.probe_every == 1
+        bv = base.view(np.uint32)
+        lv = latest.view(np.uint32)
+        sections: List[Tuple[int, int, int, bytes, np.ndarray, np.ndarray]] = []
+        total_bytes = _HEADER.size
+        for start, count in self.segments:
+            seg_b = bv[start:start + count]
+            seg_l = lv[start:start + count]
+            idx = np.nonzero(seg_b != seg_l)[0]
+            nnz = int(idx.size)
+            if nnz == 0:
+                continue
+            vals = latest[start:start + count]
+            sparse_bytes = 8 * nnz
+            dense_bytes = 4 * count
+            if self.code is not None and self.lossy_ok:
+                diff = vals - base[start:start + count]
+                body = self._lossy_encode(
+                    np.ascontiguousarray(diff, np.float32), probe)
+                if body is not None and len(body) < min(sparse_bytes,
+                                                        dense_bytes):
+                    sections.append((MODE_LOSSY, start, count, body,
+                                     None, None))
+                    total_bytes += _SECTION.size + len(body)
+                    continue
+            if sparse_bytes < dense_bytes:
+                sections.append((MODE_SPARSE, start, count, b"",
+                                 idx.astype(np.uint32), vals[idx]))
+                total_bytes += _SECTION.size + sparse_bytes
+            else:
+                sections.append((MODE_DENSE, start, count, b"",
+                                 None, vals))
+                total_bytes += _SECTION.size + dense_bytes
+        if total_bytes >= self.min_saving * self.full_bytes:
+            return None  # delta not worth it: serve a full snapshot
+        out = np.empty(total_bytes, np.uint8)
+        _HEADER.pack_into(out, 0, MAGIC, len(sections), self.total)
+        off = _HEADER.size
+        for mode, start, count, body, idx, vals in sections:
+            if mode == MODE_LOSSY:
+                n = len(body)
+            elif mode == MODE_SPARSE:
+                n = int(idx.size)
+            else:
+                n = count
+            _SECTION.pack_into(out, off, mode, start, count, n)
+            off += _SECTION.size
+            if mode == MODE_LOSSY:
+                out[off:off + len(body)] = np.frombuffer(body, np.uint8)
+                off += len(body)
+            elif mode == MODE_SPARSE:
+                ib = np.ascontiguousarray(idx).view(np.uint8)
+                out[off:off + ib.nbytes] = ib
+                off += ib.nbytes
+                vb = np.ascontiguousarray(vals, np.float32).view(np.uint8)
+                out[off:off + vb.nbytes] = vb
+                off += vb.nbytes
+            else:
+                vb = np.ascontiguousarray(vals, np.float32).view(np.uint8)
+                out[off:off + vb.nbytes] = vb
+                off += vb.nbytes
+        assert off == total_bytes
+        return out
+
+    # -- apply ------------------------------------------------------------
+    def apply(self, base: np.ndarray, payload) -> np.ndarray:
+        """Rebuild the latest flat vector from ``base`` + a delta payload
+        (bytes-like). Returns a NEW array; ``base`` is untouched."""
+        mv = memoryview(payload)
+        if mv.nbytes < _HEADER.size:
+            raise ValueError("truncated delta payload (no header)")
+        magic, n_sections, total = _HEADER.unpack_from(mv, 0)
+        if magic != MAGIC:
+            raise ValueError(f"bad delta magic 0x{magic:08x}")
+        if total != base.size:
+            raise ValueError(
+                f"delta for {total} elements applied to base of {base.size}")
+        out = np.array(base, np.float32, copy=True)
+        off = _HEADER.size
+        for _ in range(n_sections):
+            mode, start, count, n = _SECTION.unpack_from(mv, off)
+            off += _SECTION.size
+            if mode == MODE_SPARSE:
+                idx = np.frombuffer(mv, np.uint32, n, off)
+                off += 4 * n
+                vals = np.frombuffer(mv, np.float32, n, off)
+                off += 4 * n
+                out[start:start + count][idx] = vals
+            elif mode == MODE_DENSE:
+                vals = np.frombuffer(mv, np.float32, count, off)
+                off += 4 * count
+                out[start:start + count] = vals
+            elif mode == MODE_LOSSY:
+                if self.code is None:
+                    raise ValueError(
+                        "lossy delta section but this DeltaCodec has no "
+                        "codec configured (wire agreement drift)")
+                out[start:start + count] = self._lossy_apply(
+                    out[start:start + count], mv[off:off + n])
+                off += n
+            else:
+                raise ValueError(f"unknown delta section mode {mode}")
+        if off != mv.nbytes:
+            raise ValueError(
+                f"delta payload has {mv.nbytes - off} trailing bytes")
+        return out
+
+    @classmethod
+    def from_knobs(cls, template: PyTree, knobs: Dict[str, Any]
+                   ) -> "DeltaCodec":
+        """Construct from a ``DELTA_KNOBS``-shaped dict (the
+        ``cfg["serving_kw"]`` path — both ends call this with the same
+        cfg, which is what keeps the wire agreement single-sourced)."""
+        k = dict(DELTA_KNOBS)
+        k.update({key: v for key, v in knobs.items() if key in DELTA_KNOBS})
+        return cls(
+            template,
+            bucket_mb=float(k["delta_bucket_mb"]),
+            codec=k["delta_codec"],
+            codec_kw=k["delta_codec_kw"],
+            max_rel_error=float(k["delta_max_rel_error"]),
+            probe_every=int(k["delta_probe_every"]),
+            min_saving=float(k["delta_min_saving"]),
+        )

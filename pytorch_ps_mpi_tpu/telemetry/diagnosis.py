@@ -480,6 +480,12 @@ class HealthMonitor:
             # the lineage section: exact e2e/staleness distributions,
             # composition counters, stage-level critical paths
             out["lineage"] = lt.snapshot()
+        sc = getattr(self.server, "serving_core", None)
+        if sc is not None and sc.armed:
+            # the serving section: snapshot-ring occupancy, read queue
+            # depth, per-tenant read counts, shed/coalesce counters —
+            # the read tier's half of the fleet picture
+            out["serving"] = sc.serving_snapshot()
         return out
 
     def render_json(self) -> str:

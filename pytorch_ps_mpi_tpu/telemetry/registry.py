@@ -329,6 +329,22 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "lineage_pushes",
     "push_e2e_p50_ms",
     "push_e2e_p95_ms",
+    # parameter-serving read tier (serving.ServingCore): all 0.0 when the
+    # read tier is unarmed. reads_total counts read-tier requests served
+    # (plus, on TCP, the transport's own native GET_PARAMS worker reads);
+    # read_p50/p95_ms are read-tier service times; delta_bytes_saved is
+    # payload bytes delta replies avoided vs full snapshots; reads_shed
+    # counts admission-control rejections (explicit retry-after replies);
+    # coalesce_hits counts delta reads served from an existing encode;
+    # reads_not_modified counts version-conditional reads answered with
+    # no payload (read tier + the native conditional GET_PARAMS path)
+    "reads_total",
+    "read_p50_ms",
+    "read_p95_ms",
+    "delta_bytes_saved",
+    "reads_shed",
+    "coalesce_hits",
+    "reads_not_modified",
 )
 
 
@@ -375,6 +391,11 @@ def ps_server_metrics(server) -> Dict[str, float]:
         units = 1.0 if jax.tree.leaves(server.template) else 0.0
     nm = getattr(server, "numerics_monitor", None)
     lt = getattr(server, "lineage_tracker", None)
+    sc = getattr(server, "serving_core", None)
+    rm = sc.read_metrics() if (sc is not None and sc.armed) else {}
+    # the transport's own worker-read path (TCP GET_PARAMS) counts too:
+    # totals and cheap not-modified replies ride the same canonical keys
+    nat_total, nat_nm = getattr(server, "_native_read_stats", (0, 0))
     return {
         "grads_received": float(server.grads_received),
         "bytes_received": float(server.bytes_received),
@@ -402,6 +423,14 @@ def ps_server_metrics(server) -> Dict[str, float]:
             lt.e2e_ms_quantile(0.50) if lt is not None else 0.0),
         "push_e2e_p95_ms": float(
             lt.e2e_ms_quantile(0.95) if lt is not None else 0.0),
+        "reads_total": rm.get("reads_total", 0.0) + float(nat_total),
+        "read_p50_ms": rm.get("read_p50_ms", 0.0),
+        "read_p95_ms": rm.get("read_p95_ms", 0.0),
+        "delta_bytes_saved": rm.get("delta_bytes_saved", 0.0),
+        "reads_shed": rm.get("reads_shed", 0.0),
+        "coalesce_hits": rm.get("coalesce_hits", 0.0),
+        "reads_not_modified": (rm.get("reads_not_modified", 0.0)
+                               + float(nat_nm)),
     }
 
 
@@ -452,6 +481,13 @@ def ps_server_registry(
                 "contiguous payload buffers one push ships "
                 "(buckets when bucketing, leaves otherwise)").set(
                     m["wire_units_per_push"])
+        nat_total, nat_nm = getattr(server, "_native_read_stats", (0, 0))
+        r.counter("ps_native_reads_total",
+                  "transport-level worker snapshot reads (GET_PARAMS)"
+                  ).set(float(nat_total))
+        r.counter("ps_native_reads_not_modified_total",
+                  "transport-level reads answered with the cheap "
+                  "not-modified reply").set(float(nat_nm))
         r.gauge("ps_publish_version",
                 "latest published snapshot version").set(float(server.version))
         r.gauge("ps_num_workers", "configured worker count").set(
@@ -508,6 +544,10 @@ class PSServerTelemetry:
     #: step, seq, staleness, send/recv walls, decode_s), refreshed by
     #: ``framed_poll`` on every successful pop
     last_push_meta: Optional[Dict[str, Any]] = None
+    #: the attached parameter-serving core (snapshot ring + read tier +
+    #: the canonical ``reads_*`` metrics source), set by
+    #: :class:`~pytorch_ps_mpi_tpu.serving.ServingCore` on construction
+    serving_core: Optional[Any] = None
 
     @property
     def frames_rejected(self) -> Dict[int, int]:
@@ -543,7 +583,13 @@ class PSServerTelemetry:
 
         mon = self.health_monitor
         if mon is None:
-            return json.dumps({"armed": False, "workers": []})
+            doc: Dict[str, Any] = {"armed": False, "workers": []}
+            sc = self.serving_core
+            if sc is not None and sc.armed:
+                # a read-only / monitor-less server still reports its
+                # serving tier: ring occupancy, queue depth, read counts
+                doc["serving"] = sc.serving_snapshot()
+            return json.dumps(doc)
         return mon.render_json()
 
     def start_metrics_http(self, port: int = 0,

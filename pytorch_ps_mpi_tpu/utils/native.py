@@ -52,8 +52,15 @@ def build_and_load(src_name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
         if (not os.path.exists(so_path)
                 or os.path.getmtime(so_path) < os.path.getmtime(src)):
             tmp = tempfile.mktemp(suffix=".so", dir=build_dir)
+            # -lrt AFTER the source (link order): shm_open lives in librt
+            # on pre-2.34 glibc; newer glibc ships a no-op librt. Linux
+            # only — other platforms have no librt and the flag would
+            # fail the whole build into the silent fallback
+            import sys as _sys
+
+            libs = ["-lrt"] if _sys.platform.startswith("linux") else []
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                   *extra_flags, "-o", tmp, src]
+                   *extra_flags, "-o", tmp, src, *libs]
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(tmp, so_path)
         return ctypes.CDLL(so_path)

@@ -1,0 +1,583 @@
+"""Parameter-serving read tier: snapshots, deltas, coalescing, admission,
+concurrent readers on both transports, tenants, and the metric surfaces.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.parallel.dcn import _flatten, _unflatten
+from pytorch_ps_mpi_tpu.serving import (
+    DeltaCodec,
+    ServingCore,
+    ServingReader,
+    SnapshotStore,
+)
+from pytorch_ps_mpi_tpu.serving.net import ReadClient
+
+TMPL = {"a": np.zeros((700, 4), np.float32), "b": np.zeros((13,), np.float32)}
+N = 700 * 4 + 13
+KW = {"ring": 4, "admission_depth": 64, "retry_after_s": 0.005,
+      "delta_bucket_mb": 0.002}
+
+
+def flat_of(seed_or_val) -> np.ndarray:
+    if isinstance(seed_or_val, float):
+        return np.full(N, seed_or_val, np.float32)
+    return np.random.RandomState(seed_or_val).randn(N).astype(np.float32)
+
+
+# -- snapshot store ----------------------------------------------------------
+
+def test_snapshot_ring_evicts_and_refcounts():
+    st = SnapshotStore(ring=3)
+    for v in range(1, 5):
+        st.put(v, np.full(8, float(v), np.float32))
+    assert st.versions() == [2, 3, 4]
+    assert st.get(1) is None
+    held = st.acquire(2)
+    assert held.refs == 1
+    st.put(5, np.full(8, 5.0, np.float32))
+    st.put(6, np.full(8, 6.0, np.float32))
+    # evicted from the ring but alive while held (zombie accounting)
+    assert st.get(2) is None
+    assert held.flat[0] == 2.0
+    assert st.snapshot()["zombies"] == 1
+    st.release(held)
+    assert st.snapshot()["zombies"] == 0
+    assert st.refs_out() == 0
+
+
+def test_snapshot_views_are_readonly_zero_copy():
+    st = SnapshotStore(ring=2)
+    flat = np.arange(10, dtype=np.float32)
+    snap = st.put(1, flat)
+    assert not snap.flat.flags.writeable
+    mv = snap.view()
+    assert mv.readonly and mv.nbytes == 40
+    # zero copy: the view aliases the stored array's memory
+    assert np.frombuffer(mv, np.float32)[3] == 3.0
+    with pytest.raises(ValueError):
+        snap.flat[0] = 9.0
+
+
+def test_snapshot_duplicate_version_replaces_cleanly():
+    st = SnapshotStore(ring=2)
+    st.put(5, np.full(4, 1.0, np.float32))
+    held = st.acquire(5)
+    st.put(5, np.full(4, 2.0, np.float32))  # re-publish of a pinned version
+    assert st.versions() == [5]
+    assert st.latest().flat[0] == 2.0
+    assert held.flat[0] == 1.0  # the held copy survives as a zombie
+    st.put(6, np.zeros(4, np.float32))
+    st.put(7, np.zeros(4, np.float32))  # evicts 5 without a KeyError
+    assert st.latest().version == 7
+    st.release(held)
+
+
+def test_snapshot_acquire_missing_returns_none():
+    st = SnapshotStore(ring=2)
+    assert st.acquire(7) is None and st.latest() is None
+    st.put(1, np.zeros(4, np.float32))
+    assert st.acquire(None).version == 1
+
+
+# -- delta codec -------------------------------------------------------------
+
+def test_delta_exact_roundtrip_bit_for_bit():
+    dc = DeltaCodec(TMPL, bucket_mb=0.002)
+    base = flat_of(0)
+    latest = base.copy()
+    latest[[3, 500, N - 1]] = [np.nan, -0.0, 7.25]  # bit-level cases
+    payload = dc.encode(base, latest)
+    assert payload is not None and payload.nbytes < N * 4 / 5
+    out = dc.apply(base, payload)
+    assert np.array_equal(out.view(np.uint32), latest.view(np.uint32))
+
+
+def test_delta_unchanged_sections_ship_nothing():
+    dc = DeltaCodec(TMPL, bucket_mb=0.002)
+    base = flat_of(0)
+    latest = base.copy()
+    latest[0] += 1.0  # one element in one bucket
+    payload = dc.encode(base, latest)
+    assert payload.nbytes < 64  # header + one sparse entry
+
+
+def test_delta_dense_wins_when_most_elements_change():
+    dc = DeltaCodec(TMPL, bucket_mb=0.0)  # one section
+    base = flat_of(0)
+    latest = base + 1.0
+    # everything changed: dense (or full-fallback) — never 8-byte sparse
+    payload = dc.encode(base, latest)
+    if payload is not None:
+        assert payload.nbytes <= N * 4 + 64
+        out = dc.apply(base, payload)
+        assert np.array_equal(out, latest)
+
+
+def test_delta_full_fallback_when_not_worth_it():
+    dc = DeltaCodec(TMPL, bucket_mb=0.002, min_saving=0.5)
+    base = flat_of(0)
+    assert dc.encode(base, base + 1.0) is None
+
+
+def test_delta_lossy_guarded_by_fidelity_probe():
+    # bf16 narrows mantissas: small rel error, passes the probe, and the
+    # payload halves vs dense f32
+    dc_srv = DeltaCodec(TMPL, bucket_mb=0.0, codec="bf16",
+                        max_rel_error=0.05, probe_every=1)
+    dc_cli = DeltaCodec(TMPL, bucket_mb=0.0, codec="bf16",
+                        max_rel_error=0.05, probe_every=1)
+    base = flat_of(0)
+    latest = base + np.random.RandomState(1).randn(N).astype(np.float32)
+    payload = dc_srv.encode(base, latest)
+    assert dc_srv.lossy_ok and payload.nbytes < N * 4 * 0.6
+    out = dc_cli.apply(base, payload)
+    rel = np.linalg.norm(out - latest) / np.linalg.norm(latest - base)
+    assert rel < 0.05  # bounded by the probe's contract
+
+
+def test_delta_lossy_sticky_disables_on_bad_fidelity():
+    # sign destroys magnitudes: rel error ~1 >> 0.05 — the probe must
+    # disable the lossy path and the encode fall back to exact
+    dc = DeltaCodec(TMPL, bucket_mb=0.0, codec="sign",
+                    max_rel_error=0.05, probe_every=1)
+    base = flat_of(0)
+    latest = base + np.random.RandomState(1).randn(N).astype(np.float32)
+    payload = dc.encode(base, latest)
+    assert not dc.lossy_ok and dc.lossy_fallbacks == 1
+    if payload is not None:  # exact path: bit-for-bit
+        out = dc.apply(base, payload)
+        assert np.array_equal(out.view(np.uint32), latest.view(np.uint32))
+
+
+# -- serving core (in-process) ----------------------------------------------
+
+def make_core(**cfg_extra):
+    cfg = {"serving": True, "serving_kw": dict(KW)}
+    cfg.update(cfg_extra)
+    return ServingCore(None, cfg, template=TMPL)
+
+
+def test_core_not_modified_delta_full_and_ageout():
+    core = make_core()
+    v1 = flat_of(0)
+    core.publish(flat=v1.copy())
+    kind, ver, _, payload, done = core.handle_read(have_version=0)
+    assert (kind, ver) == (0, 1) and payload.nbytes == N * 4  # full
+    done()
+    kind, ver, _, payload, _ = core.handle_read(have_version=1)
+    assert kind == 2 and payload is None  # not modified
+    v2 = v1.copy()
+    v2[7] += 1.0
+    core.publish(flat=v2.copy())
+    kind, ver, base, payload, _ = core.handle_read(have_version=1)
+    assert (kind, ver, base) == (1, 2, 1)  # delta
+    assert np.array_equal(
+        DeltaCodec.from_knobs(TMPL, KW).apply(v1, payload).view(np.uint32),
+        v2.view(np.uint32))
+    # coalesce: identical ask rides the cached encode
+    kind2, _, _, payload2, _ = core.handle_read(have_version=1)
+    assert kind2 == 1 and payload2 is payload
+    assert core.coalesce_hits == 1
+    # age version 1 out of the 4-deep ring -> full fallback, counted
+    for i in range(5):
+        bump = v2.copy()
+        bump[0] = float(i)
+        core.publish(flat=bump)
+    kind, ver, _, payload, done = core.handle_read(have_version=1)
+    assert kind == 0 and core.ring_ageouts == 1
+    done()
+    m = core.read_metrics()
+    assert m["reads_total"] == 5.0 and m["reads_not_modified"] == 1.0
+    assert m["delta_bytes_saved"] > 0
+    core.close()
+
+
+def test_core_publish_requires_arming_without_server():
+    core = ServingCore(None, {}, template=TMPL)
+    assert not core.armed
+    with pytest.raises(ValueError):
+        core.publish(flat=flat_of(0))
+
+
+def test_core_tenants_are_isolated():
+    core = make_core()
+    core.publish(flat=flat_of(0.5), tenant="job-a", template=TMPL)
+    core.publish(flat=flat_of(1.5), tenant="job-b", template=TMPL)
+    core.publish(flat=flat_of(2.5), tenant="job-b", template=TMPL)
+    ka, va, _, pa, da = core.handle_read(have_version=0, tenant="job-a")
+    kb, vb, _, pb, db = core.handle_read(have_version=0, tenant="job-b")
+    assert (va, vb) == (1, 2)
+    assert pa[0] == 0.5 and pb[0] == 2.5
+    da(), db()
+    kind, _, _, msg, _ = core.handle_read(have_version=0, tenant="nope")
+    assert kind == 4 and b"unknown tenant" in bytes(msg)
+    snap = core.serving_snapshot()
+    assert snap["tenants"]["job-a"]["reads"] == 1
+    assert snap["tenants"]["job-b"]["reads"] == 1
+    assert snap["tenants"]["job-b"]["latest"] == 2
+    core.close()
+
+
+def test_core_zero_copy_inprocess_fanout():
+    core = make_core()
+    core.publish(flat=flat_of(3.5))
+    snaps = [core.acquire_latest() for _ in range(4)]
+    base_addr = snaps[0].flat.__array_interface__["data"][0]
+    assert all(s.flat.__array_interface__["data"][0] == base_addr
+               for s in snaps)  # ONE buffer fanned out
+    assert core._stores[core.default_tenant].refs_out() == 4
+    for s in snaps:
+        core.release(s)
+    assert core._stores[core.default_tenant].refs_out() == 0
+    core.close()
+
+
+# -- network read tier -------------------------------------------------------
+
+def test_net_shed_then_retry_and_error_tenant():
+    cfg = {"read_port": 0,
+           "serving_kw": {**KW, "admission_depth": 1,
+                          "retry_after_s": 0.005}}
+    core = ServingCore(None, cfg, template=TMPL)
+    core.publish(flat=flat_of(0))
+    n = 16
+    readers = [ServingReader("127.0.0.1", core.read_port, TMPL,
+                             serving_kw=cfg["serving_kw"])
+               for _ in range(n)]
+    barrier = threading.Barrier(n)
+    errs = []
+
+    def body(r):
+        try:
+            barrier.wait()
+            r.read_params()
+        except Exception as e:  # pragma: no cover
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=body, args=(r,)) for r in readers]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errs
+    assert core.reads_shed > 0  # depth 1 under a 16-wide burst
+    assert sum(r.shed_retries for r in readers) > 0
+    assert all(r.version == 1 for r in readers)
+    with pytest.raises(RuntimeError, match="unknown tenant"):
+        ReadClient("127.0.0.1", core.read_port,
+                   tenant="ghost").request()
+    for r in readers:
+        r.close()
+    core.close()
+
+
+def test_net_reader_tracks_versions_delta_exact():
+    cfg = {"read_port": 0, "serving_kw": dict(KW)}
+    core = ServingCore(None, cfg, template=TMPL)
+    flats = [flat_of(0)]
+    core.publish(flat=flats[0].copy())
+    r = ServingReader("127.0.0.1", core.read_port, TMPL, serving_kw=KW)
+    r.read_params()
+    for i in range(1, 4):
+        nxt = flats[-1].copy()
+        nxt[i * 3] += 0.25
+        flats.append(nxt)
+        core.publish(flat=nxt.copy())
+        tree, ver = r.read_params()
+        assert ver == i + 1
+        assert np.array_equal(_flatten(tree).view(np.uint32),
+                              nxt.view(np.uint32))
+    assert r.delta_reads == 3 and r.full_reads == 1
+    r.close()
+    core.close()
+
+
+@pytest.mark.parametrize("transport", ["shm", "tcp"])
+def test_concurrent_readers_never_see_torn_state(transport):
+    """N reader threads hammering the read tier while publish() advances:
+    every read must be ONE version's bytes exactly — never a mix."""
+    tmpl = {"w": np.zeros((4096,), np.float32)}
+    pattern = np.arange(1, 4097, dtype=np.float32)
+    if transport == "tcp":
+        from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSServer
+
+        server = TcpPSServer(0, num_workers=1, template=tmpl)
+    else:
+        from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSServer
+
+        server = ShmPSServer(f"/psq_torn_{os.getpid()}_{transport}", 1,
+                             tmpl)
+    cfg = {"read_port": 0, "serving_kw": {"ring": 3,
+                                          "delta_bucket_mb": 0.01}}
+    core = ServingCore(server, cfg, monitors=False)
+    core.publish(flat=pattern * 1.0)
+    n_readers, n_versions = 6, 25
+    stop = threading.Event()
+    bad = []
+    counts = [0] * n_readers
+
+    def reader(i):
+        r = ServingReader("127.0.0.1", core.read_port, tmpl,
+                          serving_kw=cfg["serving_kw"])
+        while not stop.is_set():
+            tree, ver = r.read_params()
+            flat = _flatten(tree)
+            # internal consistency: EVERY element must belong to the
+            # same version (flat == ver * pattern elementwise)
+            if not np.array_equal(flat, pattern * float(ver)):
+                bad.append((i, ver))
+                break
+            counts[i] += 1
+        r.close()
+
+    ts = [threading.Thread(target=reader, args=(i,))
+          for i in range(n_readers)]
+    for t in ts:
+        t.start()
+    for v in range(2, n_versions + 1):
+        core.publish(flat=pattern * float(v))
+        time.sleep(0.005)
+    time.sleep(0.05)
+    stop.set()
+    for t in ts:
+        t.join(timeout=30)
+    server.close()
+    assert not bad, f"torn/mixed-version reads: {bad}"
+    assert sum(counts) > n_readers  # everyone actually read repeatedly
+    m = server.metrics() if hasattr(server, "metrics") else {}
+    assert m.get("reads_total", 0) >= sum(counts)
+
+
+def test_reader_subprocess_full_roundtrip():
+    """A reader in a SEPARATE PROCESS (the deployment shape) gets a
+    consistent tree over the wire."""
+    import subprocess
+    import sys
+
+    cfg = {"read_port": 0, "serving_kw": dict(KW)}
+    core = ServingCore(None, cfg, template=TMPL)
+    core.publish(flat=flat_of(4.5))
+    src = (
+        "import numpy as np, sys\n"
+        "from pytorch_ps_mpi_tpu.serving import ServingReader\n"
+        "tmpl = {'a': np.zeros((700, 4), np.float32),"
+        " 'b': np.zeros((13,), np.float32)}\n"
+        f"r = ServingReader('127.0.0.1', {core.read_port}, tmpl)\n"
+        "tree, ver = r.read_params()\n"
+        "assert ver == 1 and float(tree['a'][0, 0]) == 4.5\n"
+        "r.close()\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    rc = subprocess.run([sys.executable, "-c", src], env=env,
+                        timeout=120).returncode
+    core.close()
+    assert rc == 0
+
+
+# -- transport-native conditional reads (the satellite fix) ------------------
+
+def test_tcp_read_params_not_modified(tmp_path):
+    from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSServer, TcpPSWorker
+
+    tmpl = {"w": np.zeros((64,), np.float32)}
+    srv = TcpPSServer(0, num_workers=1, template=tmpl)
+    srv.publish({"w": np.arange(64, dtype=np.float32)})
+    done = threading.Event()
+    out = {}
+
+    def body():
+        w = TcpPSWorker("127.0.0.1", srv.port, 0, tmpl)
+        p1, v1 = w.read_params(timeout=20)
+        p1["w"][0] = -99.0  # callers may mutate returned params in place
+        p2, v2 = w.read_params(timeout=20)
+        out.update(v1=v1, v2=v2, fresh=p2 is not p1,
+                   clean=float(p2["w"][0]) == 0.0,
+                   nm=w.reads_not_modified, w=w)
+        done.set()
+
+    t = threading.Thread(target=body)
+    t.start()
+    while not done.is_set():  # the serve loop's role: pump the transport
+        srv.poll_grad()
+        time.sleep(0.002)
+    t.join()
+    assert out["v1"] == out["v2"] == 1
+    # the not-modified hit rebuilt a FRESH tree from the cached bytes —
+    # the earlier in-place mutation did not leak into it
+    assert out["fresh"] and out["clean"] and out["nm"] == 1
+    srv.poll_grad()  # refresh native stats
+    assert srv._native_read_stats == (2, 1)
+    m = srv.metrics()
+    assert m["reads_total"] == 2.0 and m["reads_not_modified"] == 1.0
+    out["w"].close()
+    srv.close()
+
+
+def test_shm_read_params_version_peek(tmp_path):
+    from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSServer, ShmPSWorker
+
+    tmpl = {"w": np.zeros((64,), np.float32)}
+    name = f"/psq_nm_{os.getpid()}"
+    srv = ShmPSServer(name, 1, tmpl)
+    srv.publish({"w": np.ones(64, np.float32)})
+    # opt-IN on shm (unlike TCP): a shm read is a local memcpy, so the
+    # default keeps the legacy always-copy pacing of training loops
+    w = ShmPSWorker(name, 0, tmpl, cached_reads=True)
+    a, va = w.read_params()
+    b, vb = w.read_params()
+    assert va == vb == 1 and b is a and w.reads_not_modified == 1
+    srv.publish({"w": np.ones(64, np.float32) * 2})
+    c, vc = w.read_params()
+    assert vc == 2 and float(c["w"][0]) == 2.0
+    # the default is the legacy always-copy behavior
+    w2 = ShmPSWorker(name, 0, tmpl)
+    x, _ = w2.read_params()
+    y, _ = w2.read_params()
+    assert y is not x and w2.reads_not_modified == 0
+    w.close()
+    w2.close()
+    srv.close()
+
+
+# -- surfaces: canonical schema, /health, ps_top -----------------------------
+
+def test_canonical_schema_includes_serving_keys_on_both_transports():
+    from pytorch_ps_mpi_tpu.telemetry import PS_SERVER_METRIC_KEYS
+
+    for key in ("reads_total", "read_p50_ms", "read_p95_ms",
+                "delta_bytes_saved", "reads_shed", "coalesce_hits",
+                "reads_not_modified"):
+        assert key in PS_SERVER_METRIC_KEYS
+
+
+def test_health_serving_section_and_scrape(tmp_path):
+    from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSServer
+    from pytorch_ps_mpi_tpu.telemetry.diagnosis import HealthMonitor
+
+    tmpl = {"w": np.zeros((32,), np.float32)}
+    srv = ShmPSServer(f"/psq_hs_{os.getpid()}", 1, tmpl)
+    core = ServingCore(srv, {"read_port": 0}, monitors=False)
+    mon = HealthMonitor(srv, {})
+    core.publish(flat=np.ones(32, np.float32))
+    kind, _, _, _, done = core.handle_read(have_version=0)
+    done()
+    doc = mon.snapshot()
+    assert doc["serving"]["reads_total"] == 1
+    assert doc["serving"]["tenants"]["default"]["occupancy"] == 1
+    # monitor-less /health still carries the serving section
+    srv.health_monitor = None
+    bare = json.loads(srv.health_json())
+    assert bare["armed"] is False and bare["serving"]["reads_total"] == 1
+    text = srv.prometheus_text()
+    assert "ps_reads_total 1" in text
+    assert "ps_serving_ring_occupancy 1" in text
+    assert "ps_native_reads_total 0" in text
+    srv.close()
+
+
+def test_ps_top_renders_serving_block_and_reads_sort():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from tools.ps_top import SORT_KEYS, render_table
+
+    health = {
+        "armed": True, "n_workers": 1, "uptime_s": 5.0,
+        "fleet": {"grads_received": 3, "stale_drops": 0,
+                  "staleness_p50": 0, "staleness_p95": 0,
+                  "staleness_p99": 0, "anomaly_total": 0, "rounds": 0},
+        "workers": [{
+            "worker": 0, "verdict": "ok", "cause": None, "done": False,
+            "grads": 3, "push_interarrival_s": {"ewma": 0.01, "p50": 0.01,
+                                                "p95": 0.02, "n": 3},
+            "staleness": {"ewma": 0.0, "last": 0}, "anomalies": 0,
+            "last_anomaly": None, "server_wait_ewma_s": None,
+            "compute_ewma_s": None, "wire_ewma_s": None,
+            "steps_beaconed": 0, "straggle_total_s": 0.0, "retries": 0,
+            "reconnects": 0, "frames_rejected": 0, "last_seen_age_s": 0.1,
+            "gating": {"rounds": 0, "seconds": 0.0}, "numerics": None,
+            "lineage": None,
+        }],
+        "serving": {
+            "reads_per_s": 123.4, "read_p50_ms": 0.5, "read_p95_ms": 2.0,
+            "reads_shed": 7, "coalesce_hits": 11, "reads_not_modified": 40,
+            "queue_depth": 2, "connections": 9,
+            "tenants": {
+                "default": {"reads": 10, "occupancy": 3, "ring": 8,
+                            "latest": 42, "refs_out": 0},
+                "job-b": {"reads": 90, "occupancy": 1, "ring": 8,
+                          "latest": 7, "refs_out": 1},
+            },
+        },
+    }
+    assert "reads" in SORT_KEYS
+    frame = render_table(health, sort="reads")
+    assert "serving  reads/s=123.4" in frame
+    assert "shed=7" in frame and "coalesce=11" in frame
+    # reads sort: the busier tenant renders first
+    assert frame.index("tenant job-b") < frame.index("tenant default")
+
+
+# -- serve() integration -----------------------------------------------------
+
+def test_serve_with_read_tier_armed_end_to_end(tmp_path):
+    """The trainer loop on ServingCore with the read tier armed: a live
+    reader mid-run gets internally consistent trees, and the returned
+    metrics carry the serving rollup + canonical read keys."""
+    from pytorch_ps_mpi_tpu.parallel import dcn
+    from pytorch_ps_mpi_tpu.parallel.async_train import (
+        join_workers,
+        make_problem,
+        serve,
+        spawn_worker,
+    )
+
+    cfg = {"model": "mlp", "model_kw": {"features": (16, 4)},
+           "in_shape": [8], "batch": 16, "seed": 0, "steps": 6,
+           "frame_check": True, "read_port": 0,
+           "serving_kw": {"ring": 4, "delta_bucket_mb": 0.25}}
+    _, params0, _, _ = make_problem(cfg)
+    name = f"/psq_srv_e2e_{os.getpid()}"
+    server = dcn.ShmPSServer(name, num_workers=1, template=params0,
+                             frame=True)
+    stats = {}
+
+    def reader_waiter():
+        for _ in range(400):
+            sc = getattr(server, "serving_core", None)
+            if sc is not None and sc.read_port is not None:
+                r = ServingReader("127.0.0.1", sc.read_port, params0,
+                                  serving_kw=cfg["serving_kw"])
+                for _ in range(10):
+                    tree, ver = r.read_params()
+                    assert ver >= 1
+                    time.sleep(0.03)
+                stats.update(reads=r.reads, ver=r.version)
+                r.close()
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=reader_waiter)
+    t.start()
+    procs = [spawn_worker(name, 0, cfg)]
+    params, m = serve(server, cfg, total_grads=0, total_received=6,
+                      timeout=180)
+    t.join(timeout=60)
+    assert join_workers(procs) == [0]
+    server.close()
+    assert stats.get("reads") == 10
+    assert m["serving"]["reads_total"] >= 10
+    assert m["read_port"] > 0
+    for key in ("reads_total", "read_p50_ms", "reads_shed",
+                "coalesce_hits", "delta_bytes_saved"):
+        assert key in m
+    # publishes landed in the ring: 6 applied + initial publish
+    assert m["serving"]["tenants"]["default"]["latest"] == 7

@@ -21,11 +21,18 @@ Usage::
   python tools/ps_top.py 9100 --interval 0.5          # localhost port
   python tools/ps_top.py 9100 --once                  # one frame, no tty
 
+When the parameter-serving read tier is armed the frame grows a
+``serving`` block: a reader rollup line (reads/s, read p50/p95, shed,
+coalesce hits, queue depth) and one row per tenant namespace (ring
+occupancy, latest version, read count) — the ``reads`` sort key orders
+the tenant rows by read count.
+
 Keybindings (when stdin is a tty): ``q`` quit · ``p`` pause/resume ·
 ``s`` cycle the sort column (worker → verdict → interarrival → e2e →
-gating → numerics) · ``n`` jump straight to the numerics sort (NaN
-count, then grad norm) · ``e`` jump to the exact-e2e-latency sort ·
-``r`` force an immediate refresh.
+gating → numerics → reads) · ``n`` jump straight to the numerics sort
+(NaN count, then grad norm) · ``e`` jump to the exact-e2e-latency
+sort · ``d`` jump to the reads sort · ``r`` force an immediate
+refresh.
 """
 
 from __future__ import annotations
@@ -38,7 +45,7 @@ import urllib.request
 from typing import Any, Dict, List, Optional
 
 SORT_KEYS = ("worker", "verdict", "interarrival", "e2e", "gating",
-             "numerics")
+             "numerics", "reads")
 
 _VERDICT_ORDER = {"quarantined": 0, "missing": 1, "churning": 2, "slow": 3,
                   "ok": 4}
@@ -91,6 +98,29 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
         f"rounds={fleet.get('rounds', 0)}  "
         f"up={health.get('uptime_s', 0):.0f}s"
     )
+    serving = health.get("serving")
+    if serving:
+        # reader rollup: the read tier's load/latency/shed picture
+        lines.append(
+            f"serving  reads/s={serving.get('reads_per_s', 0):.1f}  "
+            f"read p50/p95={serving.get('read_p50_ms', 0):.2f}/"
+            f"{serving.get('read_p95_ms', 0):.2f}ms  "
+            f"shed={serving.get('reads_shed', 0)}  "
+            f"coalesce={serving.get('coalesce_hits', 0)}  "
+            f"nm={serving.get('reads_not_modified', 0)}  "
+            f"q={serving.get('queue_depth', 0)}  "
+            f"conns={serving.get('connections', 0)}"
+        )
+        tenants = list((serving.get("tenants") or {}).items())
+        if sort == "reads":
+            tenants.sort(key=lambda kv: -int(kv[1].get("reads", 0)))
+        for tname, t in tenants:
+            lines.append(
+                f"  tenant {tname}: reads={t.get('reads', 0)}  "
+                f"ring={t.get('occupancy', 0)}/{t.get('ring', 0)}  "
+                f"latest=v{t.get('latest', 0)}  "
+                f"refs_out={t.get('refs_out', 0)}"
+            )
     cols = ["wk", "verdict", "cause", "grads", "inter-ewma", "inter-p95",
             "stale-ewma", "stale-x", "e2e-ms", "gnorm", "nan", "relerr",
             "anom", "gate-rounds", "gate-s", "retry", "reconn", "rej",
@@ -164,7 +194,7 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
             line = _COLOR[w["verdict"]] + line + _RESET
         lines.append(line)
     lines.append(f"[sort: {sort}]  q quit · p pause · s sort · "
-                 "n numerics · e e2e · r refresh")
+                 "n numerics · e e2e · d reads · r refresh")
     return "\n".join(lines)
 
 
@@ -254,6 +284,9 @@ def main(argv=None) -> int:
                     break
                 if k == "e":
                     sort_i = SORT_KEYS.index("e2e")
+                    break
+                if k == "d":
+                    sort_i = SORT_KEYS.index("reads")
                     break
                 if k == "r":
                     break
