@@ -10,6 +10,7 @@ test:
 	python -m pytest tests/ -q
 	$(MAKE) trace-smoke
 	$(MAKE) read-smoke
+	$(MAKE) agg-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -88,6 +89,28 @@ read-smoke:
 	JAX_PLATFORMS=cpu python tools/read_smoke.py
 	python tools/telemetry_smoke.py
 
+# Homomorphic-aggregation gate (in the default `make test` path): a
+# 2-process shm sync-barrier run over the top-k wire must fold every
+# push into the compressed accumulator and decode exactly ONCE per
+# published version (decodes_per_publish == 1 in metrics AND /health),
+# the wire aggregate must equal decode-sum for the exact algebra,
+# agg=off must really keep the legacy path, and agg_bench --quick's
+# per-push cost gates must hold (sparse fold flat in model size,
+# integer per-push accumulate beating a per-push decode). Appends a
+# bench_gate trajectory row to benchmarks/results/agg_smoke.jsonl.
+agg-smoke:
+	JAX_PLATFORMS=cpu python tools/agg_smoke.py
+
+# Full per-push server-cost bench over 1x/8x models (the agg-smoke
+# quick gates at measurement scale); rows + a bench_gate-gated
+# trajectory in benchmarks/results/agg_bench.jsonl.
+agg-bench:
+	JAX_PLATFORMS=cpu python benchmarks/agg_bench.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/agg_bench.jsonl \
+		--metric 'agg_bench.sparse_flat_ratio:lower:0.5' \
+		--metric 'agg_bench.int_speedup_min_x:higher:0.5'
+
 # Read-tier load bench: open-loop fleet of simulated readers — delta
 # bytes economics (>=5x reduction gate), saturation sweep with bounded
 # served p99 past the admission limit. Full scale; `--quick` inside
@@ -122,4 +145,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench

@@ -1,0 +1,251 @@
+"""agg_bench: per-push server cost of homomorphic aggregation.
+
+Measures what the serve loop actually pays per arriving push, on real
+``CodecWire`` payload bytes, for both server-side disciplines:
+
+- **decode-sum** (the pre-aggregation path): jitted decode of every
+  push into a full f32 tree + tree-add — cost scales with the DECODED
+  model size whatever the codec compressed the wire to;
+- **aggregate** (``Codec.aggregate`` via ``WireAggregator``): each push
+  folds into a compressed accumulator (host numpy, no jit dispatch, no
+  tree rebuild) and ONE decode runs per round — cost scales with the
+  PAYLOAD.
+
+The bench runs each codec over a 1× and an 8× model (element count) and
+asserts the headline claims:
+
+- sparse codecs at fixed k (top-k / random-k): per-push aggregate cost
+  is FLAT in model size (≤1.2× between 1× and 8×) — the payload does
+  not grow, so neither does the fold;
+- integer codecs (int8 / qsgd): the payload grows with the model, so
+  absolute flatness is unavailable; the gate is RELATIVE — the per-push
+  accumulate (the fold alone, what the serve loop pays per arrival;
+  the finalize is the round's one decode, paid per publish) must beat
+  a per-push decode. The full-round speedup (finalize included) is
+  reported but not gated: at world=4 it amortizes a quarter of an O(n)
+  decode into every push and sits at noise-level parity on CPU.
+
+Run: ``python benchmarks/agg_bench.py [--quick]``. Appends one row per
+(codec, size, path) to ``benchmarks/results/agg_bench.jsonl`` plus a
+summary row ``bench="agg_bench"`` for ``bench_gate --trajectory``
+(wired as ``make agg-bench``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+RESULTS_DIR = os.path.join("benchmarks", "results")
+TRAJECTORY = os.path.join(RESULTS_DIR, "agg_bench.jsonl")
+
+WORLD = 4  # pushes per aggregation round
+
+
+def make_template(n_elems: int) -> dict:
+    """A few-leaf tree totalling ``n_elems`` (mixed leaf sizes, like a
+    small model tower rather than one flat blob)."""
+    big = int(n_elems * 0.75)
+    mid = int(n_elems * 0.2)
+    small = n_elems - big - mid
+    return {
+        "dense": np.zeros((big // 128, 128), np.float32),
+        "proj": np.zeros((mid,), np.float32),
+        "bias": np.zeros((small,), np.float32),
+    }
+
+
+def timed(fn, rounds: int, repeats: int = 5, best: bool = False) -> float:
+    """Wall seconds per execution of fn: median-of-repeats by default,
+    min-of-repeats (``best=True``) for the µs-scale fold timings where
+    scheduler noise dominates the median."""
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            fn()
+        samples.append((time.perf_counter() - t0) / rounds)
+    return float(np.min(samples) if best else np.median(samples))
+
+
+def bench_codec(name: str, kw: dict, n_elems: int, rounds: int) -> dict:
+    import jax
+
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.parallel.dcn import CodecWire
+
+    template = make_template(n_elems)
+    wire = CodecWire(get_codec(name, **kw), template, seed=0)
+    assert wire.agg_supported, name
+    rng = np.random.RandomState(0)
+    grads = [
+        jax.tree.map(
+            lambda x: rng.randn(*x.shape).astype(np.float32), template)
+        for _ in range(WORLD)
+    ]
+    bufs = [np.copy(wire.encode_to_bytes(g)) for g in grads]
+
+    # warmup both paths (jit compiles, accumulator allocation)
+    for b in bufs:
+        wire.decode_from_bytes(b)
+    agg = wire.agg_begin()
+    for b in bufs:
+        agg.fold(b)
+    agg.finalize()
+
+    def decode_round():
+        ref = None
+        for b in bufs:
+            d = wire.decode_from_bytes(b)
+            ref = d if ref is None else jax.tree.map(np.add, ref, d)
+        return ref
+
+    def agg_round():
+        a = wire.agg_begin()
+        for b in bufs:
+            a.fold(b)
+        return a.finalize()
+
+    def fold_round():
+        a = wire.agg_begin()
+        for b in bufs:
+            a.fold(b)
+        return a
+
+    t_decode = timed(decode_round, rounds) / WORLD   # per push
+    t_agg = timed(agg_round, rounds) / WORLD         # per push, finalize incl.
+    # the per-push ACCUMULATE cost (what scales with arrival rate): the
+    # fold alone — the finalize is the round's ONE decode, paid once per
+    # published version however many pushes composed it (and necessarily
+    # O(n): its output IS the dense gradient)
+    t_fold = timed(fold_round, rounds * 4, repeats=7, best=True) / WORLD
+    payload_mb = wire.wire_bytes / (1 << 20)
+    return {
+        "codec": name, "codec_kw": kw, "n_elems": n_elems,
+        "world": WORLD, "payload_bytes": wire.wire_bytes,
+        "decode_per_push_ms": round(t_decode * 1e3, 4),
+        "agg_per_push_ms": round(t_agg * 1e3, 4),
+        "fold_per_push_ms": round(t_fold * 1e3, 4),
+        "agg_per_payload_mb_ms": round(t_agg * 1e3 / max(payload_mb, 1e-9),
+                                       4),
+        "speedup_x": round(t_decode / max(t_agg, 1e-12), 2),
+        "decodes_per_publish_agg": 1,
+        "decodes_per_publish_decode_sum": WORLD,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="smaller models / fewer rounds (CI smoke scale)")
+    args = ap.parse_args(argv)
+
+    base = 128_000 if args.quick else 1_000_000
+    rounds = 10 if args.quick else 30
+    sizes = {"1x": base, "8x": 8 * base}
+    k = 2048
+    codecs = [
+        ("topk", {"k": k}, "sparse"),
+        ("randomk", {"k": k}, "sparse"),
+        ("int8", {}, "integer"),
+        ("qsgd", {"levels": 16}, "integer"),
+    ]
+
+    os.makedirs(RESULTS_DIR, exist_ok=True)
+    stamp = time.strftime("%Y-%m-%d")
+    artifact = os.path.join(RESULTS_DIR, f"agg_bench_{stamp}.jsonl")
+    rows = {}
+    with open(artifact, "a") as f:
+        for name, kw, family in codecs:
+            for label, n in sizes.items():
+                row = bench_codec(name, kw, n, rounds)
+                row.update({"bench": "agg_bench_row", "size": label,
+                            "family": family, "quick": bool(args.quick),
+                            "backend": "cpu", "t": time.time()})
+                rows[(name, label)] = row
+                print(json.dumps(row), flush=True)
+                f.write(json.dumps(row) + "\n")
+
+    # -- gates -------------------------------------------------------------
+    # flat-cost threshold: 1.2x at measurement scale; 1.5x under --quick,
+    # where the fold sits at tens of µs and CI scheduler noise alone
+    # moves the ratio ±30%
+    flat_max = 1.5 if args.quick else 1.2
+    failures = []
+    sparse_ratios = []
+    int_speedups = []
+    int_fold_wins = []
+    for name, kw, family in codecs:
+        r1, r8 = rows[(name, "1x")], rows[(name, "8x")]
+        if family == "sparse":
+            # fixed-k payload: per-push ACCUMULATE (fold) cost flat in
+            # model size — the payload doesn't grow, so neither may the
+            # per-arrival work
+            ratio = r8["fold_per_push_ms"] / max(r1["fold_per_push_ms"],
+                                                 1e-9)
+            sparse_ratios.append(ratio)
+            print(f"{name}: fold per-push 1x={r1['fold_per_push_ms']}ms "
+                  f"8x={r8['fold_per_push_ms']}ms ratio={ratio:.2f}")
+            if ratio > flat_max:
+                failures.append(
+                    f"{name}: per-push accumulate cost not flat "
+                    f"({ratio:.2f}x between 1x and 8x model, "
+                    f"gate {flat_max}x)")
+        else:
+            # dense integer payload grows with the model: gate the
+            # per-push ACCUMULATE (fold) against a per-push decode —
+            # the serve loop pays the fold per arrival and the finalize
+            # once per publish, so that is the cost that must win.
+            # Under --quick the 1x model is 128k elements, where the
+            # fold's jit dispatch (~0.1 ms) is the whole budget and the
+            # ratio is noise — report it, gate only the 8x size there
+            # (full scale gates both). The full-round speedup_x
+            # (finalize included) is reported for the table, never
+            # gated: it hovers at parity on CPU within timer noise.
+            for r in (r1, r8):
+                gated = not (args.quick and r is r1)
+                fold_win = (r["decode_per_push_ms"]
+                            / max(r["fold_per_push_ms"], 1e-9))
+                if gated:
+                    int_speedups.append(r["speedup_x"])
+                    int_fold_wins.append(round(fold_win, 2))
+                print(f"{name}@{r['size']}: decode "
+                      f"{r['decode_per_push_ms']}ms vs fold "
+                      f"{r['fold_per_push_ms']}ms ({fold_win:.2f}x), "
+                      f"full-round agg {r['agg_per_push_ms']}ms "
+                      f"({r['speedup_x']}x)"
+                      + ("" if gated else " [reported, not gated]"))
+                if gated and fold_win < 1.0:
+                    failures.append(
+                        f"{name}@{r['size']}: per-push accumulate "
+                        f"slower than a per-push decode "
+                        f"({fold_win:.2f}x)")
+    if failures:
+        for msg in failures:
+            print(f"FAIL: {msg}")
+        return 1
+
+    summary = {
+        "bench": "agg_bench", "t": time.time(),
+        "sparse_flat_ratio": round(max(sparse_ratios), 3),
+        "int_speedup_min_x": round(min(int_speedups), 2),
+        "int_fold_win_min_x": round(min(int_fold_wins), 2),
+        "topk_agg_per_push_ms": rows[("topk", "8x")]["agg_per_push_ms"],
+        "int8_agg_per_push_ms": rows[("int8", "8x")]["agg_per_push_ms"],
+        "quick": bool(args.quick),
+    }
+    with open(TRAJECTORY, "a") as f:
+        f.write(json.dumps(summary) + "\n")
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

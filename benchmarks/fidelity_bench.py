@@ -77,6 +77,67 @@ def tree_fidelity(code, grads, seed: int = 0) -> dict:
     }
 
 
+#: aggregation-mode probes: codecs with a compressed-domain algebra,
+#: measured as aggregate-vs-decode-sum rel error across worker counts —
+#: ~0 for the exact algebras (the committed sanity anchor), a real
+#: number for the approximate sign vote (its fidelity CONTRACT: the
+#: serve loop ships the vote algebra only because this table bounds it)
+AGG_CODECS = [
+    ("int8", {}),
+    ("qsgd", {}),
+    ("terngrad", {}),
+    ("topk", {"fraction": 0.01}),
+    ("randomk", {"fraction": 0.01}),
+    ("powersgd", {"rank": 2}),
+    ("sign", {"use_pallas": False}),
+]
+AGG_WORLDS = (2, 4, 8)
+
+
+def aggregate_fidelity(code, grads, world: int, seed: int = 0) -> dict:
+    """Aggregate-vs-decode-sum relative L2 error over the whole tree.
+
+    Worker payloads derive from the shared backprop gradient with a
+    per-worker magnitude factor (``u_w ~ U[0.5, 1.5]``, so per-frame
+    statistics — sign's mean|g|, int8's absmax — genuinely differ) AND
+    additive minibatch-style noise at half the gradient's RMS (so
+    workers genuinely DISAGREE on signs — a multiplicative factor alone
+    leaves every sign bit identical, which the vote algebra handles
+    exactly and would report a misleading 0). This is the regime that
+    separates the exact algebras (error stays 0) from the sign vote
+    approximation (mean-scale substitution, the number this table
+    commits)."""
+    err2 = ref2 = 0.0
+    key = jax.random.key(seed)
+    for i, g in enumerate(jax.tree.leaves(grads)):
+        payloads = []
+        state = code.init_state(g.shape, g.dtype)
+        sigma = 0.5 * jnp.sqrt(jnp.mean(g.astype(jnp.float32) ** 2))
+        for w in range(world):
+            kw_ = jax.random.fold_in(jax.random.fold_in(key, i), w)
+            scale = jax.random.uniform(kw_, (), minval=0.5, maxval=1.5)
+            noise = sigma * jax.random.normal(
+                jax.random.fold_in(kw_, 2), g.shape, jnp.float32)
+            g_w = (g.astype(jnp.float32) + noise) * scale
+            rng = jax.random.fold_in(kw_, 1) if code.needs_rng else None
+            p, state = code.encode(g_w.astype(g.dtype), state, rng)
+            payloads.append(p)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *payloads)
+        ref = np.asarray(
+            code.decode_sum(stacked, g.shape, g.dtype), np.float64)
+        agg_payload, meta = code.aggregate(stacked, g.shape, g.dtype)
+        out = np.asarray(
+            code.agg_decode(agg_payload, meta, g.shape, g.dtype),
+            np.float64)
+        err2 += float(np.sum((out - ref) ** 2))
+        ref2 += float(np.sum(ref * ref))
+    return {
+        "world": world,
+        "rel_error": (err2 / max(ref2, 1e-300)) ** 0.5,
+        "exact": bool(code.agg_exact),
+    }
+
+
 def resnet18_grads(batch: int = 8):
     from pytorch_ps_mpi_tpu.models import ResNet18
 
@@ -117,6 +178,10 @@ def main(argv=None) -> int:
     ap.add_argument("--models", default="resnet18,bert")
     ap.add_argument("--bert-config", default="base",
                     choices=["base", "tiny"])
+    ap.add_argument("--aggregate", action="store_true",
+                    help="also probe aggregate-vs-decode-sum fidelity "
+                         "across worker counts (rows bench=agg_fidelity "
+                         "into fidelity_agg_<model>.jsonl)")
     args = ap.parse_args(argv)
     os.makedirs("benchmarks/results", exist_ok=True)
     for model in args.models.split(","):
@@ -136,6 +201,18 @@ def main(argv=None) -> int:
                 row.update(tree_fidelity(get_codec(name, **kw), grads))
                 print(json.dumps(row), flush=True)
                 f.write(json.dumps(row) + "\n")
+        if args.aggregate:
+            agg_out = f"benchmarks/results/fidelity_agg_{label}.jsonl"
+            with open(agg_out, "a") as f:
+                for name, kw in AGG_CODECS:
+                    code = get_codec(name, **kw)
+                    for world in AGG_WORLDS:
+                        row = {"bench": "agg_fidelity", "model": label,
+                               "codec": name, "codec_kw": kw,
+                               "backend": jax.default_backend()}
+                        row.update(aggregate_fidelity(code, grads, world))
+                        print(json.dumps(row), flush=True)
+                        f.write(json.dumps(row) + "\n")
     return 0
 
 

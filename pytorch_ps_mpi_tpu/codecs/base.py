@@ -14,9 +14,23 @@ SURVEY §2.2):
   ``ps.py:166``).
 - ``decode_sum(payloads, shape, dtype) -> grad`` — decode a stacked
   ``[world, ...]`` payload batch and sum over ranks in one shot (the
-  reference's ``sum(grads)`` loop, ``ps.py:176``); the default is
-  vmap(decode).sum(0) and codecs override it when a fused form exists
-  (e.g. top-k scatter-add).
+  reference's ``sum(grads)`` loop, ``ps.py:176``); the default is a
+  ``lax.scan`` fold (peak memory = ONE decoded tensor + the accumulator,
+  never a ``[world, ...]`` decoded stack) and codecs override it when a
+  fused form exists (e.g. top-k scatter-add).
+- ``aggregate(payloads, shape, dtype) -> (agg_payload, meta)`` /
+  ``agg_decode(agg_payload, meta, shape, dtype) -> grad`` — homomorphic
+  aggregation (THC / SparCML, PAPERS.md): sum a stacked payload batch in
+  the COMPRESSED domain, then decode ONCE. ``agg_payload`` is sized by
+  the payloads, never by a ``[world, decoded]`` stack; codecs without an
+  exact or probe-certified algebra leave ``supports_aggregate`` False
+  and every consumer falls back to ``decode_sum`` automatically.
+- ``agg_init(shape, dtype)`` / ``agg_fold(acc, payload)`` /
+  ``agg_finalize(acc, shape, dtype)`` — the STREAMING (host-side, numpy)
+  form of the same algebra, used by the async serve loop's
+  ``CodecWire`` aggregator: each arriving push folds into a compressed
+  accumulator and the one decode happens at publish time
+  (``decodes_per_publish == 1``).
 - ``init_state(shape, dtype)`` — per-leaf codec state (e.g. error-feedback
   memory); ``()`` for stateless codecs. Explicit state threading replaces
   the reference's mutable ``code.codes`` side channel (``ps.py:165``).
@@ -117,6 +131,19 @@ class Codec:
     #: narrowed wire. ``encode``/``decode_sum`` remain the payload form
     #: for wires with no synchronous collective (async/DCN/host PS).
     supports_fused_allreduce: bool = False
+    #: codecs whose payload algebra allows compressed-domain aggregation
+    #: set this and implement ``aggregate``/``agg_decode`` (+ optionally
+    #: the streaming ``agg_init``/``agg_fold``/``agg_finalize`` overrides
+    #: when an O(payload) accumulator exists). False means every consumer
+    #: (ps.aggregate, the CodecWire serve-loop aggregator) falls back to
+    #: decode_sum — the always-correct path.
+    supports_aggregate: bool = False
+    #: True when ``aggregate`` is bit-identical to ``decode_sum`` (the
+    #: integer/sparse algebras); False for probe-certified approximations
+    #: (sign's vote-count algebra), which the SPMD training path never
+    #: uses implicitly and the host wire ships behind the measured
+    #: fidelity contract in ``benchmarks/fidelity_bench.py --aggregate``.
+    agg_exact: bool = True
 
     def init_state(self, shape: Tuple[int, ...], dtype) -> PyTree:
         return ()
@@ -129,9 +156,72 @@ class Codec:
         raise NotImplementedError
 
     def decode_sum(self, payloads: PyTree, shape: Tuple[int, ...], dtype) -> jax.Array:
-        """Decode a [world, ...]-stacked payload pytree, summed over ranks."""
-        decoded = jax.vmap(lambda p: self.decode(p, shape, dtype))(payloads)
-        return decoded.sum(axis=0)
+        """Decode a [world, ...]-stacked payload pytree, summed over ranks.
+
+        Default: a ``lax.scan`` fold — one rank decoded per step into a
+        running accumulator, so peak memory is ONE decoded tensor plus
+        the accumulator instead of the ``[world, ...]`` decoded stack the
+        old vmap-then-sum form materialized (at BERT scale × 8 workers
+        that stack was a ~4 GB cliff). Order note: the fold accumulates
+        ranks sequentially (bit-exact to the left-fold definition,
+        ``tests/test_agg.py``); XLA's axis-0 reduce used a tree order,
+        so the two forms agree to 1 ulp per element, not bitwise, for
+        world > 2."""
+        def body(acc, p):
+            return acc + self.decode(p, shape, dtype).astype(acc.dtype), None
+
+        summed, _ = jax.lax.scan(body, jnp.zeros(shape, dtype), payloads)
+        return summed
+
+    # -- homomorphic aggregation (THC / SparCML; see module docstring) ----
+    def can_aggregate(self, shape: Tuple[int, ...], dtype) -> bool:
+        """Per-unit refinement of ``supports_aggregate``: a codec may
+        support the algebra in general but not for a particular wire
+        unit (sign's Pallas bit layout has no host-side unpack). The
+        CodecWire aggregator checks every unit and falls back to
+        decode_sum wholesale when any says no."""
+        return self.supports_aggregate
+
+    def aggregate(self, payloads: PyTree, shape: Tuple[int, ...], dtype
+                  ) -> Tuple[PyTree, Dict[str, Any]]:
+        """Compressed-domain sum of a [world, ...]-stacked payload batch:
+        returns ``(agg_payload, meta)`` where ``agg_payload`` is sized by
+        the payloads (sparse index-merge, widened integer counts, summed
+        low-rank factors) and one :meth:`agg_decode` call yields the
+        summed gradient. jnp ops only — runs under jit/shard_map."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no compressed-domain aggregation "
+            "algebra (supports_aggregate=False); use decode_sum"
+        )
+
+    def agg_decode(self, agg_payload: PyTree, meta: Dict[str, Any],
+                   shape: Tuple[int, ...], dtype) -> jax.Array:
+        """The ONE decode of an aggregated payload → summed gradient."""
+        raise NotImplementedError
+
+    # -- streaming form (host-side numpy; the serve-loop accumulator) -----
+    def agg_init(self, shape: Tuple[int, ...], dtype) -> Dict[str, Any]:
+        """Fresh streaming accumulator for one wire unit. The default
+        keeps the raw payloads (payload-sized memory — for sparse codecs
+        this IS the index-merge accumulator) and defers the algebra to
+        :meth:`aggregate` at finalize; codecs with an O(1)-frames
+        accumulator (int8's scale-folded sum, sign's vote counts)
+        override all three methods."""
+        return {"frames": 0, "payloads": []}
+
+    def agg_fold(self, acc: Dict[str, Any], payload: PyTree) -> None:
+        """Fold ONE worker's payload (numpy array views into the receive
+        buffer — anything retained must be copied) into ``acc``."""
+        acc["payloads"].append(jax.tree.map(np.copy, payload))
+        acc["frames"] += 1
+
+    def agg_finalize(self, acc: Dict[str, Any], shape: Tuple[int, ...],
+                     dtype):
+        """One decode of the accumulated state → summed gradient (numpy
+        or jax array, ``shape``-shaped)."""
+        stacked = jax.tree.map(lambda *xs: np.stack(xs), *acc["payloads"])
+        agg, meta = self.aggregate(stacked, shape, dtype)
+        return self.agg_decode(agg, meta, shape, dtype)
 
     def payload_bits(self, shape: Tuple[int, ...], dtype) -> int:
         """Encoded wire size in bits per gradient (for metrics)."""
@@ -178,6 +268,69 @@ class Codec:
             "bits_per_param": self.payload_bits(grad.shape, grad.dtype) / n,
             "grad_norm": float(gn),
         }
+
+
+# -- shared streaming accumulator for the sparse index-merge family --------
+# (top-k / block-top-k / random-k / threshold): the accumulator IS the
+# concatenated (values, indices) list — O(payload) per fold, and the one
+# finalize scatter-adds world×k entries into the dense gradient. Pure
+# numpy: the serve loop's per-push cost carries no jit dispatch.
+
+def sparse_agg_init() -> Dict[str, Any]:
+    return {"frames": 0, "values": [], "indices": []}
+
+
+def sparse_agg_fold(acc: Dict[str, Any], values, indices) -> None:
+    acc["values"].append(np.array(values, np.float32,
+                                  copy=True).reshape(-1))
+    acc["indices"].append(np.array(indices, copy=True).reshape(-1))
+    acc["frames"] += 1
+
+
+def sparse_agg_finalize(acc: Dict[str, Any], shape, dtype) -> np.ndarray:
+    n = int(np.prod(shape)) if shape else 1
+    idx = np.concatenate(acc["indices"]).astype(np.int64)
+    val = np.concatenate(acc["values"])
+    keep = (idx >= 0) & (idx < n)  # mode='drop' for block pad-slot picks
+    flat = np.zeros(n, np.float32)
+    np.add.at(flat, idx[keep], val[keep])
+    return flat.astype(dtype, copy=False).reshape(shape)
+
+
+# -- shared streaming accumulator for the dense cast-up family -------------
+# (identity / bf16 / f16): ONE running f32 array per unit; each fold
+# casts its frame up and adds in place, mirroring decode_sum's
+# cast-before-sum rule. The per-frame cast stays with the codec.
+
+def dense_agg_init(shape) -> Dict[str, Any]:
+    n = int(np.prod(shape)) if shape else 1
+    return {"frames": 0, "acc": np.zeros(n, np.float32)}
+
+
+def dense_agg_finalize(acc: Dict[str, Any], shape, dtype) -> np.ndarray:
+    # np.asarray: the accumulator may be a jax array (scale-fold jit path)
+    return np.asarray(acc["acc"]).astype(dtype, copy=False).reshape(shape)
+
+
+# -- shared streaming accumulator for the scale-folded integer family ------
+# (int8 / qsgd / terngrad: decode is scale × integer payload). ONE f32
+# accumulator per unit with a dual fold path: units at or above the
+# crossover fold through the codec's jitted fused kernel (one SIMD
+# dequant-multiply-add pass — numpy's multiply-into-temp + add pays ~3x
+# the memory traffic there); smaller units keep pure numpy, where a jit
+# dispatch would dominate. The per-codec fused kernel stays with the
+# codec; finalize is dense_agg_finalize.
+
+FOLD_JIT_MIN = 1 << 16
+
+
+def scalefold_agg_init(shape) -> Dict[str, Any]:
+    n = int(np.prod(shape)) if shape else 1
+    if n >= FOLD_JIT_MIN:
+        return {"frames": 0, "acc": jnp.zeros(n, jnp.float32), "n": n,
+                "jit": True}
+    return {"frames": 0, "acc": np.zeros(n, np.float32),
+            "tmp": np.empty(n, np.float32), "n": n}
 
 
 _REGISTRY: Dict[str, Type[Codec]] = {}

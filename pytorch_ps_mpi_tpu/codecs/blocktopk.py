@@ -149,11 +149,30 @@ class BlockTopK8Codec(BlockTopKCodec):
         )
 
     def decode_sum(self, payloads, shape, dtype):
-        return super().decode_sum(
+        # via aggregate (which dequantizes): decode_sum(raw int8 payload)
+        # and the compressed-domain path are one code path
+        agg, meta = self.aggregate(payloads, shape, dtype)
+        return self.agg_decode(agg, meta, shape, dtype)
+
+    def aggregate(self, payloads, shape, dtype):
+        # dequantize per rank (payload-sized), then the inherited sparse
+        # index-merge — identical values/order to decode_sum (bit-exact)
+        return super().aggregate(
             {"values": self._dequant(payloads, dtype),
              "indices": payloads["indices"]},
             shape, dtype,
         )
+
+    def agg_fold(self, acc, payload):
+        # numpy dequant of the int8 survivors (per-block scale), then
+        # the shared sparse concat fold
+        from pytorch_ps_mpi_tpu.codecs.base import sparse_agg_fold
+
+        q = np.asarray(payload["values"])
+        scale = np.asarray(payload["scale"], np.float32)
+        val = (q.reshape(scale.shape[0], -1).astype(np.float32)
+               * scale).reshape(-1)
+        sparse_agg_fold(acc, val, payload["indices"])
 
     def payload_bits(self, shape, dtype):
         n = int(np.prod(shape)) if shape else 1

@@ -21,7 +21,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    dense_agg_finalize,
+    dense_agg_init,
+    register_codec,
+)
 
 
 @register_codec("bf16")
@@ -30,6 +35,9 @@ class Bf16Codec(Codec):
     # a cast is elementwise: casting one flat bucket == casting each leaf
     # (bit-exact), so bucketed aggregation is lossless relative to per-leaf
     bucketable = True
+    # exact: aggregation is the same cast-up-then-sum decode_sum runs;
+    # the streaming accumulator is one f32 array per unit
+    supports_aggregate = True
 
     wire_dtype = jnp.bfloat16
 
@@ -43,6 +51,27 @@ class Bf16Codec(Codec):
         # cast up BEFORE the sum: world-many bf16 addends would lose
         # low bits pairwise; f32 accumulation matches psum's behavior
         return payloads.astype(dtype).sum(axis=0).reshape(shape)
+
+    def aggregate(self, payloads, shape, dtype):
+        # same cast-up-before-sum as decode_sum (bit-exact)
+        return (payloads.astype(dtype).sum(axis=0),
+                {"frames": int(payloads.shape[0])})
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
+        return agg_payload.astype(dtype).reshape(shape)
+
+    def agg_init(self, shape, dtype):
+        return dense_agg_init(shape)
+
+    def agg_fold(self, acc, payload):
+        # cast up per frame (ml_dtypes handles the bf16/f16 view), then
+        # accumulate in f32 — the streaming mirror of decode_sum's
+        # cast-before-sum rule
+        acc["acc"] += np.asarray(payload).reshape(-1).astype(np.float32)
+        acc["frames"] += 1
+
+    def agg_finalize(self, acc, shape, dtype):
+        return dense_agg_finalize(acc, shape, dtype)
 
     def payload_bits(self, shape, dtype):
         n = int(np.prod(shape)) if shape else 1

@@ -39,6 +39,34 @@ class ErrorFeedback(Codec):
     def decode_sum(self, payloads, shape, dtype):
         return self.inner.decode_sum(payloads, shape, dtype)
 
+    # -- aggregation delegates to the inner codec: EF state lives on the
+    # -- worker (encode side); the receive-side algebra is the inner's
+    @property
+    def supports_aggregate(self):
+        return self.inner.supports_aggregate
+
+    @property
+    def agg_exact(self):
+        return self.inner.agg_exact
+
+    def can_aggregate(self, shape, dtype):
+        return self.inner.can_aggregate(shape, dtype)
+
+    def aggregate(self, payloads, shape, dtype):
+        return self.inner.aggregate(payloads, shape, dtype)
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
+        return self.inner.agg_decode(agg_payload, meta, shape, dtype)
+
+    def agg_init(self, shape, dtype):
+        return self.inner.agg_init(shape, dtype)
+
+    def agg_fold(self, acc, payload):
+        return self.inner.agg_fold(acc, payload)
+
+    def agg_finalize(self, acc, shape, dtype):
+        return self.inner.agg_finalize(acc, shape, dtype)
+
     def payload_bits(self, shape, dtype):
         return self.inner.payload_bits(shape, dtype)
 

@@ -10,13 +10,21 @@ from __future__ import annotations
 
 import jax
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    dense_agg_finalize,
+    dense_agg_init,
+    register_codec,
+)
 
 
 @register_codec("identity")
 class IdentityCodec(Codec):
     supports_psum = True
     bucketable = True  # trivially shape-agnostic and stateless
+    # aggregation IS the sum — trivially exact; the streaming form keeps
+    # one running f32 accumulator per unit (no per-push tree rebuild)
+    supports_aggregate = True
 
     def encode(self, grad, state=(), rng=None):
         return grad, state
@@ -26,3 +34,20 @@ class IdentityCodec(Codec):
 
     def decode_sum(self, payloads, shape, dtype):
         return payloads.sum(axis=0).astype(dtype).reshape(shape)
+
+    def aggregate(self, payloads, shape, dtype):
+        return (payloads.sum(axis=0),
+                {"frames": int(payloads.shape[0])})
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
+        return agg_payload.astype(dtype).reshape(shape)
+
+    def agg_init(self, shape, dtype):
+        return dense_agg_init(shape)
+
+    def agg_fold(self, acc, payload):
+        acc["acc"] += payload.reshape(-1)
+        acc["frames"] += 1
+
+    def agg_finalize(self, acc, shape, dtype):
+        return dense_agg_finalize(acc, shape, dtype)

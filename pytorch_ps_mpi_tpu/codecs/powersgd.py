@@ -58,6 +58,13 @@ def _matrix_shape(shape):
 @register_codec("powersgd")
 class PowerSGDCodec(Codec):
     supports_fused_allreduce = True
+    # exact factor-domain aggregation: W rank-r payloads concatenate into
+    # ONE rank-W·r factor pair ([n, Wr] and [m, Wr]) whose single
+    # reconstruct equals Σ_w P_w Q_wᵀ — the factors are summed/stacked in
+    # the compressed domain and the O(n·m) reconstruct happens once per
+    # round instead of once per worker (the all-reduced shared-Q protocol
+    # remains the true factor-SUM form, fused_allreduce)
+    supports_aggregate = True
 
     def __init__(self, rank: int = 2, min_compression_elems: int = 1024):
         """``rank``: approximation rank r. Tensors with fewer than
@@ -151,10 +158,29 @@ class PowerSGDCodec(Codec):
         return (payload["P"] @ payload["Q"].T).reshape(shape).astype(dtype)
 
     def decode_sum(self, payloads, shape, dtype):
+        # Σ_w P_w Q_wᵀ through the factor-concat aggregation (one
+        # [n, Wr] @ [Wr, m] contraction — same reduction the old
+        # "wnr,wmr->nm" einsum performed, single source of truth now)
+        agg, meta = self.aggregate(payloads, shape, dtype)
+        return self.agg_decode(agg, meta, shape, dtype)
+
+    def aggregate(self, payloads, shape, dtype):
         if "raw" in payloads:
-            return payloads["raw"].sum(axis=0).astype(dtype)
-        # Σ_w P_w Q_wᵀ in one batched contraction
-        out = jnp.einsum("wnr,wmr->nm", payloads["P"], payloads["Q"])
+            return ({"raw": payloads["raw"].sum(axis=0)},
+                    {"frames": int(payloads["raw"].shape[0])})
+        w, n, r = payloads["P"].shape
+        m = payloads["Q"].shape[1]
+        # [w, n, r] -> [n, w*r]: stack the per-worker factors side by
+        # side; the concatenated pair IS the aggregated payload (rank
+        # W·r), sized by the factors, never by the decoded matrix
+        p_cat = jnp.transpose(payloads["P"], (1, 0, 2)).reshape(n, w * r)
+        q_cat = jnp.transpose(payloads["Q"], (1, 0, 2)).reshape(m, w * r)
+        return {"P": p_cat, "Q": q_cat}, {"frames": int(w)}
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
+        if "raw" in agg_payload:
+            return agg_payload["raw"].astype(dtype)
+        out = agg_payload["P"] @ agg_payload["Q"].T
         return out.reshape(shape).astype(dtype)
 
     def payload_bits(self, shape, dtype):

@@ -19,12 +19,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    register_codec,
+    sparse_agg_finalize,
+    sparse_agg_fold,
+    sparse_agg_init,
+)
 
 
 @register_codec("randomk")
 class RandomKCodec(Codec):
     needs_rng = True
+    # exact sparse index-merge (see TopKCodec): concat + one scatter-add,
+    # never densified; per-worker strata may overlap across ranks and the
+    # scatter-add sums collisions exactly as decode_sum does
+    supports_aggregate = True
 
     @property
     def bucketable(self):
@@ -73,11 +83,31 @@ class RandomKCodec(Codec):
         return flat.reshape(shape)
 
     def decode_sum(self, payloads, shape, dtype):
+        agg, meta = self.aggregate(payloads, shape, dtype)
+        return self.agg_decode(agg, meta, shape, dtype)
+
+    def aggregate(self, payloads, shape, dtype):
+        idx = payloads["indices"]
+        return {
+            "values": payloads["values"].reshape(-1),
+            "indices": idx.reshape(-1),
+        }, {"frames": int(idx.shape[0])}
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
         n = int(np.prod(shape)) if shape else 1
         flat = jnp.zeros((n,), dtype)
-        idx = payloads["indices"].reshape(-1)
-        val = payloads["values"].reshape(-1).astype(dtype)
-        return flat.at[idx].add(val).reshape(shape)
+        val = agg_payload["values"].astype(dtype)
+        return flat.at[agg_payload["indices"]].add(val).reshape(shape)
+
+    # streaming form: shared sparse concat accumulator (O(k) per fold)
+    def agg_init(self, shape, dtype):
+        return sparse_agg_init()
+
+    def agg_fold(self, acc, payload):
+        sparse_agg_fold(acc, payload["values"], payload["indices"])
+
+    def agg_finalize(self, acc, shape, dtype):
+        return sparse_agg_finalize(acc, shape, dtype)
 
     def payload_bits(self, shape, dtype):
         k = self._k_for(shape)

@@ -37,6 +37,16 @@ class SignCodec(Codec):
     # becomes a per-BUCKET mean|g| instead of per-tensor (same estimator
     # family, coarser normalization group — documented semantics change)
     bucketable = True
+    # APPROXIMATE vote-count algebra: per-element votes accumulate in a
+    # widened integer counter (pure integer domain, no decode per push)
+    # and the decode applies the MEAN of the per-frame scales — exact
+    # when all frames share a scale, otherwise sign-vote ≈ sum-of-signs
+    # with a measured rel-error (fidelity_bench --aggregate). agg_exact
+    # is False, so the SPMD training path (ps.decode_sum_payloads) never
+    # substitutes it for the exact decode_sum; only the host wire ships
+    # it, behind the fidelity contract.
+    supports_aggregate = True
+    agg_exact = False
 
     def __init__(self, use_pallas: bool = True, nonfinite: str = "propagate"):
         self.use_pallas = use_pallas
@@ -80,6 +90,51 @@ class SignCodec(Codec):
         signs = self._unpack(payload["packed"], n)
         g = jnp.where(signs, payload["scale"], -payload["scale"]).astype(dtype)
         return g.reshape(shape)
+
+    def can_aggregate(self, shape, dtype) -> bool:
+        # the Pallas bit layout (sublane-grouped) has no host-side
+        # unpack; those units fall back to decode_sum automatically
+        n = int(np.prod(shape)) if shape else 1
+        return not self._pallas_ok(n)
+
+    def aggregate(self, payloads, shape, dtype):
+        """Vote-count aggregation: per-element positive-sign votes in an
+        int32 counter plus the summed scale. Σ_w s_w·(2b_w − 1) is
+        approximated by s̄·(2·votes − W); the per-frame decode collapses
+        to ONE at agg_decode time."""
+        n = int(np.prod(shape)) if shape else 1
+        bits = jax.vmap(lambda p: self._unpack(p, n))(payloads["packed"])
+        votes = bits.astype(jnp.int32).sum(axis=0)
+        scale_sum = payloads["scale"].astype(jnp.float32).sum()
+        return ({"votes": votes, "scale_sum": scale_sum},
+                {"frames": int(payloads["packed"].shape[0])})
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
+        w = meta["frames"]
+        mean_scale = agg_payload["scale_sum"] / w
+        out = (2 * agg_payload["votes"] - w).astype(dtype) * mean_scale
+        return out.astype(dtype).reshape(shape)
+
+    def agg_init(self, shape, dtype):
+        n = int(np.prod(shape)) if shape else 1
+        return {"frames": 0, "votes": np.zeros(n, np.int32),
+                "scale_sum": 0.0, "n": n}
+
+    def agg_fold(self, acc, payload):
+        # np.unpackbits(bitorder='little') matches the jnp pack weights
+        # [1, 2, 4, ...]; pure integer accumulate — the widened-counter
+        # vote domain
+        bits = np.unpackbits(payload["packed"].reshape(-1),
+                             count=acc["n"], bitorder="little")
+        acc["votes"] += bits
+        acc["scale_sum"] += float(payload["scale"])
+        acc["frames"] += 1
+
+    def agg_finalize(self, acc, shape, dtype):
+        w = acc["frames"]
+        mean_scale = np.float32(acc["scale_sum"] / w)
+        out = (2 * acc["votes"] - w).astype(np.float32) * mean_scale
+        return out.astype(dtype).reshape(shape)
 
     def payload_bits(self, shape, dtype):
         n = int(np.prod(shape)) if shape else 1

@@ -14,6 +14,8 @@ gradients, all on-device (no host compressor, SURVEY §2.4).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -21,8 +23,10 @@ import numpy as np
 from pytorch_ps_mpi_tpu.codecs.base import (
     Codec,
     check_nonfinite_mode,
+    dense_agg_finalize,
     guard_nonfinite,
     register_codec,
+    scalefold_agg_init,
 )
 
 _WEIGHTS = (1, 4, 16, 64)  # base-4 digit weights, 4 ternary digits per byte
@@ -32,38 +36,122 @@ def _packed_len(n: int) -> int:
     return (n + 3) // 4
 
 
+@partial(jax.jit, static_argnames=("n",))
+def _fused_tern_fold(acc, packed, scale, n):
+    """acc + scale · unpack(packed) in one fused pass."""
+    digits = (packed[:, None]
+              // jnp.asarray(_WEIGHTS, jnp.uint8)[None, :]) % 4
+    tern = digits.reshape(-1)[:n].astype(jnp.int8) - 1
+    return acc + tern.astype(jnp.float32) * scale
+
+
 @register_codec("terngrad")
 class TernGradCodec(Codec):
     needs_rng = True
     # per-bucket max|g| scale instead of per-tensor under bucketing;
     # unbiasedness is preserved (scale is shared, Bernoulli stays exact)
     bucketable = True
+    # exact ternary-count algebra: the batch form contracts the unpacked
+    # {-1,0,+1} digits against the per-frame scale vector in one widened-
+    # accumulator einsum (decode_sum routes through it); the streaming
+    # form folds scale × ternary per push into an f32 accumulator —
+    # integer unpack, one fused multiply-add, no per-push jitted decode
+    supports_aggregate = True
 
-    def __init__(self, nonfinite: str = "propagate"):
+    def __init__(self, nonfinite: str = "propagate",
+                 scan_block: int = 1 << 20, scan_threshold: int = 0):
+        """``scan_block``/``scan_threshold``: gradients with at least
+        ``scan_threshold`` elements (default ``4 * scan_block``) encode
+        through a ``lax.scan`` over ``scan_block``-element chunks so XLA
+        never materializes a full-size f32 intermediate — the fix for
+        the 505 MB HLO temp the whole-tensor form allocated on a
+        BERT-base gradient (BENCH_TPU_WATCH: the uniform draw + keep
+        probability both went [132M] f32). Per-chunk PRNG keys derive
+        from the round key by fold-in, so the stream differs from the
+        whole-tensor form — irrelevant for an unbiased stochastic codec
+        — while wire format and size are unchanged."""
         # a NaN/Inf element drives the max|g| scale non-finite AND makes
         # its keep-probability NaN (uniform < NaN is False, so the digit
         # silently collapses to 0) — guard per codecs/base.guard_nonfinite
         self.nonfinite = check_nonfinite_mode(nonfinite)
+        if scan_block <= 0 or scan_block % 4:
+            raise ValueError("scan_block must be a positive multiple of 4")
+        self.scan_block = int(scan_block)
+        self.scan_threshold = (int(scan_threshold) if scan_threshold > 0
+                               else 4 * self.scan_block)
+
+    def _digits(self, g, scale, rng):
+        """g (any shape) → ternary digits {0,1,2} (uint8, same shape)."""
+        keep = jax.random.uniform(rng, g.shape) < (jnp.abs(g) / scale)
+        # ternary digit: 0 -> -1, 1 -> 0, 2 -> +1
+        return jnp.where(keep, jnp.where(g >= 0, 2, 0), 1).astype(jnp.uint8)
 
     def encode(self, grad, state=(), rng=None):
         assert rng is not None, "TernGradCodec needs a PRNG key"
         g = guard_nonfinite(grad.astype(jnp.float32), self.nonfinite,
                             "TernGradCodec")
         n = int(np.prod(g.shape)) if g.shape else 1
+        weights = jnp.asarray(_WEIGHTS, jnp.uint8)
+
+        def pack_digits(d):
+            return (d.reshape(-1, 4) * weights).sum(axis=1).astype(jnp.uint8)
+
+        if n >= self.scan_threshold:
+            # chunked encode: scan over scan_block-element slices — the
+            # absmax pass AND the Bernoulli/pack pass both run one chunk
+            # at a time, so peak temp is a chunk's intermediates (XLA
+            # reuses the loop-body buffers), never an n-sized f32 tensor
+            # (the whole-tensor form materializes abs|g| + the uniform
+            # draw: 505 MB of HLO temps on a BERT-base gradient,
+            # BENCH_TPU_WATCH). A ragged tail (< scan_block elements)
+            # encodes outside the scan with chunk-sized temps; its digit
+            # offset stays 4-aligned because scan_block is.
+            blk = self.scan_block
+            nb_full = n // blk
+            tail_n = n - nb_full * blk
+            flat = g.reshape(-1)
+            idxs = jnp.arange(nb_full, dtype=jnp.int32)
+
+            def chunk(i):
+                # dynamic_slice, not a pre-reshaped xs array: the scan
+                # reads blk elements straight out of the input buffer,
+                # so no n-sized copy exists even at ragged sizes
+                return jax.lax.dynamic_slice(flat, (i * blk,), (blk,))
+
+            def mx_body(m, i):
+                return jnp.maximum(m, jnp.max(jnp.abs(chunk(i)))), None
+
+            scale, _ = jax.lax.scan(mx_body, jnp.float32(1e-12), idxs)
+            tail = flat[nb_full * blk:] if tail_n else None
+            if tail_n:
+                scale = jnp.maximum(scale, jnp.max(jnp.abs(tail)))
+
+            def body(_, i):
+                d = self._digits(chunk(i), scale,
+                                 jax.random.fold_in(rng, i))
+                return 0, pack_digits(d)
+
+            _, packed = jax.lax.scan(body, 0, idxs)
+            parts = [packed.reshape(-1)]
+            if tail_n:
+                d = self._digits(tail, scale,
+                                 jax.random.fold_in(rng, nb_full))
+                pad = _packed_len(tail_n) * 4 - tail_n
+                parts.append(pack_digits(
+                    jnp.pad(d, (0, pad), constant_values=1)))
+            packed = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            return {"packed": packed,
+                    "scale": scale.astype(jnp.float32)}, state
         scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
         # draw the Bernoulli randoms in the gradient's NATIVE shape and
         # flatten only the resulting uint8 digits: fusing a 132M-element
         # threefry with a reshape-derived probability tensor crashes the
         # TPU compile helper (observed on v5e; 1-D and native-shape forms
         # compile fine)
-        keep = jax.random.uniform(rng, g.shape) < (jnp.abs(g) / scale)
-        # ternary digit: 0 -> -1, 1 -> 0, 2 -> +1
-        digit = jnp.where(keep, jnp.where(g >= 0, 2, 0), 1).astype(jnp.uint8)
-        flat = digit.reshape(-1)
+        digit = self._digits(g, scale, rng)
         pad = _packed_len(n) * 4 - n
-        flat = jnp.pad(flat, (0, pad), constant_values=1).reshape(-1, 4)
-        weights = jnp.asarray(_WEIGHTS, jnp.uint8)
-        packed = (flat * weights).sum(axis=1).astype(jnp.uint8)
+        packed = pack_digits(
+            jnp.pad(digit.reshape(-1), (0, pad), constant_values=1))
         return {"packed": packed, "scale": scale.astype(jnp.float32)}, state
 
     def _unpack(self, packed, n):
@@ -77,11 +165,46 @@ class TernGradCodec(Codec):
 
     def decode_sum(self, payloads, shape, dtype):
         # Sum of per-rank scaled ternaries without materializing [world, n]
-        # floats: unpack to int8, weight each rank by its scale.
+        # floats — routed through the exact ternary-count aggregation.
+        agg, meta = self.aggregate(payloads, shape, dtype)
+        return self.agg_decode(agg, meta, shape, dtype)
+
+    def aggregate(self, payloads, shape, dtype):
+        # ternary-count contraction: the [world, n] int8 digit matrix
+        # meets the [world] scale vector inside one widened-accumulator
+        # einsum — the integer payloads never become a float stack
         n = int(np.prod(shape)) if shape else 1
         tern = jax.vmap(lambda p: self._unpack(p, n))(payloads["packed"])
-        summed = (tern.astype(dtype) * payloads["scale"][:, None].astype(dtype)).sum(0)
-        return summed.reshape(shape)
+        acc = jnp.einsum("wn,w->n", tern,
+                         payloads["scale"].astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        return {"acc": acc}, {"frames": int(tern.shape[0])}
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
+        return agg_payload["acc"].astype(dtype).reshape(shape)
+
+    def agg_init(self, shape, dtype):
+        return scalefold_agg_init(shape)
+
+    def agg_fold(self, acc, payload):
+        # base-4 unpack (integer ops), then one per-frame scale-folded
+        # multiply-add into the f32 accumulator; large units run the
+        # jitted fused kernel, small ones pure numpy
+        packed = payload["packed"].reshape(-1)
+        if acc.get("jit"):
+            acc["acc"] = _fused_tern_fold(
+                acc["acc"], packed, np.float32(payload["scale"]),
+                acc["n"])
+        else:
+            digits = (packed[:, None] //
+                      np.asarray(_WEIGHTS, np.uint8)[None, :]) % 4
+            tern = digits.reshape(-1)[: acc["n"]].astype(np.int8) - 1
+            np.multiply(tern, np.float32(payload["scale"]), out=acc["tmp"])
+            acc["acc"] += acc["tmp"]
+        acc["frames"] += 1
+
+    def agg_finalize(self, acc, shape, dtype):
+        return dense_agg_finalize(acc, shape, dtype)
 
     def payload_bits(self, shape, dtype):
         n = int(np.prod(shape)) if shape else 1

@@ -47,11 +47,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    register_codec,
+    sparse_agg_finalize,
+    sparse_agg_fold,
+    sparse_agg_init,
+)
 
 
 @register_codec("threshold")
 class ThresholdCodec(Codec):
+    # exact sparse index-merge, with each rank's garbage tail masked by
+    # ITS OWN length sidecar before the concat — the ragged protocol's
+    # receive half applied in the compressed domain
+    supports_aggregate = True
+
     def __init__(
         self,
         tau: float = 2.0,
@@ -154,10 +165,34 @@ class ThresholdCodec(Codec):
         # Masked fused scatter-add over all workers: each worker's garbage
         # tail is zeroed by ITS OWN length before the sum — the receive
         # half of the ragged protocol.
+        agg, meta = self.aggregate(payloads, shape, dtype)
+        return self.agg_decode(agg, meta, shape, dtype)
+
+    def aggregate(self, payloads, shape, dtype):
+        idx = payloads["indices"]
+        return {
+            "values": self._masked_values(payloads, dtype).reshape(-1),
+            "indices": idx.reshape(-1),
+        }, {"frames": int(idx.shape[0])}
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
         n = int(np.prod(shape)) if shape else 1
-        vals = self._masked_values(payloads, dtype).reshape(-1)
-        idx = payloads["indices"].reshape(-1)
-        return jnp.zeros((n,), dtype).at[idx].add(vals).reshape(shape)
+        return jnp.zeros((n,), dtype).at[agg_payload["indices"]].add(
+            agg_payload["values"].astype(dtype)).reshape(shape)
+
+    # streaming form: each frame contributes only its length-prefix
+    # (survivors live at the front in index order; the tail is garbage
+    # by the wire contract) — O(length) per fold
+    def agg_init(self, shape, dtype):
+        return sparse_agg_init()
+
+    def agg_fold(self, acc, payload):
+        k = int(payload["length"])
+        sparse_agg_fold(acc, np.asarray(payload["values"]).reshape(-1)[:k],
+                        np.asarray(payload["indices"]).reshape(-1)[:k])
+
+    def agg_finalize(self, acc, shape, dtype):
+        return sparse_agg_finalize(acc, shape, dtype)
 
     def payload_bits(self, shape, dtype):
         # static wire size (the cap); true occupancy varies per step

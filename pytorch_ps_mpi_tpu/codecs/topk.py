@@ -18,11 +18,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
+from pytorch_ps_mpi_tpu.codecs.base import (
+    Codec,
+    register_codec,
+    sparse_agg_finalize,
+    sparse_agg_fold,
+    sparse_agg_init,
+)
 
 
 @register_codec("topk")
 class TopKCodec(Codec):
+    # exact sparse index-merge algebra (SparCML): aggregation is concat
+    # of (values, indices) pairs — never densified — and ONE scatter-add
+    # decodes the sum; the streaming accumulator is the concat list
+    # itself, so server-side per-push cost is O(k), not O(n)
+    supports_aggregate = True
+
     def __init__(self, k: int = 0, fraction: float = 0.0, approx: bool = False):
         """``approx=True`` selects ``lax.approx_max_k`` — the TPU's
         hardware-accelerated approximate top-k (recall ~0.95) — instead of
@@ -67,11 +79,38 @@ class TopKCodec(Codec):
     def decode_sum(self, payloads, shape, dtype):
         # Fused scatter-add across all ranks' payloads: one segment-sum
         # instead of the reference's per-rank decode loop (ps.py:161-176).
+        agg, meta = self.aggregate(payloads, shape, dtype)
+        return self.agg_decode(agg, meta, shape, dtype)
+
+    def aggregate(self, payloads, shape, dtype):
+        # SparCML index-merge: the aggregated payload is the ranks'
+        # (values, indices) pairs concatenated in rank order — the
+        # reshape(-1) of the stacked batch — sized world×k, never n
+        idx = payloads["indices"]
+        return {
+            "values": payloads["values"].reshape(-1),
+            "indices": idx.reshape(-1),
+        }, {"frames": int(idx.shape[0])}
+
+    def agg_decode(self, agg_payload, meta, shape, dtype):
+        # mode='drop' as in decode: load-bearing for BlockTopKCodec's
+        # >= n pad-slot indices
         n = int(np.prod(shape)) if shape else 1
         flat = jnp.zeros((n,), dtype)
-        idx = payloads["indices"].reshape(-1)
-        val = payloads["values"].reshape(-1).astype(dtype)
-        return flat.at[idx].add(val, mode="drop").reshape(shape)
+        val = agg_payload["values"].astype(dtype)
+        return flat.at[agg_payload["indices"]].add(
+            val, mode="drop").reshape(shape)
+
+    # streaming form: the concat list IS the accumulator (O(k) per fold,
+    # one numpy scatter-add at finalize) — shared sparse helpers
+    def agg_init(self, shape, dtype):
+        return sparse_agg_init()
+
+    def agg_fold(self, acc, payload):
+        sparse_agg_fold(acc, payload["values"], payload["indices"])
+
+    def agg_finalize(self, acc, shape, dtype):
+        return sparse_agg_finalize(acc, shape, dtype)
 
     def payload_bits(self, shape, dtype):
         k = self._k_for(shape)

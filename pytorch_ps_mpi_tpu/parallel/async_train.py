@@ -587,6 +587,27 @@ def serve(
     exactly like the metrics endpoint. Unarmed, publishes degrade to the
     transport's own publish — the legacy path pays nothing.
 
+    Homomorphic aggregation (``cfg["agg"]``: ``"auto"`` default /
+    ``"on"`` / ``"off"``): in sync-barrier mode over a codec wire whose
+    algebra supports it (``Codec.supports_aggregate`` — int8/qsgd in the
+    integer domain, top-k/random-k/threshold by sparse index-merge,
+    terngrad in the ternary-count domain, PowerSGD by factor concat,
+    sign by per-element vote counts), the loop stops decoding per push:
+    payloads queue in compressed form, each round folds one payload per
+    active worker into a :class:`~pytorch_ps_mpi_tpu.parallel.dcn.
+    WireAggregator`, and exactly ONE decode runs per published version
+    (``decodes_per_publish == 1`` in the canonical metrics; ``agg_mode``
+    1.0). Per-push server cost becomes a function of PAYLOAD size, and
+    the ``[world, ...]`` decoded stack never exists. Falls back to
+    decode-sum automatically — async mode, no codec, a codec without
+    the algebra, or an armed numerics monitor (its per-push validation
+    needs decoded trees) — counting ``agg_fallbacks`` when ``"on"``
+    asked explicitly. The sign vote algebra is APPROXIMATE (exact when
+    per-push scales agree; measured rel-error in
+    ``benchmarks/fidelity_bench.py --aggregate``), so ``"auto"`` never
+    arms it — approximate algebras require an explicit ``"on"``, the
+    opt-in to that fidelity contract.
+
     Resilience hooks:
 
     - ``on_tick``: called from INSIDE the loop (same thread as every
@@ -679,6 +700,43 @@ def serve(
 
     inj = FaultInjector.from_cfg(cfg, role="server")
 
+    # -- homomorphic aggregation (cfg["agg"]: "auto" | "on" | "off") ------
+    # Armed, the sync-barrier loop stops decoding per push: each arriving
+    # payload is kept in its COMPRESSED form, a round folds one payload
+    # per active worker into a CodecWire aggregator, and exactly one
+    # decode happens per published version (decodes_per_publish == 1).
+    # Requirements — any miss falls back to the decode-sum path, loudly
+    # when "on" asked for it: a sync barrier (async mode publishes per
+    # push, one decode per publish already), a codec wire whose algebra
+    # supports aggregation (Codec.supports_aggregate + per-unit
+    # can_aggregate; approximate algebras additionally need the explicit
+    # "on"), and no numerics monitor (its per-push decoded-tree
+    # validation needs the decode; the payload-level non-finite screen
+    # below rides the aggregator instead).
+    agg_req = str(cfg.get("agg", "auto")).lower()
+    if agg_req not in ("auto", "on", "off"):
+        raise ValueError(f"cfg['agg'] must be auto/on/off, got {agg_req!r}")
+    wire = getattr(server, "wire", None)
+    agg_armed = (
+        agg_req != "off" and sync_barrier and wire is not None
+        and getattr(wire, "agg_supported", False) and numon is None
+        # an APPROXIMATE algebra (sign's vote counts, agg_exact=False)
+        # changes training numerics, so "auto" never arms it — only an
+        # explicit cfg["agg"] = "on" opts into the measured fidelity
+        # contract; exact algebras arm under "auto" (bit-identical)
+        and (agg_req == "on"
+             or getattr(wire.code, "agg_exact", True))
+    )
+    if agg_req == "on" and not agg_armed:
+        why = ("no sync barrier" if not sync_barrier
+               else "no codec wire" if wire is None
+               else "codec lacks an aggregation algebra"
+               if not getattr(wire, "agg_supported", False)
+               else "numerics monitor armed")
+        print(f"compressed-domain aggregation requested but not armed "
+              f"({why}); falling back to decode-sum", flush=True)
+    server.agg_mode = 1.0 if agg_armed else 0.0
+
     loss0 = float(eval_loss(params, eval_batch))
     core.publish(params)
     applied = 0
@@ -746,6 +804,7 @@ def serve(
         # through the serving core: the transport publish plus — when the
         # read tier is armed — one snapshot into the refcounted ring
         # (same single flatten either way)
+        server.grad_publishes += 1  # decodes_per_publish denominator
         core.publish(jax.tree.map(np.asarray, params))
         up_dur = time.perf_counter() - up_t0
         h_update.observe(up_dur)
@@ -802,12 +861,28 @@ def serve(
         if not active or any(not pending[w] for w in active):
             return False
         up_t0 = time.perf_counter()
-        batch_grads = [pending[w].popleft() for w in active]
-        summed = jax.tree.map(lambda *gs: sum(gs) / len(gs), *batch_grads)
+        if agg_armed:
+            # compressed-domain round: fold one queued payload per
+            # active worker into the wire aggregator, then ONE decode
+            # (never a [world, ...] decoded stack, never per-push
+            # decodes) — the averaged result feeds the same jitted
+            # update the decode-sum path does
+            agg = wire.agg_begin()
+            for w in active:
+                agg.fold(pending[w].popleft())
+            server.decodes_done += 1
+            inv = np.float32(1.0 / len(active))
+            summed = jax.tree.map(lambda x: x * inv, agg.finalize())
+            n_contrib = agg.frames
+        else:
+            batch_grads = [pending[w].popleft() for w in active]
+            summed = jax.tree.map(
+                lambda *gs: sum(gs) / len(gs), *batch_grads)
+            n_contrib = len(batch_grads)
         probe = numon is not None and applied >= next_numerics_probe
         old_params = params if probe else None
         params, state = update(params, summed, state)
-        applied += len(batch_grads)
+        applied += n_contrib
         if probe:
             numon.observe_update(old_params, params,
                                  applied_before + applied)
@@ -821,7 +896,7 @@ def serve(
             for w2 in range(n_workers):
                 if pending[w2]:
                     round_ready[w2] = up_t0
-        if len(batch_grads) < n_workers:
+        if n_contrib < n_workers:
             degraded_rounds += 1
             c_degraded.inc()
             if rec is not None:
@@ -845,13 +920,34 @@ def serve(
                 _mark_dead_workers()
                 while _try_complete_round():
                     pass
-        item = server.poll_grad()
+        item = server.poll_grad(raw=True) if agg_armed else server.poll_grad()
         if item is None:
             if draining:
                 break
             time.sleep(0.0005)
             continue
         wid, grad_version, grad = item
+        if agg_armed:
+            # payload-level non-finite screen (the aggregation path's
+            # stand-in for the numerics monitor's decoded-tree check,
+            # which can't run here — arming requires numon off): a push
+            # whose float payload leaves are non-finite would poison the
+            # compressed accumulator, so reject it like any bad frame
+            # and let the barrier wait for the worker's next push (the
+            # same consumed-but-skipped discipline as numerics "skip")
+            if not wire.payload_finite(grad):
+                server._reject_frame(wid, "nonfinite")
+                if lint is not None:
+                    lint.discard_last(wid, reason="nonfinite")
+                wait_t0 = time.perf_counter()
+                continue
+            # grad is the validated payload BYTES (a view into the
+            # receive buffer): one payload-sized copy queues it for its
+            # round — the per-push cost, in place of a jitted decode +
+            # full-tree rebuild
+            grad = np.copy(grad)
+        elif agg_req == "on":
+            server.agg_fallbacks += 1
         wait_s = time.perf_counter() - wait_t0
         h_wait.observe(wait_s)
         staleness = max(0, server.version - grad_version)

@@ -274,12 +274,10 @@ class CodecWire:
         out["codec"] = type(self.code).__name__
         return out
 
-    def decode_from_bytes(self, buf) -> PyTree:
-        """Decode a wire buffer (``bytes``, ``bytearray``, ``memoryview``
-        or uint8 ndarray) back into the template-structured gradient tree.
-        Payload arrays are zero-copy views through one ``memoryview`` —
-        the device transfer inside the jitted decode is the only copy.
-        A buffer shorter than the wire spec raises a clear ValueError."""
+    def payloads_from_bytes(self, buf) -> list:
+        """Parse a wire buffer into the per-unit payload pytrees as
+        ZERO-COPY numpy views (valid only while ``buf`` is — consumers
+        that retain anything must copy)."""
         import jax
 
         from pytorch_ps_mpi_tpu.utils.serialization import read_arrays
@@ -292,10 +290,102 @@ class CodecWire:
                 jax.tree.unflatten(struct, arrays[i:i + struct.num_leaves])
             )
             i += struct.num_leaves
-        decoded = self._dec(payloads)
+        return payloads
+
+    def decode_from_bytes(self, buf) -> PyTree:
+        """Decode a wire buffer (``bytes``, ``bytearray``, ``memoryview``
+        or uint8 ndarray) back into the template-structured gradient tree.
+        Payload arrays are zero-copy views through one ``memoryview`` —
+        the device transfer inside the jitted decode is the only copy.
+        A buffer shorter than the wire spec raises a clear ValueError."""
+        import jax
+
+        decoded = self._dec(self.payloads_from_bytes(buf))
         return jax.tree.unflatten(
             self.treedef, [np.asarray(x) for x in decoded]
         )
+
+    @property
+    def agg_supported(self) -> bool:
+        """True when EVERY wire unit can aggregate in the compressed
+        domain (``Codec.supports_aggregate`` + the per-unit
+        ``can_aggregate`` refinement). False means the serve loop keeps
+        the decode-sum path — the automatic fallback."""
+        return bool(getattr(self.code, "supports_aggregate", False)) and all(
+            self.code.can_aggregate(s, d)
+            for s, d in zip(self.shapes, self.dtypes)
+        )
+
+    def agg_begin(self) -> "WireAggregator":
+        """Fresh compressed-domain accumulator for one aggregation round
+        (one published version). Fold every composing push's payload
+        bytes in, then ``finalize()`` for the ONE decode."""
+        return WireAggregator(self)
+
+    def payload_finite(self, buf) -> bool:
+        """Cheap payload-level non-finite screen: checks only the FLOAT
+        leaves of the wire payload (scales, norms, sparse values — for
+        int8 that is one scalar per unit). A payload whose float leaves
+        are finite decodes to a finite gradient for every registered
+        codec, so this is the aggregation path's stand-in for the
+        decoded-tree check the numerics monitor runs. Float-ness is
+        decided by an UPCAST probe, not ``dtype.kind``: the ml_dtypes
+        wire types (bf16's numpy dtype has kind 'V', not 'f') must be
+        screened — they are exactly the payloads an identity/bf16 wire
+        carries."""
+        import jax
+
+        for p in self.payloads_from_bytes(buf):
+            for leaf in jax.tree.leaves(p):
+                if leaf.dtype.kind in "iub":
+                    continue  # integer payload domain (q, indices, votes)
+                if not np.all(np.isfinite(np.asarray(leaf, np.float32))):
+                    return False
+        return True
+
+
+class WireAggregator:
+    """One aggregation round's compressed accumulator over a
+    :class:`CodecWire`: ``fold`` ingests one push's payload bytes per
+    call (host-side numpy, no jit dispatch, no tree rebuild — the
+    per-push cost is a function of PAYLOAD size), ``finalize`` performs
+    exactly one decode and returns the summed gradient tree. The
+    serve-loop half of the THC/SparCML recipe; the SPMD half lives in
+    ``ps.decode_sum_payloads``."""
+
+    def __init__(self, wire: "CodecWire"):
+        self.wire = wire
+        code = wire.code
+        self._accs = [
+            code.agg_init(s, d) for s, d in zip(wire.shapes, wire.dtypes)
+        ]
+        self.frames = 0
+
+    def fold(self, buf) -> None:
+        """Fold one push's payload bytes (any bytes-like of exactly
+        ``wire.wire_bytes``) into the accumulator. The parse is
+        zero-copy; codec folds copy only what they retain."""
+        payloads = self.wire.payloads_from_bytes(buf)
+        code = self.wire.code
+        for acc, p in zip(self._accs, payloads):
+            code.agg_fold(acc, p)
+        self.frames += 1
+
+    def finalize(self) -> PyTree:
+        """The ONE decode per published version: per-unit finalize,
+        bucket unpack (when the wire is bucketed), tree rebuild. Returns
+        the SUM over folded pushes."""
+        import jax
+
+        wire = self.wire
+        code = wire.code
+        units = [
+            np.asarray(code.agg_finalize(acc, s, d))
+            for acc, s, d in zip(self._accs, wire.shapes, wire.dtypes)
+        ]
+        if wire.plan is not None:
+            units = [np.asarray(x) for x in wire.plan.unpack_leaves(units)]
+        return jax.tree.unflatten(wire.treedef, units)
 
 
 class ShmPSServer(PSServerTelemetry):
@@ -382,7 +472,9 @@ class ShmPSServer(PSServerTelemetry):
 
     def _decode_payload(self, payload: np.ndarray) -> PyTree:
         """Payload bytes (a view into the receive buffer) → gradient
-        tree; shared by the framed and legacy poll paths."""
+        tree; shared by the framed and legacy poll paths. Counted in
+        ``decodes_done`` — the numerator of ``decodes_per_publish``."""
+        self.decodes_done += 1
         if self.wire:
             # zero-copy: decode reads the receive buffer through a
             # memoryview; the jitted decode's device transfer is the copy
@@ -390,7 +482,8 @@ class ShmPSServer(PSServerTelemetry):
         flat = np.frombuffer(payload, np.float32).copy()
         return _unflatten(flat, self.template)
 
-    def _poll_grad_framed(self) -> Optional[Tuple[int, int, PyTree]]:
+    def _poll_grad_framed(self, raw: bool = False
+                          ) -> Optional[Tuple[int, int, PyTree]]:
         """Frame-checking poll — the shared ``frames.framed_poll`` loop
         (validate → reject-and-count → bounded staleness → decode) over
         this transport's mailbox pop."""
@@ -409,14 +502,23 @@ class ShmPSServer(PSServerTelemetry):
             )
             return int(n), int(worker.value), int(version.value)
 
-        return self._frames.framed_poll(self, pop_once)
+        return self._frames.framed_poll(self, pop_once, raw=raw)
 
-    def poll_grad(self) -> Optional[Tuple[int, int, PyTree]]:
+    def poll_grad(self, raw: bool = False
+                  ) -> Optional[Tuple[int, int, PyTree]]:
         """One pending gradient as (worker, version, grad_tree), or None.
         Gradients staler than max_staleness are dropped (bounded
-        staleness), counted in ``stale_drops``."""
+        staleness), counted in ``stale_drops``. ``raw=True`` (the
+        homomorphic-aggregation mode) skips the decode and returns the
+        validated payload BYTES as a view into the receive buffer —
+        copy or fold before the next poll."""
+        if raw and not self.wire:
+            # without a codec wire the receive buffer is f32-typed and
+            # there is no payload format to hand back — a [:n] slice
+            # would be a silently mis-sized view, not bytes
+            raise ValueError("poll_grad(raw=True) needs a codec wire")
         if self.frame:
-            return self._poll_grad_framed()
+            return self._poll_grad_framed(raw=raw)
         worker = ctypes.c_uint32()
         version = ctypes.c_uint64()
         cursor = getattr(self, "_cursor", None)
@@ -456,13 +558,15 @@ class ShmPSServer(PSServerTelemetry):
                 f"payload size {n} != wire spec {expected} bytes: worker "
                 "and server codec configs disagree"
             )
-        if self.wire:
-            # zero-copy: decode reads the receive buffer through a
-            # memoryview; the jitted decode's device transfer is the copy
-            grad = self.wire.decode_from_bytes(self._grad_buf[:n])
+        if raw:
+            # aggregation mode (codec wire only): the validated payload
+            # bytes, a view into the receive buffer
+            grad = self._grad_buf[:n]
+        elif self.wire:
+            grad = self._decode_payload(self._grad_buf[:n])
         else:
-            flat = self._grad_buf[: n // 4].copy()
-            grad = _unflatten(flat, self.template)
+            # the no-codec receive buffer is f32-typed: slice elements
+            grad = self._decode_payload(self._grad_buf[: n // 4])
         return int(worker.value), int(version.value), grad
 
     def reset_worker_slot(self, worker: int) -> None:

@@ -210,7 +210,9 @@ class TcpPSServer(PSServerTelemetry):
 
     def _decode_payload(self, payload: np.ndarray) -> PyTree:
         """Payload bytes (a view into the receive buffer) → gradient
-        tree; shared by the framed and legacy poll paths."""
+        tree; shared by the framed and legacy poll paths. Counted in
+        ``decodes_done`` — the numerator of ``decodes_per_publish``."""
+        self.decodes_done += 1
         if self.wire:
             # zero-copy: decode reads the receive buffer via memoryview
             return self.wire.decode_from_bytes(payload)
@@ -230,7 +232,8 @@ class TcpPSServer(PSServerTelemetry):
                 self._ever_connected.add(w)
                 self.last_seen.setdefault(w, now)
 
-    def _poll_grad_framed(self) -> Optional[Tuple[int, int, PyTree]]:
+    def _poll_grad_framed(self, raw: bool = False
+                          ) -> Optional[Tuple[int, int, PyTree]]:
         """Frame-checking poll — the shared ``frames.framed_poll`` loop
         (validate → reject-and-count → bounded staleness → decode, the
         fix for one misconfigured worker's size-mismatched frame killing
@@ -254,14 +257,23 @@ class TcpPSServer(PSServerTelemetry):
                 self._ever_connected.add(wid)
             return int(n), wid, int(version.value)
 
-        return self._frames.framed_poll(self, pop_once)
+        return self._frames.framed_poll(self, pop_once, raw=raw)
 
-    def poll_grad(self) -> Optional[Tuple[int, int, PyTree]]:
+    def poll_grad(self, raw: bool = False
+                  ) -> Optional[Tuple[int, int, PyTree]]:
         """One pending gradient as (worker, version, grad_tree), or None.
         Pumps the sockets, then drains stale gradients iteratively (same
-        bounded-staleness discipline as the shm server)."""
+        bounded-staleness discipline as the shm server). ``raw=True``
+        (the homomorphic-aggregation mode) skips the decode and returns
+        the validated payload BYTES as a view into the receive buffer —
+        copy or fold before the next poll."""
+        if raw and not self.wire:
+            # without a codec wire the receive buffer is f32-typed and
+            # there is no payload format to hand back — a [:n] slice
+            # would be a silently mis-sized view, not bytes
+            raise ValueError("poll_grad(raw=True) needs a codec wire")
         if self.frame:
-            return self._poll_grad_framed()
+            return self._poll_grad_framed(raw=raw)
         worker = ctypes.c_uint32()
         version = ctypes.c_uint64()
         self._lib.tps_server_pump(self._h)
@@ -303,12 +315,15 @@ class TcpPSServer(PSServerTelemetry):
             if staleness <= self.max_staleness:
                 break
             self.stale_drops += 1
-        if self.wire:
-            # zero-copy: decode reads the receive buffer via memoryview
-            grad = self.wire.decode_from_bytes(self._grad_buf[:n])
+        if raw:
+            # aggregation mode (codec wire only): the validated payload
+            # bytes, a view into the receive buffer
+            grad = self._grad_buf[:n]
+        elif self.wire:
+            grad = self._decode_payload(self._grad_buf[:n])
         else:
-            flat = self._grad_buf[: n // 4].copy()
-            grad = _unflatten(flat, self.template)
+            # the no-codec receive buffer is f32-typed: slice elements
+            grad = self._decode_payload(self._grad_buf[: n // 4])
         return int(worker.value), int(version.value), grad
 
     def connected(self, worker: int) -> bool:

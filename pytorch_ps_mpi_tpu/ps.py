@@ -418,6 +418,23 @@ def _accumulate_grads(loss_fn, accum_steps: int, params: PyTree,
     return reduce_loss(losses.mean()), grads
 
 
+def decode_sum_payloads(code: Codec, gathered: PyTree, shape, dtype):
+    """The ONE payload-summing call site discipline (used by
+    :func:`aggregate`, :func:`bucketed_aggregate` and the instrumented
+    decode stage): route through the codec's compressed-domain
+    ``Codec.aggregate`` algebra when it is EXACT — sum in the integer /
+    sparse-index / factor domain, then decode once — and fall back to
+    ``decode_sum`` otherwise. Approximate algebras (sign's vote counts,
+    ``agg_exact=False``) never enter the training path implicitly; they
+    ride only the host wire, behind the measured fidelity contract."""
+    if (getattr(code, "supports_aggregate", False)
+            and getattr(code, "agg_exact", True)
+            and code.can_aggregate(shape, dtype)):
+        agg_payload, meta = code.aggregate(gathered, shape, dtype)
+        return code.agg_decode(agg_payload, meta, shape, dtype)
+    return code.decode_sum(gathered, shape, dtype)
+
+
 def aggregate(
     code: Codec,
     grads: PyTree,
@@ -482,7 +499,8 @@ def aggregate(
                 gathered = jax.tree.map(
                     lambda x: lax.all_gather(x, axes), payload
                 )
-            summed_leaves.append(code.decode_sum(gathered, g.shape, g.dtype))
+            summed_leaves.append(
+                decode_sum_payloads(code, gathered, g.shape, g.dtype))
     if average:
         summed_leaves = [x / n for x, n in zip(summed_leaves, sizes)]
     return jax.tree.unflatten(treedef, summed_leaves)
@@ -540,7 +558,8 @@ def bucketed_aggregate(
             gathered = jax.tree.map(
                 lambda x: lax.all_gather(x, axis_name), payload
             )
-            summed_b.append(code.decode_sum(gathered, b.shape, b.dtype))
+            summed_b.append(
+                decode_sum_payloads(code, gathered, b.shape, b.dtype))
     if average:
         summed_b = [x / size for x in summed_b]
     return unflatten_from_buckets(plan, summed_b)
@@ -1495,7 +1514,7 @@ class MPI_PS:
                 lambda gathered: jax.tree.unflatten(
                     jax.tree.structure(self.params),
                     [
-                        self.code.decode_sum(pl, p.shape, p.dtype)
+                        decode_sum_payloads(self.code, pl, p.shape, p.dtype)
                         for p, pl in zip(
                             jax.tree.leaves(self.params),
                             jax.tree.structure(self.params).flatten_up_to(gathered),

@@ -198,7 +198,8 @@ def read_lineage(buf: np.ndarray) -> Tuple[int, int, float]:
 
 
 def framed_poll(
-    server, pop_once: Callable[[], Tuple[int, int, int]]
+    server, pop_once: Callable[[], Tuple[int, int, int]],
+    raw: bool = False,
 ) -> Optional[Tuple[int, int, PyTree]]:
     """The ONE frame-checking poll loop both PS transports share (the
     transports differ only in how a frame is popped — ``pop_once``
@@ -215,7 +216,14 @@ def framed_poll(
     send_wall from the header; recv time, staleness, decode wall
     measured here) feed ``server.lineage_tracker`` when one is attached
     and land on ``server.last_push_meta`` either way, so the serve loop
-    can read the consumed push's trace ID without re-parsing anything."""
+    can read the consumed push's trace ID without re-parsing anything.
+
+    ``raw=True`` is the homomorphic-aggregation mode: a consumed push is
+    returned as ``(worker, version, payload_view)`` — validated, counted
+    and lineage-fed exactly as above, but NOT decoded (the serve loop
+    folds the bytes into a compressed accumulator and the one decode per
+    published version happens there). The view aliases the server's
+    receive buffer: copy or fold before the next poll."""
     lt = getattr(server, "lineage_tracker", None)
     while True:
         n, wid, version = pop_once()
@@ -246,8 +254,12 @@ def framed_poll(
         }
         if staleness <= server.max_staleness:
             t_dec = time.monotonic()
-            grad = server._decode_payload(payload)
-            meta["decode_s"] = round(time.monotonic() - t_dec, 6)
+            if raw:
+                grad = payload
+                meta["decode_s"] = 0.0  # deferred to the round's ONE decode
+            else:
+                grad = server._decode_payload(payload)
+                meta["decode_s"] = round(time.monotonic() - t_dec, 6)
             server.last_push_meta = meta
             # the server-side anchor of the cross-process flow arrow:
             # a span carrying the same (worker, step, seq) trace ID the

@@ -463,7 +463,11 @@ class HealthMonitor:
             m = ps_server_metrics(self.server)
             fleet.update({k: m[k] for k in (
                 "grads_received", "stale_drops",
-                "staleness_p50", "staleness_p95", "staleness_p99")})
+                "staleness_p50", "staleness_p95", "staleness_p99",
+                # homomorphic-aggregation rollup: mode flag, decodes per
+                # gradient-composed publish (1.0 = compressed-domain
+                # rounds), explicit-request fallbacks
+                "agg_mode", "decodes_per_publish", "agg_fallbacks")})
         out = {
             "armed": True,
             "t_wall": time.time(),

@@ -329,6 +329,18 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "lineage_pushes",
     "push_e2e_p50_ms",
     "push_e2e_p95_ms",
+    # homomorphic aggregation (Codec.aggregate + the CodecWire
+    # aggregator): agg_mode is 1.0 while the serve loop folds pushes
+    # into a compressed accumulator (0.0 unarmed); decodes_per_publish
+    # is decodes over gradient-composed publishes (== 1.0 in aggregation
+    # mode, ~world-size on the sync decode-sum path, ALSO 1.0 on the
+    # async path where every push publishes — read it WITH agg_mode,
+    # 0.0 before any publish); agg_fallbacks counts pushes that took
+    # the decode-sum
+    # path while cfg["agg"] == "on" explicitly requested aggregation
+    "agg_mode",
+    "decodes_per_publish",
+    "agg_fallbacks",
     # parameter-serving read tier (serving.ServingCore): all 0.0 when the
     # read tier is unarmed. reads_total counts read-tier requests served
     # (plus, on TCP, the transport's own native GET_PARAMS worker reads);
@@ -418,6 +430,12 @@ def ps_server_metrics(server) -> Dict[str, float]:
             nm.codec_rel_error if nm is not None else 0.0),
         "ef_residual_norm": float(
             nm.ef_residual_norm if nm is not None else 0.0),
+        "agg_mode": float(getattr(server, "agg_mode", 0.0)),
+        "decodes_per_publish": (
+            float(getattr(server, "decodes_done", 0))
+            / max(1.0, float(getattr(server, "grad_publishes", 0)))
+            if getattr(server, "grad_publishes", 0) else 0.0),
+        "agg_fallbacks": float(getattr(server, "agg_fallbacks", 0)),
         "lineage_pushes": float(lt.composed if lt is not None else 0.0),
         "push_e2e_p50_ms": float(
             lt.e2e_ms_quantile(0.50) if lt is not None else 0.0),
@@ -481,6 +499,17 @@ def ps_server_registry(
                 "contiguous payload buffers one push ships "
                 "(buckets when bucketing, leaves otherwise)").set(
                     m["wire_units_per_push"])
+        r.gauge("ps_agg_mode",
+                "1 while the serve loop aggregates pushes in the "
+                "compressed domain (Codec.aggregate)").set(m["agg_mode"])
+        r.gauge("ps_decodes_per_publish",
+                "payload decodes per gradient-composed publish (~world "
+                "on the sync decode-sum path; 1 under aggregation AND "
+                "on the per-push async path — aggregation is armed only "
+                "when ps_agg_mode is 1)").set(m["decodes_per_publish"])
+        r.counter("ps_agg_fallbacks_total",
+                  "pushes consumed via decode-sum while aggregation was "
+                  "explicitly requested").set(m["agg_fallbacks"])
         nat_total, nat_nm = getattr(server, "_native_read_stats", (0, 0))
         r.counter("ps_native_reads_total",
                   "transport-level worker snapshot reads (GET_PARAMS)"
@@ -527,6 +556,20 @@ class PSServerTelemetry:
     _telemetry_registry: Optional[MetricsRegistry] = None
     #: total self-verifying frames rejected (all workers)
     frames_rejected_total: int = 0
+    #: payload decodes performed (per consumed push on the decode-sum
+    #: path, ONE per round under homomorphic aggregation) — incremented
+    #: by the transports' ``_decode_payload`` and by the serve loop's
+    #: round finalize; numerator of ``decodes_per_publish``
+    decodes_done: int = 0
+    #: gradient-composed publishes (the serve loop's ``_post_update``
+    #: site; the initial parameter publish is excluded) — denominator of
+    #: ``decodes_per_publish``
+    grad_publishes: int = 0
+    #: 1.0 while the serve loop's compressed-domain aggregation is armed
+    agg_mode: float = 0.0
+    #: pushes consumed via decode-sum while ``cfg["agg"] == "on"``
+    #: explicitly requested aggregation (auto-fallback visibility)
+    agg_fallbacks: int = 0
     #: the attached online-diagnosis monitor (``/health``'s source),
     #: set by ``serve()`` when health is armed — see :mod:`.diagnosis`
     health_monitor: Optional[Any] = None

@@ -21,6 +21,13 @@ Usage::
   python tools/ps_top.py 9100 --interval 0.5          # localhost port
   python tools/ps_top.py 9100 --once                  # one frame, no tty
 
+The summary line carries the homomorphic-aggregation rollup when the
+server reports it: ``agg=on/off`` (compressed-domain rounds armed),
+``dec/pub`` (payload decodes per gradient-composed publish — 1.00 under
+aggregation, ~world-size on the decode-sum path) and ``agg_fb`` (pushes
+that fell back to decode-sum while aggregation was explicitly
+requested).
+
 When the parameter-serving read tier is armed the frame grows a
 ``serving`` block: a reader rollup line (reads/s, read p50/p95, shed,
 coalesce hits, queue depth) and one row per tenant namespace (ring
@@ -86,6 +93,17 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
     if not health.get("armed", False):
         return ("health monitor not armed on this server "
                 "(run with health/health_dir/health_port configured)")
+    # homomorphic-aggregation rollup: agg=on means the serve loop sums
+    # pushes in the compressed domain; dec/pub is decodes per gradient-
+    # composed publish (1.00 in aggregation mode, ~world on decode-sum)
+    agg_bits = ""
+    if "decodes_per_publish" in fleet:
+        agg_bits = (
+            f"agg={'on' if fleet.get('agg_mode') else 'off'}  "
+            f"dec/pub={fleet.get('decodes_per_publish', 0):.2f}  "
+        )
+        if fleet.get("agg_fallbacks"):
+            agg_bits += f"agg_fb={int(fleet['agg_fallbacks'])}  "
     lines.append(
         f"ps_top  workers={health.get('n_workers')}  "
         f"grads={int(fleet.get('grads_received', 0))}  "
@@ -94,6 +112,7 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
         f"{fleet.get('staleness_p50', 0):.1f}/"
         f"{fleet.get('staleness_p95', 0):.1f}/"
         f"{fleet.get('staleness_p99', 0):.1f}  "
+        f"{agg_bits}"
         f"anomalies={fleet.get('anomaly_total', 0)}  "
         f"rounds={fleet.get('rounds', 0)}  "
         f"up={health.get('uptime_s', 0):.0f}s"
