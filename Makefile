@@ -11,6 +11,7 @@ test:
 	$(MAKE) trace-smoke
 	$(MAKE) read-smoke
 	$(MAKE) agg-smoke
+	$(MAKE) native-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -108,8 +109,10 @@ agg-bench:
 	JAX_PLATFORMS=cpu python benchmarks/agg_bench.py
 	python tools/bench_gate.py \
 		--trajectory benchmarks/results/agg_bench.jsonl \
-		--metric 'agg_bench.sparse_flat_ratio:lower:0.5' \
-		--metric 'agg_bench.int_speedup_min_x:higher:0.5'
+		--metric 'agg_bench.sparse_flat_ratio:lower:1.0' \
+		--metric 'agg_bench.int_speedup_min_x:higher:0.5' \
+		--metric 'agg_bench.native_fold_speedup_int8_x:higher:0.5' \
+		--metric 'agg_bench.native_push_speedup_topk_x:higher:0.5'
 
 # Read-tier load bench: open-loop fleet of simulated readers — delta
 # bytes economics (>=5x reduction gate), saturation sweep with bounded
@@ -131,10 +134,27 @@ bench:
 tpu-watch:
 	python tools/tpu_watch.py
 
+# -ffp-contract=off: the wc_fold_* kernels may not fuse multiply+add
+# into FMAs — bit-exact parity with the numpy fallback (enforced by
+# tests/test_native_fold.py and the native-smoke gate) pins separate
+# f32 rounding. utils/native.py passes the same flag when it builds
+# these libraries on demand.
 native:
-	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libwirecodec.so native/wirecodec.cpp -lrt
-	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp -lrt
-	g++ -O3 -std=c++17 -shared -fPIC -o native/_build/libtcpps.so native/tcpps.cpp -lrt
+	mkdir -p native/_build
+	g++ -O3 -std=c++17 -ffp-contract=off -shared -fPIC -o native/_build/libwirecodec.so native/wirecodec.cpp -lrt
+	g++ -O3 -std=c++17 -ffp-contract=off -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp -lrt
+	g++ -O3 -std=c++17 -ffp-contract=off -shared -fPIC -o native/_build/libtcpps.so native/tcpps.cpp -lrt
+
+# Native fast-path gate (in the default `make test` path): both
+# libraries must build and load with the fold/batch entry points, every
+# fold-family codec must be BIT-exact native-vs-numpy over real
+# CodecWire rounds, a live TcpPSServer must drain framed pushes through
+# the C++ batched ingest (and reason-count a corrupt frame), and the
+# native int8 fold must beat the numpy fallback >=1.5x at 1M elements.
+# Appends a bench_gate trajectory row to
+# benchmarks/results/native_smoke.jsonl.
+native-smoke:
+	JAX_PLATFORMS=cpu python tools/native_smoke.py
 
 # CPU-runnable protocol/convergence benches (the TPU-window stages run
 # via tpu-watch); each emits JSON lines for benchmarks/results/
@@ -145,4 +165,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke

@@ -41,8 +41,10 @@ CODECS = [  # (label, registry name, kwargs)
 
 # codecs with a Pallas kernel AND a jnp fallback: measure both and report
 # the Mosaic-kernel speedup (VERDICT r1 item 2 — only meaningful on TPU,
-# where use_pallas=True lowers through Mosaic instead of the interpreter)
-PALLAS_PAIRS = ["int8", "sign"]
+# where use_pallas=True lowers through Mosaic instead of the interpreter).
+# sign and terngrad use the PR 9 fused encode+pack kernels (one VMEM
+# pass instead of reduce-then-pack).
+PALLAS_PAIRS = ["int8", "sign", "terngrad"]
 
 
 def bench_codec(name, kw, n, k=None):
@@ -112,9 +114,48 @@ def main():
                 f"| {name} | {pt*1e3:.2f} | {jt*1e3:.2f} "
                 f"| {safe_ratio(jt, pt):.2f}x |"
             )
+        # ISSUE 9 acceptance: the exact top-k Pallas selection
+        # (threshold refine + chunked compaction, no full sort) must
+        # land within 2× of approx_max_k at this size — lax.top_k's
+        # full bitonic sort measured 5.5× over approx at 8M on v5e.
+        try:
+            pe, _ = bench_codec("topk", {"fraction": 0.01, "pallas": True}, n)
+            ax, _ = bench_codec("topk",
+                                {"fraction": 0.01, "approx": True}, n)
+            st, _ = bench_codec("topk", {"fraction": 0.01}, n)
+            ratio = pe / max(ax, 1e-12)
+            print(f"topk exact selection: pallas {pe*1e3:.2f} ms, "
+                  f"lax.top_k sort {st*1e3:.2f} ms, approx "
+                  f"{ax*1e3:.2f} ms — exact/approx {ratio:.2f}x (gate 2x)")
+            if ratio > 2.0:
+                print(f"FAIL: exact top-k Pallas encode {ratio:.1f}x over "
+                      f"approx (gate 2x)")
+                return 1
+        except Exception as e:
+            msg = (str(e).splitlines() or [""])[0][:120]
+            print(f"topk exact-vs-approx aborted: {type(e).__name__}: {msg}")
     else:
         print("(pallas-vs-jnp column skipped: kernels run interpreted off-TPU)")
 
+    # threshold-compaction regression guard (ISSUE 9): the unchunked
+    # sort compaction ran a bitonic network of depth log²(n) over the
+    # WHOLE tensor — 619–1613 ms on the BERT flat grad vs 17.8 ms for
+    # exact top-k on the same bytes (tpu_v5e 2026-07-31 sweep), a 35×
+    # gap that scaled superlinearly. The chunked compaction bounds the
+    # sort width, so threshold enc+dec must now stay within one
+    # moderate factor of top-k at any size: 10× — the TPU sort path
+    # sits at ~2× post-fix and the CPU scatter path at ~5.5×, while
+    # the pre-fix pathology measured 35× and grew with n.
+    by = {r["codec"]: r["enc_dec_ms_device"] for r in rows}
+    thr_ratio = by["threshold"] / max(by["topk"], 1e-9)
+    print(f"threshold/topk enc+dec ratio: {thr_ratio:.2f}x (gate 10x)")
+    if thr_ratio > 10.0:
+        print(f"FAIL: threshold compaction regressed — enc+dec "
+              f"{by['threshold']} ms is {thr_ratio:.1f}x top-k's "
+              f"{by['topk']} ms (gate 10x; see ThresholdCodec chunk=)")
+        return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

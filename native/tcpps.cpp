@@ -49,6 +49,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
+#include <sys/epoll.h>
 #include <sys/socket.h>
 #include <time.h>
 #include <unistd.h>
@@ -87,6 +88,7 @@ struct GradMsg {
 struct Conn {
   int fd = -1;
   int32_t worker = -1;  // -1 until HELLO
+  bool dead = false;    // EOF/error seen in the read phase
   std::vector<uint8_t> rx;
   std::vector<uint8_t> tx;
 };
@@ -104,6 +106,16 @@ struct Server {
   // Python server's scrape registry via tps_server_read_stats)
   uint64_t reads_total = 0;
   uint64_t reads_not_modified = 0;
+  // epoll-batched ingest: readiness-driven accept + recv so an idle
+  // fleet costs zero syscalls per pump beyond one epoll_wait. -1 =
+  // epoll unavailable, fall back to the full-sweep recv loop.
+  int epfd = -1;
+  // inner PSF2 frame validation (tps_server_set_frame_check): CRC32 +
+  // config fingerprint checked in C++ by the batched pop, so the serve
+  // loop receives only validated payload views
+  int frame_check = 0;
+  uint64_t fingerprint = 0;
+  uint64_t expected_payload = 0;
 };
 
 struct Worker {
@@ -193,9 +205,140 @@ size_t queue_cap(const Server* s) { return 4 * (size_t)s->n_workers + 16; }
 
 void close_conn(Server* s, size_t i) {
   Conn* c = s->conns[i];
-  if (c->fd >= 0) close(c->fd);
+  if (c->fd >= 0) {
+    if (s->epfd >= 0) epoll_ctl(s->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+  }
   delete c;
   s->conns.erase(s->conns.begin() + i);
+}
+
+// Drain one connection's socket into its rx buffer (up to the per-conn
+// memory bound); sets c->dead on EOF/error. Returns progress events.
+int read_conn(Server* s, Conn* c) {
+  int events = 0;
+  // per-conn memory bound: once a full max-size frame is buffered
+  // (possible only while the grad queue back-pressures), stop reading
+  // until handle_frames consumes it
+  while (c->rx.size() <= sizeof(FrameHdr) + s->max_msg) {
+    uint8_t buf[65536];
+    ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+    if (r > 0) {
+      c->rx.insert(c->rx.end(), buf, buf + r);
+      ++events;
+      continue;
+    }
+    if (r == 0) c->dead = true;  // EOF
+    else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+      c->dead = true;
+    break;
+  }
+  return events;
+}
+
+// Accept every pending connection; registers with epoll when armed.
+int accept_all(Server* s) {
+  int events = 0;
+  for (;;) {
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) break;
+    set_nonblock(fd);
+    set_nodelay(fd);
+    Conn* c = new Conn();
+    c->fd = fd;
+    s->conns.push_back(c);
+    if (s->epfd >= 0) {
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.ptr = c;
+      epoll_ctl(s->epfd, EPOLL_CTL_ADD, fd, &ev);
+    }
+    ++events;
+  }
+  return events;
+}
+
+// ---- CRC32 (zlib-compatible: poly 0xEDB88320, init/xorout 0xFFFFFFFF),
+// for the in-C++ PSF2 inner-frame validation of the batched pop --------
+
+const uint32_t* crc32_table() {
+  static uint32_t table[256];
+  static bool init = [] {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    return true;
+  }();
+  (void)init;
+  return table;
+}
+
+uint32_t crc32_of(const uint8_t* p, size_t n) {
+  const uint32_t* t = crc32_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---- PSF2 inner frame (resilience/frames.py, v2 36-byte header) -----------
+
+constexpr uint32_t kPsfMagicV2 = 0x32465350;  // "PSF2"
+constexpr uint32_t kPsfMagicV1 = 0x31465350;  // "PSF1" — rejected "version"
+constexpr size_t kPsfHeader = 36;
+
+// Rejection reason codes shared with the Python side (tcp.py maps them
+// back to frames.open_frame's reason strings).
+enum FrameStatus : uint32_t {
+  FRAME_OK = 0,
+  FRAME_SHORT = 1,
+  FRAME_VERSION = 2,
+  FRAME_MAGIC = 3,
+  FRAME_SIZE = 4,
+  FRAME_CONFIG = 5,
+  FRAME_CORRUPT = 6,
+};
+
+#pragma pack(push, 1)
+struct PsfHeader {
+  uint32_t magic;
+  uint32_t payload_len;
+  uint32_t crc;
+  uint64_t fingerprint;
+  uint32_t step;
+  uint32_t seq;
+  double send_wall;
+};
+#pragma pack(pop)
+static_assert(sizeof(PsfHeader) == kPsfHeader, "PSF2 header must be 36 B");
+
+// Validate one queued message against the armed wire agreement —
+// EXACTLY frames.open_frame's checks in the same order. On FRAME_OK,
+// *payload/*plen point into the message.
+uint32_t validate_frame(const Server* s, const GradMsg& m,
+                        const uint8_t** payload, uint64_t* plen,
+                        PsfHeader* hdr_out) {
+  const uint8_t* b = m.bytes.data();
+  size_t n = m.bytes.size();
+  if (n < 4) return FRAME_SHORT;
+  uint32_t magic;
+  std::memcpy(&magic, b, 4);
+  if (magic == kPsfMagicV1) return FRAME_VERSION;
+  if (magic != kPsfMagicV2) return FRAME_MAGIC;
+  if (n < kPsfHeader) return FRAME_SHORT;
+  PsfHeader h;
+  std::memcpy(&h, b, sizeof(h));
+  if (h.payload_len != n - kPsfHeader ||
+      (s->expected_payload && h.payload_len != s->expected_payload))
+    return FRAME_SIZE;
+  if (h.fingerprint != s->fingerprint) return FRAME_CONFIG;
+  if (crc32_of(b + kPsfHeader, h.payload_len) != h.crc) return FRAME_CORRUPT;
+  *payload = b + kPsfHeader;
+  *plen = h.payload_len;
+  if (hdr_out) *hdr_out = h;
+  return FRAME_OK;
 }
 
 // Parse every complete frame in c->rx; returns false on protocol error
@@ -332,7 +475,31 @@ void* tps_server_create(uint16_t port, uint32_t n_workers, uint64_t max_msg) {
   s->port = ntohs(addr.sin_port);
   s->n_workers = n_workers;
   s->max_msg = max_msg;
+  // epoll instance for readiness-batched ingest; a failed create means
+  // the pump falls back to the original full sweep (same semantics)
+  s->epfd = epoll_create1(0);
+  if (s->epfd >= 0) {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = nullptr;  // nullptr marks the listener
+    if (epoll_ctl(s->epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
+      close(s->epfd);
+      s->epfd = -1;
+    }
+  }
   return s;
+}
+
+// Arm C++-side validation of the inner PSF2 frame for the batched pop:
+// the Python server passes its wire fingerprint + expected payload size
+// once at construction, and tps_server_pop_grad_batch then rejects bad
+// frames (reason-coded) without the bytes ever crossing into Python.
+void tps_server_set_frame_check(void* sv, uint64_t fingerprint,
+                                uint64_t expected_payload) {
+  Server* s = (Server*)sv;
+  s->frame_check = 1;
+  s->fingerprint = fingerprint;
+  s->expected_payload = expected_payload;
 }
 
 uint16_t tps_server_port(void* sv) { return ((Server*)sv)->port; }
@@ -349,38 +516,50 @@ int tps_server_publish(void* sv, const uint8_t* buf, uint64_t len,
 
 // One non-blocking sweep: accept, read, parse, reply, flush. Returns the
 // number of complete frames/connection events progressed (0 = idle).
+//
+// With epoll armed (the default) the accept+recv phase is readiness-
+// driven: ONE epoll_wait(0) names exactly the sockets with pending
+// bytes, and only those pay a recv() syscall — an idle fleet member
+// costs nothing per pump, where the old full sweep paid one EAGAIN
+// recv per connection per call. The parse/flush phase still walks all
+// connections (pure memory ops unless a reply is owed): a conn whose
+// buffered frame was deferred by grad-queue back-pressure has no
+// kernel event to re-announce it, so readiness alone must never gate
+// handle_frames.
 int tps_server_pump(void* sv) {
   Server* s = (Server*)sv;
   int events = 0;
-  for (;;) {  // accept everything pending
-    int fd = accept(s->listen_fd, nullptr, nullptr);
-    if (fd < 0) break;
-    set_nonblock(fd);
-    set_nodelay(fd);
-    Conn* c = new Conn();
-    c->fd = fd;
-    s->conns.push_back(c);
-    ++events;
+  if (s->epfd >= 0) {
+    epoll_event evs[64];
+    for (;;) {
+      int ne = epoll_wait(s->epfd, evs, 64, 0);
+      if (ne <= 0) break;
+      int pass_events = 0;
+      for (int e = 0; e < ne; ++e) {
+        if (evs[e].data.ptr == nullptr) {
+          pass_events += accept_all(s);
+        } else {
+          Conn* c = (Conn*)evs[e].data.ptr;
+          pass_events += read_conn(s, c);
+        }
+      }
+      events += pass_events;
+      // exit on a short pass (every ready fd seen) OR a no-progress
+      // pass: level-triggered epoll re-reports conns parked at the
+      // per-conn rx memory bound, and with 64+ of those the event
+      // count alone would never drop below the batch size — only the
+      // parse phase below can free their buffers, so spinning here
+      // would hang the server at 100% CPU
+      if (ne < 64 || pass_events == 0) break;
+    }
+  } else {
+    events += accept_all(s);
+    for (Conn* c : s->conns)
+      if (!c->dead) events += read_conn(s, c);
   }
   for (size_t i = 0; i < s->conns.size();) {
     Conn* c = s->conns[i];
-    bool dead = false;
-    // per-conn memory bound: once a full max-size frame is buffered
-    // (possible only while the grad queue back-pressures), stop reading
-    // until handle_frames consumes it
-    while (c->rx.size() <= sizeof(FrameHdr) + s->max_msg) {
-      uint8_t buf[65536];
-      ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
-      if (r > 0) {
-        c->rx.insert(c->rx.end(), buf, buf + r);
-        ++events;
-        continue;
-      }
-      if (r == 0) dead = true;  // EOF
-      else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-        dead = true;
-      break;
-    }
+    bool dead = c->dead;
     if (!dead && !handle_frames(s, c)) dead = true;  // protocol error
     if (!dead && !c->tx.empty()) {                   // flush replies
       ssize_t w = send(c->fd, c->tx.data(), c->tx.size(), MSG_NOSIGNAL);
@@ -396,6 +575,75 @@ int tps_server_pump(void* sv) {
     else ++i;
   }
   return events;
+}
+
+// Per-frame record of the batched pop (mirrored by ctypes in tcp.py).
+#pragma pack(push, 1)
+struct BatchMeta {
+  uint32_t worker;
+  uint32_t status;   // FrameStatus: 0 ok, else the rejection reason
+  uint64_t version;
+  uint64_t off;      // payload offset into the batch buffer (ok only)
+  uint64_t len;      // payload byte length (0 when rejected)
+  uint32_t step;     // PSF2 lineage fields (0 unless frame_check hit ok)
+  uint32_t seq;
+  double send_wall;
+};
+#pragma pack(pop)
+static_assert(sizeof(BatchMeta) == 48, "BatchMeta must be 48 bytes");
+
+// Batched pop: drain up to max_frames queued gradients in ONE call,
+// validating each inner PSF2 frame in C++ when armed
+// (tps_server_set_frame_check) — magic/version, declared vs expected
+// size, config fingerprint, CRC32 — and packing only the VALIDATED
+// payload bytes contiguously into buf. Rejected frames produce a
+// reason-coded meta and no bytes; Python turns them into the same
+// counted per-worker rejections frames.framed_poll produces. Returns
+// the number of metas filled (0 = nothing queued); stops early when
+// the next payload would not fit in cap (that frame stays queued).
+int tps_server_pop_grad_batch(void* sv, uint8_t* buf, uint64_t cap,
+                              BatchMeta* metas, int max_frames) {
+  Server* s = (Server*)sv;
+  int n = 0;
+  uint64_t off = 0;
+  while (n < max_frames && !s->grads.empty()) {
+    GradMsg& m = s->grads.front();
+    BatchMeta& meta = metas[n];
+    meta.worker = m.worker;
+    meta.version = m.version;
+    meta.step = 0;
+    meta.seq = 0;
+    meta.send_wall = 0.0;
+    const uint8_t* payload = m.bytes.data();
+    uint64_t plen = m.bytes.size();
+    uint32_t status = FRAME_OK;
+    if (s->frame_check) {
+      PsfHeader h{};
+      status = validate_frame(s, m, &payload, &plen, &h);
+      if (status == FRAME_OK) {
+        meta.step = h.step;
+        meta.seq = h.seq;
+        meta.send_wall = h.send_wall;
+      }
+    }
+    if (status != FRAME_OK) {
+      meta.status = status;
+      meta.off = 0;
+      meta.len = 0;
+      s->grads.pop_front();
+      ++n;
+      continue;
+    }
+    if (off + plen > cap) break;  // no room: frame stays queued
+    std::memcpy(buf + off, payload, plen);
+    meta.status = FRAME_OK;
+    meta.off = off;
+    meta.len = plen;
+    off += plen;
+    s->grads.pop_front();
+    ++n;
+  }
+  return n;
 }
 
 // Pop one queued gradient (FIFO arrival order). Returns byte length >0
@@ -449,6 +697,7 @@ void tps_server_close(void* sv) {
   if (!s) return;
   for (size_t i = s->conns.size(); i-- > 0;) close_conn(s, i);
   if (s->listen_fd >= 0) close(s->listen_fd);
+  if (s->epfd >= 0) close(s->epfd);
   delete s;
 }
 

@@ -1,4 +1,4 @@
-// wirecodec: host-side wire compression for checkpoint/metadata buffers.
+// wirecodec: host-side wire compression + homomorphic fold kernels.
 //
 // The TPU-native framework's answer to the reference's c-blosc dependency
 // (reference mpi_comms.py:18-30 reached blosc through python bindings; this
@@ -16,12 +16,123 @@
 //
 // Format of rle0: repeated [zero_run varint][lit_len varint][lit bytes].
 // Varints are LEB128. Worst case output = input + 16.
+//
+// -- wc_fold_*: fused decode+accumulate (the serve loop's hot path) --------
+//
+// One kernel per compressed-domain algebra family (codecs/base.py): each
+// folds ONE worker's payload into the round accumulator in a single pass
+// over the payload — dequantize-multiply-add fused, so the f32
+// "decoded tensor" intermediate the numpy fallback materializes
+// (multiply into tmp, then add) never exists. Auto-vectorized by -O3;
+// compiled with -ffp-contract=off (utils/native.py passes it) so the
+// separate multiply and add match the numpy fallback BIT-EXACTLY — an
+// FMA-contracted fold would be more accurate but would break the
+// native==numpy parity contract the tests pin.
 
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
 
 extern "C" {
+
+// acc[i] += scale * q[i] — int8/qsgd scale-folded integer family.
+void wc_fold_scaled_i8(float* acc, const int8_t* q, float scale, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    float v = (float)q[i] * scale;
+    acc[i] += v;
+  }
+}
+
+// acc[i] += scale * (digit_i - 1) — terngrad base-4 2-bit unpack + MA.
+// packed holds 4 ternary digits {0,1,2} per byte, weights 1/4/16/64.
+void wc_fold_tern(float* acc, const uint8_t* packed, float scale, size_t n) {
+  size_t full = n / 4;
+  for (size_t b = 0; b < full; ++b) {
+    uint8_t p = packed[b];
+    float* a = acc + b * 4;
+    // digits decoded branch-free; separate mul+add per element (see
+    // the -ffp-contract note above)
+    float d0 = (float)((p & 3) - 1);
+    float d1 = (float)(((p >> 2) & 3) - 1);
+    float d2 = (float)(((p >> 4) & 3) - 1);
+    float d3 = (float)(((p >> 6) & 3) - 1);
+    a[0] += d0 * scale;
+    a[1] += d1 * scale;
+    a[2] += d2 * scale;
+    a[3] += d3 * scale;
+  }
+  for (size_t i = full * 4; i < n; ++i) {
+    int digit = (packed[i / 4] >> (2 * (i % 4))) & 3;
+    acc[i] += (float)(digit - 1) * scale;
+  }
+}
+
+// votes[i] += bit_i — sign popcount vote counts (bitorder 'little',
+// matching np.unpackbits(bitorder='little') and the jnp pack weights).
+void wc_fold_sign(int32_t* votes, const uint8_t* packed, size_t n) {
+  size_t full = n / 8;
+  for (size_t b = 0; b < full; ++b) {
+    uint8_t p = packed[b];
+    int32_t* v = votes + b * 8;
+    for (int j = 0; j < 8; ++j) v[j] += (p >> j) & 1;
+  }
+  for (size_t i = full * 8; i < n; ++i)
+    votes[i] += (packed[i / 8] >> (i % 8)) & 1;
+}
+
+// acc[idx[j]] += val[j] — sparse (idx, val) merge-fold straight into the
+// dense f32 accumulator. Out-of-range indices (blocktopk's >= n pad-slot
+// picks, mode='drop' semantics) are skipped. Element order preserved, so
+// the accumulation order matches the numpy np.add.at finalize exactly.
+void wc_fold_sparse(float* acc, const float* val, const int32_t* idx,
+                    size_t k, size_t n) {
+  for (size_t j = 0; j < k; ++j) {
+    int32_t i = idx[j];
+    if (i >= 0 && (size_t)i < n) acc[i] += val[j];
+  }
+}
+
+// Scatter-zero for the pooled sparse accumulator: re-zero exactly the
+// entries a previous round's folds touched (same in-range drop rule as
+// wc_fold_sparse), so buffer recycling costs O(touched), not O(n).
+void wc_zero_sparse(float* acc, const int32_t* idx, size_t k, size_t n) {
+  for (size_t j = 0; j < k; ++j) {
+    int32_t i = idx[j];
+    if (i >= 0 && (size_t)i < n) acc[i] = 0.0f;
+  }
+}
+
+// blocktopk8: int8-quantized sparse values with one f32 scale per block
+// of kb survivors — dequantize (q * scale) and scatter-add in one pass.
+void wc_fold_sparse_q8(float* acc, const int8_t* q, const float* scales,
+                       const int32_t* idx, size_t nb, size_t kb, size_t n) {
+  for (size_t b = 0; b < nb; ++b) {
+    float s = scales[b];
+    const int8_t* qb = q + b * kb;
+    const int32_t* ib = idx + b * kb;
+    for (size_t j = 0; j < kb; ++j) {
+      int32_t i = ib[j];
+      float v = (float)qb[j] * s;
+      if (i >= 0 && (size_t)i < n) acc[i] += v;
+    }
+  }
+}
+
+// acc[i] += x[i] — identity/f32 dense fold.
+void wc_fold_dense_f32(float* acc, const float* x, size_t n) {
+  for (size_t i = 0; i < n; ++i) acc[i] += x[i];
+}
+
+// acc[i] += (float)bf16[i] — bf16 payload cast-up fold (a bf16 is the
+// top 16 bits of the equal-valued f32; the cast is exact).
+void wc_fold_dense_bf16(float* acc, const uint16_t* x, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    uint32_t bits = (uint32_t)x[i] << 16;
+    float v;
+    std::memcpy(&v, &bits, 4);
+    acc[i] += v;
+  }
+}
 
 void wc_shuffle(const uint8_t* src, uint8_t* dst, size_t n_elems, size_t elem) {
   for (size_t i = 0; i < n_elems; ++i)

@@ -40,6 +40,7 @@ SURVEY §2.2):
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, Optional, Tuple, Type
 
 import jax
@@ -275,12 +276,100 @@ class Codec:
 # concatenated (values, indices) list — O(payload) per fold, and the one
 # finalize scatter-adds world×k entries into the dense gradient. Pure
 # numpy: the serve loop's per-push cost carries no jit dispatch.
+#
+# With the native fast path (utils/native.fold_lib, PS_NO_NATIVE off),
+# the accumulator is the dense f32 gradient itself and each fold is ONE
+# C++ scatter-add pass over the payload (wc_fold_sparse) — same O(k) per
+# push, no per-push array copies, no finalize concat. Accumulation order
+# (push order, then element order) matches np.add.at over the concat
+# exactly, so the two paths are bit-identical.
+#
+# The dense buffers are POOLED across rounds: allocating + first-touch
+# faulting a fresh zeros(n) costs ~3 ms at 8M elements — it would
+# dominate the whole round and make the "per-push cost is O(payload)"
+# claim false. Instead each round remembers which entries its folds
+# touched, releases the buffer at finalize (or aggregator GC), and the
+# next round scatter-zeroes ONLY those entries on reuse — O(world × k)
+# per round, flat in model size, and bit-identical to a fresh zeros
+# buffer. A buffer is handed out again only once the pool holds at
+# least two (FIFO), so a finalize's returned view stays valid until a
+# LATER agg_begin — the serve loop derives the averaged gradient from
+# it immediately, well inside that window.
 
-def sparse_agg_init() -> Dict[str, Any]:
+_SPARSE_POOL: Dict[int, Any] = {}
+_SPARSE_POOL_LOCK = threading.Lock()
+_SPARSE_POOL_MIN_READY = 2   # buffers that must sit in the pool before reuse
+_SPARSE_POOL_MAX = 4         # kept per size; beyond this they drop to the GC
+
+
+def _sparse_pool_take(n: int):
+    """A recycled dense buffer plus the index arrays its last round's
+    folds touched (the caller re-zeroes exactly those entries), or None
+    (pool cold — caller allocates a fresh zeros)."""
+    with _SPARSE_POOL_LOCK:
+        q = _SPARSE_POOL.get(n)
+        if not q or len(q) < _SPARSE_POOL_MIN_READY:
+            return None
+        return q.pop(0)
+
+
+def _sparse_pool_give(n: int, buf: np.ndarray, touched) -> None:
+    with _SPARSE_POOL_LOCK:
+        q = _SPARSE_POOL.setdefault(n, [])
+        if len(q) < _SPARSE_POOL_MAX:
+            q.append((buf, list(touched)))
+
+
+def sparse_agg_release(acc: Dict[str, Any]) -> None:
+    """Return a native sparse accumulator's dense buffer to the pool
+    (idempotent). Called at finalize and from ``WireAggregator`` GC so
+    abandoned rounds don't leak pool capacity."""
+    # "touched" marks a NATIVE SPARSE acc — scale-fold/dense accs also
+    # carry "acc"+"lib" but their buffers hold arbitrary sums that a
+    # touched-entry zero pass could never clean, so they must never pool
+    if acc.get("lib") is not None and "touched" in acc:
+        buf = acc.pop("acc", None)
+        if buf is not None:
+            _sparse_pool_give(acc["n"], buf, acc.pop("touched"))
+
+
+def sparse_agg_init(shape=None) -> Dict[str, Any]:
+    from pytorch_ps_mpi_tpu.utils import native as _native
+
+    lib = _native.fold_lib() if shape is not None else None
+    if lib is not None:
+        n = int(np.prod(shape)) if shape else 1
+        taken = _sparse_pool_take(n)
+        if taken is None:
+            buf = np.zeros(n, np.float32)
+            ptr = _native._f32(buf)
+        else:
+            buf, dirty = taken
+            ptr = _native._f32(buf)
+            for idx in dirty:  # O(touched) recycle, not O(n)
+                if idx.size:
+                    _native.zero_sparse(lib, buf, idx, acc_ptr=ptr)
+        return {"frames": 0, "acc": buf, "n": n, "lib": lib,
+                "touched": [], "ptr": ptr}
     return {"frames": 0, "values": [], "indices": []}
 
 
 def sparse_agg_fold(acc: Dict[str, Any], values, indices) -> None:
+    lib = acc.get("lib")
+    if lib is not None:
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        # the index copy is retained in `touched` (payload buffers are
+        # transport-owned views) — it is both the C++ argument and the
+        # record of which entries to re-zero when the buffer recycles
+        idx = np.array(indices, np.int32, copy=True).reshape(-1)
+        _native.fold_sparse(
+            lib, acc["acc"],
+            np.ascontiguousarray(values, np.float32).reshape(-1), idx,
+            acc_ptr=acc["ptr"])
+        acc["touched"].append(idx)
+        acc["frames"] += 1
+        return
     acc["values"].append(np.array(values, np.float32,
                                   copy=True).reshape(-1))
     acc["indices"].append(np.array(indices, copy=True).reshape(-1))
@@ -288,6 +377,13 @@ def sparse_agg_fold(acc: Dict[str, Any], values, indices) -> None:
 
 
 def sparse_agg_finalize(acc: Dict[str, Any], shape, dtype) -> np.ndarray:
+    if acc.get("lib") is not None and "touched" in acc:
+        out = acc["acc"].astype(dtype, copy=False).reshape(shape)
+        # release to the pool NOW (not at GC): `out` may be a view of
+        # the buffer, valid until a later agg_begin re-issues it — see
+        # the pool contract above
+        sparse_agg_release(acc)
+        return out
     n = int(np.prod(shape)) if shape else 1
     idx = np.concatenate(acc["indices"]).astype(np.int64)
     val = np.concatenate(acc["values"])
@@ -314,18 +410,26 @@ def dense_agg_finalize(acc: Dict[str, Any], shape, dtype) -> np.ndarray:
 
 # -- shared streaming accumulator for the scale-folded integer family ------
 # (int8 / qsgd / terngrad: decode is scale × integer payload). ONE f32
-# accumulator per unit with a dual fold path: units at or above the
-# crossover fold through the codec's jitted fused kernel (one SIMD
-# dequant-multiply-add pass — numpy's multiply-into-temp + add pays ~3x
-# the memory traffic there); smaller units keep pure numpy, where a jit
-# dispatch would dominate. The per-codec fused kernel stays with the
-# codec; finalize is dense_agg_finalize.
+# accumulator per unit with a three-way fold path, picked at init:
+# native (utils/native.fold_lib — one C++ SIMD dequant-multiply-add pass
+# per push, no jit dispatch, bit-exact to the numpy form) when the fast
+# path is armed; else the codec's jitted fused kernel at or above the
+# crossover (numpy's multiply-into-temp + add pays ~3x the memory
+# traffic there); else pure numpy, where a jit dispatch would dominate.
+# The per-codec fused kernel stays with the codec; finalize is
+# dense_agg_finalize.
 
 FOLD_JIT_MIN = 1 << 16
 
 
 def scalefold_agg_init(shape) -> Dict[str, Any]:
+    from pytorch_ps_mpi_tpu.utils import native as _native
+
     n = int(np.prod(shape)) if shape else 1
+    lib = _native.fold_lib()
+    if lib is not None:
+        return {"frames": 0, "acc": np.zeros(n, np.float32), "n": n,
+                "lib": lib}
     if n >= FOLD_JIT_MIN:
         return {"frames": 0, "acc": jnp.zeros(n, jnp.float32), "n": n,
                 "jit": True}

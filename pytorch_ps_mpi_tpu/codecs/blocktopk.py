@@ -164,12 +164,29 @@ class BlockTopK8Codec(BlockTopKCodec):
         )
 
     def agg_fold(self, acc, payload):
-        # numpy dequant of the int8 survivors (per-block scale), then
-        # the shared sparse concat fold
+        # dequant of the int8 survivors (per-block scale), then the
+        # sparse fold. Native fast path: wc_fold_sparse_q8 fuses the
+        # dequantize-multiply and the scatter-add into one C++ pass over
+        # the payload; otherwise numpy dequant + shared concat fold.
         from pytorch_ps_mpi_tpu.codecs.base import sparse_agg_fold
+        from pytorch_ps_mpi_tpu.utils import native as _native
 
         q = np.asarray(payload["values"])
         scale = np.asarray(payload["scale"], np.float32)
+        lib = acc.get("lib")
+        if lib is not None:
+            # retained copy feeds both the C++ call and the pooled
+            # buffer's re-zero record (see base.py sparse pool)
+            idx = np.array(payload["indices"], np.int32,
+                           copy=True).reshape(-1)
+            _native.fold_sparse_q8(
+                lib, acc["acc"],
+                np.ascontiguousarray(q, np.int8).reshape(-1),
+                np.ascontiguousarray(scale).reshape(-1), idx,
+                acc_ptr=acc["ptr"])
+            acc["touched"].append(idx)
+            acc["frames"] += 1
+            return
         val = (q.reshape(scale.shape[0], -1).astype(np.float32)
                * scale).reshape(-1)
         sparse_agg_fold(acc, val, payload["indices"])

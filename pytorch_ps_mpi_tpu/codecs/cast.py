@@ -61,13 +61,30 @@ class Bf16Codec(Codec):
         return agg_payload.astype(dtype).reshape(shape)
 
     def agg_init(self, shape, dtype):
-        return dense_agg_init(shape)
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        acc = dense_agg_init(shape)
+        # bind once per round, not per push (fold_lib reads the env var
+        # and probes symbols — hot-path money); f16 has no fused kernel
+        acc["lib"] = (_native.fold_lib()
+                      if self.wire_dtype == jnp.bfloat16 else None)
+        return acc
 
     def agg_fold(self, acc, payload):
         # cast up per frame (ml_dtypes handles the bf16/f16 view), then
         # accumulate in f32 — the streaming mirror of decode_sum's
-        # cast-before-sum rule
-        acc["acc"] += np.asarray(payload).reshape(-1).astype(np.float32)
+        # cast-before-sum rule. bf16 payloads have a native fused
+        # cast-up + add (wc_fold_dense_bf16: a bf16 is the top 16 bits
+        # of the equal-valued f32, so the cast is exact and the numpy
+        # astype temp never exists); f16 keeps the numpy path.
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        x = np.asarray(payload).reshape(-1)
+        lib = acc.get("lib")
+        if lib is not None and x.flags.c_contiguous:
+            _native.fold_dense_bf16(lib, acc["acc"], x.view(np.uint16))
+        else:
+            acc["acc"] += x.astype(np.float32)
         acc["frames"] += 1
 
     def agg_finalize(self, acc, shape, dtype):

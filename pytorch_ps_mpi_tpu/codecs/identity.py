@@ -43,10 +43,25 @@ class IdentityCodec(Codec):
         return agg_payload.astype(dtype).reshape(shape)
 
     def agg_init(self, shape, dtype):
-        return dense_agg_init(shape)
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        acc = dense_agg_init(shape)
+        # bind once per round, not per push (fold_lib reads the env var
+        # and probes symbols — hot-path money)
+        acc["lib"] = _native.fold_lib()
+        return acc
 
     def agg_fold(self, acc, payload):
-        acc["acc"] += payload.reshape(-1)
+        import numpy as np
+
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        x = np.asarray(payload).reshape(-1)
+        lib = acc.get("lib") if x.dtype == np.float32 else None
+        if lib is not None and x.flags.c_contiguous:
+            _native.fold_dense_f32(lib, acc["acc"], x)
+        else:
+            acc["acc"] += x
         acc["frames"] += 1
 
     def agg_finalize(self, acc, shape, dtype):

@@ -72,7 +72,17 @@ class _ScaleFoldedInt8(Codec):
 
     def agg_fold(self, acc, payload):
         scale = self._frame_scale(payload)
-        if acc.get("jit"):
+        lib = acc.get("lib")
+        if lib is not None:
+            # native fast path: ONE fused dequant-multiply-add pass in
+            # C++ over the int8 payload view — no temp, no dispatch
+            from pytorch_ps_mpi_tpu.utils import native as _native
+
+            _native.fold_scaled_i8(
+                lib, acc["acc"],
+                np.ascontiguousarray(payload["q"], np.int8).reshape(-1),
+                scale)
+        elif acc.get("jit"):
             acc["acc"] = _fused_scale_fold(
                 acc["acc"], payload["q"].reshape(-1), scale)
         else:

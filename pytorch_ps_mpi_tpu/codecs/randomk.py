@@ -101,7 +101,7 @@ class RandomKCodec(Codec):
 
     # streaming form: shared sparse concat accumulator (O(k) per fold)
     def agg_init(self, shape, dtype):
-        return sparse_agg_init()
+        return sparse_agg_init(shape)
 
     def agg_fold(self, acc, payload):
         sparse_agg_fold(acc, payload["values"], payload["indices"])

@@ -61,12 +61,19 @@ class SignCodec(Codec):
     def encode(self, grad, state=(), rng=None):
         flat = guard_nonfinite(grad.reshape(-1), self.nonfinite, "SignCodec")
         n = flat.shape[0]
-        scale = jnp.mean(jnp.abs(flat))
         if self._pallas_ok(n):
-            from pytorch_ps_mpi_tpu.ops.sign_pallas import pack_signs
+            # fused encode: packed bits + the |g| sum for the scale in
+            # ONE pass over the gradient (ops/sign_pallas.encode_signs)
+            # — half the memory traffic of scale-reduce-then-pack. The
+            # blockwise-sequential sum may differ from jnp.mean in the
+            # last ulps (same config-scoped semantics as the Pallas bit
+            # layout).
+            from pytorch_ps_mpi_tpu.ops.sign_pallas import encode_signs
 
-            packed = pack_signs(flat.astype(jnp.float32))
+            packed, abs_sum = encode_signs(flat.astype(jnp.float32))
+            scale = abs_sum / n
         else:
+            scale = jnp.mean(jnp.abs(flat))
             bits = (flat >= 0).astype(jnp.uint8)
             pad = _packed_len(n) * 8 - n
             bits = jnp.pad(bits, (0, pad)).reshape(-1, 8)
@@ -116,17 +123,30 @@ class SignCodec(Codec):
         return out.astype(dtype).reshape(shape)
 
     def agg_init(self, shape, dtype):
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
         n = int(np.prod(shape)) if shape else 1
+        # bind the native library once per round — the env-var read +
+        # symbol probe in fold_lib() is per-push money on the serve
+        # loop's hot path (same discipline as scalefold/sparse_agg_init)
         return {"frames": 0, "votes": np.zeros(n, np.int32),
-                "scale_sum": 0.0, "n": n}
+                "scale_sum": 0.0, "n": n, "lib": _native.fold_lib()}
 
     def agg_fold(self, acc, payload):
-        # np.unpackbits(bitorder='little') matches the jnp pack weights
-        # [1, 2, 4, ...]; pure integer accumulate — the widened-counter
-        # vote domain
-        bits = np.unpackbits(payload["packed"].reshape(-1),
-                             count=acc["n"], bitorder="little")
-        acc["votes"] += bits
+        # pure integer accumulate — the widened-counter vote domain.
+        # Native fast path: one C++ bit-unpack + vote-count pass
+        # (wc_fold_sign, bitorder 'little' like np.unpackbits and the
+        # jnp pack weights [1, 2, 4, ...]); integer domain, so native
+        # and numpy are identical by construction.
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        lib = acc.get("lib")
+        packed = np.ascontiguousarray(payload["packed"], np.uint8).reshape(-1)
+        if lib is not None:
+            _native.fold_sign(lib, acc["votes"], packed)
+        else:
+            acc["votes"] += np.unpackbits(packed, count=acc["n"],
+                                          bitorder="little")
         acc["scale_sum"] += float(payload["scale"])
         acc["frames"] += 1
 

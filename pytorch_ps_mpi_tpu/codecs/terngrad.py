@@ -59,7 +59,8 @@ class TernGradCodec(Codec):
     supports_aggregate = True
 
     def __init__(self, nonfinite: str = "propagate",
-                 scan_block: int = 1 << 20, scan_threshold: int = 0):
+                 scan_block: int = 1 << 20, scan_threshold: int = 0,
+                 use_pallas: bool = False):
         """``scan_block``/``scan_threshold``: gradients with at least
         ``scan_threshold`` elements (default ``4 * scan_block``) encode
         through a ``lax.scan`` over ``scan_block``-element chunks so XLA
@@ -69,7 +70,19 @@ class TernGradCodec(Codec):
         probability both went [132M] f32). Per-chunk PRNG keys derive
         from the round key by fold-in, so the stream differs from the
         whole-tensor form — irrelevant for an unbiased stochastic codec
-        — while wire format and size are unchanged."""
+        — while wire format and size are unchanged.
+
+        ``use_pallas=True`` routes sizes divisible by 512 through the
+        fused ternarize+pack kernel (``ops/tern_pallas.tern_pack``):
+        compare → digit → base-4 pack in ONE VMEM pass over the
+        gradient and a tile of raw random bits, so the f32 uniform
+        draw, keep mask, and digit tensor never hit HBM. NOTE: the
+        Pallas bit layout groups by sublane (digit s of packed byte
+        [r, lane] holds element r*512 + s*128 + lane) while the jnp
+        path packs 4 consecutive elements per byte — payloads are only
+        self-consistent within one codec configuration, and the native
+        C++ wire fold (flat layout) declines Pallas-layout units (the
+        numpy fold handles both layouts)."""
         # a NaN/Inf element drives the max|g| scale non-finite AND makes
         # its keep-probability NaN (uniform < NaN is False, so the digit
         # silently collapses to 0) — guard per codecs/base.guard_nonfinite
@@ -79,6 +92,18 @@ class TernGradCodec(Codec):
         self.scan_block = int(scan_block)
         self.scan_threshold = (int(scan_threshold) if scan_threshold > 0
                                else 4 * self.scan_block)
+        self.use_pallas = bool(use_pallas)
+
+    def _pallas_ok(self, n: int) -> bool:
+        # 512 = one packed Pallas row (4 sublanes × 128 lanes). Above
+        # the scan threshold the chunks must divide into rows too: with
+        # scan_block % 512 == 0 every full chunk AND the ragged tail
+        # inherit n's divisibility (tail ≡ n mod scan_block), and the
+        # per-chunk packs concatenate into exactly the whole-tensor
+        # Pallas layout (chunks are whole numbers of packed rows)
+        if not (self.use_pallas and n > 0 and n % 512 == 0):
+            return False
+        return n < self.scan_threshold or self.scan_block % 512 == 0
 
     def _digits(self, g, scale, rng):
         """g (any shape) → ternary digits {0,1,2} (uint8, same shape)."""
@@ -96,6 +121,9 @@ class TernGradCodec(Codec):
         def pack_digits(d):
             return (d.reshape(-1, 4) * weights).sum(axis=1).astype(jnp.uint8)
 
+        pallas = self._pallas_ok(n)
+        if pallas:
+            from pytorch_ps_mpi_tpu.ops.tern_pallas import tern_pack
         if n >= self.scan_threshold:
             # chunked encode: scan over scan_block-element slices — the
             # absmax pass AND the Bernoulli/pack pass both run one chunk
@@ -127,22 +155,40 @@ class TernGradCodec(Codec):
                 scale = jnp.maximum(scale, jnp.max(jnp.abs(tail)))
 
             def body(_, i):
-                d = self._digits(chunk(i), scale,
-                                 jax.random.fold_in(rng, i))
-                return 0, pack_digits(d)
+                key = jax.random.fold_in(rng, i)
+                c = chunk(i)
+                if pallas:
+                    # fused compare/digit/pack: per-chunk raw bits are
+                    # the only full-chunk temp (u32, reused across scan
+                    # iterations) — the uniform f32 / keep / digit
+                    # tensors never exist
+                    bits = jax.random.bits(key, (blk,), jnp.uint32)
+                    return 0, tern_pack(c, bits, scale)
+                return 0, pack_digits(self._digits(c, scale, key))
 
             _, packed = jax.lax.scan(body, 0, idxs)
             parts = [packed.reshape(-1)]
             if tail_n:
-                d = self._digits(tail, scale,
-                                 jax.random.fold_in(rng, nb_full))
-                pad = _packed_len(tail_n) * 4 - tail_n
-                parts.append(pack_digits(
-                    jnp.pad(d, (0, pad), constant_values=1)))
+                key = jax.random.fold_in(rng, nb_full)
+                if pallas:
+                    # tail_n ≡ n mod 512 == 0 (see _pallas_ok), so the
+                    # tail packs with the same fused kernel and its
+                    # bytes continue the global sublane layout exactly
+                    bits = jax.random.bits(key, (tail_n,), jnp.uint32)
+                    parts.append(tern_pack(tail, bits, scale))
+                else:
+                    d = self._digits(tail, scale, key)
+                    pad = _packed_len(tail_n) * 4 - tail_n
+                    parts.append(pack_digits(
+                        jnp.pad(d, (0, pad), constant_values=1)))
             packed = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
             return {"packed": packed,
                     "scale": scale.astype(jnp.float32)}, state
         scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-12)
+        if pallas:
+            bits = jax.random.bits(rng, (n,), jnp.uint32)
+            return {"packed": tern_pack(g.reshape(-1), bits, scale),
+                    "scale": scale.astype(jnp.float32)}, state
         # draw the Bernoulli randoms in the gradient's NATIVE shape and
         # flatten only the resulting uint8 digits: fusing a 132M-element
         # threefry with a reshape-derived probability tensor crashes the
@@ -155,11 +201,26 @@ class TernGradCodec(Codec):
         return {"packed": packed, "scale": scale.astype(jnp.float32)}, state
 
     def _unpack(self, packed, n):
-        digits = (packed[:, None] // jnp.asarray(_WEIGHTS, jnp.uint8)[None, :]) % 4
+        if self._pallas_ok(n):
+            # sublane-grouped layout: byte [r, lane] holds digits of
+            # elements r*512 + s*128 + lane — the [rows, 4, 128] digit
+            # cube flattens back to element order
+            digits = (packed.reshape(-1, 128)[:, None, :]
+                      // jnp.asarray(_WEIGHTS, jnp.uint8)[None, :, None]) % 4
+        else:
+            digits = (packed[:, None]
+                      // jnp.asarray(_WEIGHTS, jnp.uint8)[None, :]) % 4
         return digits.reshape(-1)[:n].astype(jnp.int8) - 1  # {-1, 0, +1}
 
     def decode(self, payload, shape, dtype):
         n = int(np.prod(shape)) if shape else 1
+        if self._pallas_ok(n):
+            # fused dequantizing unpack (digits and the ±scale values
+            # never exist separately)
+            from pytorch_ps_mpi_tpu.ops.tern_pallas import tern_unpack
+
+            g = tern_unpack(payload["packed"], payload["scale"])
+            return g.astype(dtype).reshape(shape)
         tern = self._unpack(payload["packed"], n)
         return (tern.astype(dtype) * payload["scale"].astype(dtype)).reshape(shape)
 
@@ -188,9 +249,32 @@ class TernGradCodec(Codec):
 
     def agg_fold(self, acc, payload):
         # base-4 unpack (integer ops), then one per-frame scale-folded
-        # multiply-add into the f32 accumulator; large units run the
-        # jitted fused kernel, small ones pure numpy
+        # multiply-add into the f32 accumulator; the native fast path
+        # fuses unpack + MA into one C++ pass, large units otherwise run
+        # the jitted fused kernel, small ones pure numpy
         packed = payload["packed"].reshape(-1)
+        if self._pallas_ok(acc["n"]):
+            # sublane-grouped Pallas layout: the native kernel and the
+            # jitted fused fold both assume the flat base-4 grouping —
+            # layout-aware numpy unpack + multiply-add instead (still
+            # exact; only the fast paths decline)
+            p = np.ascontiguousarray(packed, np.uint8).reshape(-1, 128)
+            digits = (p[:, None, :]
+                      // np.asarray(_WEIGHTS, np.uint8)[None, :, None]) % 4
+            tern = digits.reshape(-1)[: acc["n"]].astype(np.int8) - 1
+            acc["acc"] = acc["acc"] + (tern.astype(np.float32)
+                                       * np.float32(payload["scale"]))
+            acc["frames"] += 1
+            return
+        lib = acc.get("lib")
+        if lib is not None:
+            from pytorch_ps_mpi_tpu.utils import native as _native
+
+            _native.fold_tern(
+                lib, acc["acc"], np.ascontiguousarray(packed, np.uint8),
+                np.float32(payload["scale"]))
+            acc["frames"] += 1
+            return
         if acc.get("jit"):
             acc["acc"] = _fused_tern_fold(
                 acc["acc"], packed, np.float32(payload["scale"]),

@@ -70,6 +70,7 @@ class ThresholdCodec(Codec):
         target_fraction: float = 0.0,
         eta: float = 0.25,
         compaction: str | None = None,
+        chunk: int = 1 << 16,
     ):
         """Args:
           tau: initial threshold in units of the gradient's mean |g|.
@@ -78,7 +79,7 @@ class ThresholdCodec(Codec):
           target_fraction: if >0, adapt tau so the kept fraction tracks
             this value (tau becomes codec state).
           eta: controller gain for the tau adaptation.
-          compaction: ``'sort'`` compacts survivor indices with one
+          compaction: ``'sort'`` compacts survivor indices with a
             sort — a bitonic network the TPU runs vectorized;
             ``'scatter'`` uses ``jnp.nonzero(size=cap)``, which lowers to
             an n-sized scatter TPUs execute serially but CPUs run cheaply
@@ -88,6 +89,18 @@ class ThresholdCodec(Codec):
             backend: sort on TPU, scatter elsewhere. Both produce
             identical decoded gradients; only the garbage tail beyond
             ``length`` differs (and decode masks it either way).
+          chunk: sort-path tensors with at least ``4 * chunk`` elements
+            compact CHUNKED: one vectorized per-chunk sort over
+            ``[n_chunks, chunk]`` (a bitonic network of depth log²(chunk)
+            instead of log²(n) — the fix for the superlinear 619 ms
+            BERT-flat-grad encode, BENCH_TPU_WATCH) followed by a
+            sequential cursor merge of the per-chunk survivor prefixes
+            (``dynamic_update_slice`` per chunk; each write is a full
+            static-size chunk and the next chunk's write overlap-
+            overwrites the garbage tail, so the merged prefix is exactly
+            the global survivors in index order). Identical decoded
+            payloads to the unchunked sort — only the garbage tail past
+            ``length`` differs. 0 disables chunking.
         """
         if not 0.0 < max_fraction <= 1.0:
             raise ValueError(f"max_fraction must be in (0, 1], got {max_fraction}")
@@ -98,11 +111,15 @@ class ThresholdCodec(Codec):
         if compaction not in ("sort", "scatter"):
             raise ValueError(f"compaction must be 'sort' or 'scatter', "
                              f"got {compaction!r}")
+        if chunk and (chunk < 1024 or chunk & (chunk - 1)):
+            raise ValueError(f"chunk must be 0 or a power of two >= 1024, "
+                             f"got {chunk}")
         self.tau = float(tau)
         self.max_fraction = float(max_fraction)
         self.target_fraction = float(target_fraction)
         self.eta = float(eta)
         self.compaction = compaction
+        self.chunk = int(chunk)
 
     def _cap(self, shape) -> int:
         n = int(np.prod(shape)) if shape else 1
@@ -123,14 +140,19 @@ class ThresholdCodec(Codec):
         # static-size compaction: indices of the first `cap` survivors in
         # index order; slots past min(kept, cap) hold garbage by design
         # (see module doc) — decode masks them by `length` either way.
-        if self.compaction == "sort" and 2 * n < 2**31:
+        if (self.compaction == "sort" and self.chunk
+                and n >= 4 * self.chunk):
+            idx = self._chunked_compact(mask, n, cap)
+        elif self.compaction == "sort" and 2 * n < 2**31:
             # survivors keep their index as the sort key, non-survivors
             # get index+n: one ascending sort puts survivor indices
             # first IN INDEX ORDER. The sort is bitonic — vectorized on
             # TPU, unlike nonzero's serial n-sized scatter. The 2n < 2^31
             # guard keeps the biased keys inside int32 (beyond it, pos+n
             # would wrap negative and sort garbage BEFORE survivors —
-            # silently wrong decode); such tensors take the scatter path.
+            # silently wrong decode); such tensors take the scatter path
+            # (large tensors normally hit the chunked branch above,
+            # whose local keys never approach the int32 bound).
             pos = jnp.arange(n, dtype=jnp.int32)
             keys = jnp.where(mask, pos, pos + n)
             idx = jax.lax.sort(keys)[:cap]
@@ -149,6 +171,47 @@ class ThresholdCodec(Codec):
         else:
             new_tau = tau
         return payload, {"tau": new_tau}
+
+    def _chunked_compact(self, mask, n: int, cap: int):
+        """Chunked data-dependent compaction: the first ``cap`` survivor
+        indices of ``mask`` in GLOBAL index order, without an n-sized
+        sort. Per-chunk biased-key sorts run as ONE vectorized
+        ``lax.sort`` over ``[n_chunks, chunk]`` (bitonic depth
+        log²(chunk), not log²(n)); a sequential ``fori_loop`` then
+        merges each chunk's survivor prefix at a running cursor with a
+        full-chunk ``dynamic_update_slice`` — the next chunk's write
+        lands AT its predecessor's survivor count, overwriting the
+        garbage tail, so out[:kept_total] is exactly the concatenation
+        of survivor prefixes = the global survivors in index order.
+        Bit-identical payload semantics to the unchunked sort path for
+        every slot decode ever reads (the masked ``length`` prefix)."""
+        C = self.chunk
+        nc = -(-n // C)
+        pad = nc * C - n
+        m2 = (jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)])
+              if pad else mask).reshape(nc, C)
+        pos = jnp.arange(C, dtype=jnp.int32)[None, :]
+        keys = jnp.where(m2, pos, pos + C)  # local keys: always < 2^31
+        skeys = jax.lax.sort(keys, dimension=-1)
+        counts = m2.sum(axis=1, dtype=jnp.int32)  # survivors per chunk
+        take = min(C, cap)  # a chunk's rank >= cap entries can never
+        # land inside the global first-cap prefix, so a static
+        # take-per-chunk write loses nothing
+        out0 = jnp.zeros((cap + take,), jnp.int32)
+
+        def body(c, state):
+            out, cursor = state
+            glob = skeys[c, :take]
+            glob = jnp.where(glob >= C, glob - C, glob) + c * C
+            # clamp only the WRITE position: past cap the write lands in
+            # the slack region (sliced off below); the cursor itself
+            # keeps the true running survivor count
+            out = jax.lax.dynamic_update_slice(
+                out, glob, (jnp.minimum(cursor, cap),))
+            return out, cursor + counts[c]
+
+        out, _ = jax.lax.fori_loop(0, nc, body, (out0, jnp.int32(0)))
+        return out[:cap]
 
     def _masked_values(self, payload, dtype):
         cap = payload["values"].shape[-1]
@@ -184,7 +247,7 @@ class ThresholdCodec(Codec):
     # (survivors live at the front in index order; the tail is garbage
     # by the wire contract) — O(length) per fold
     def agg_init(self, shape, dtype):
-        return sparse_agg_init()
+        return sparse_agg_init(shape)
 
     def agg_fold(self, acc, payload):
         k = int(payload["length"])

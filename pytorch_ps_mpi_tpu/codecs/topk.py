@@ -35,17 +35,29 @@ class TopKCodec(Codec):
     # itself, so server-side per-push cost is O(k), not O(n)
     supports_aggregate = True
 
-    def __init__(self, k: int = 0, fraction: float = 0.0, approx: bool = False):
+    def __init__(self, k: int = 0, fraction: float = 0.0, approx: bool = False,
+                 pallas: bool = False):
         """``approx=True`` selects ``lax.approx_max_k`` — the TPU's
         hardware-accelerated approximate top-k (recall ~0.95) — instead of
         the exact sort-based ``lax.top_k``, which is far cheaper on
         multi-million-element gradients. Sparsification is already lossy,
-        so approximate selection costs little accuracy."""
+        so approximate selection costs little accuracy.
+
+        ``pallas=True`` keeps selection EXACT but replaces the full-sort
+        ``lax.top_k`` with the per-block threshold-refine kernel
+        (``ops/topk_pallas.exact_topk``: Pallas count passes find the
+        exact k-th |g|, chunked compaction extracts the survivors) —
+        same value multiset, ties broken in index order instead of sort
+        order. Small tensors fall back to ``lax.top_k`` internally."""
         if (k <= 0) == (fraction <= 0.0):
             raise ValueError("give exactly one of k>0 or 0<fraction<=1")
+        if approx and pallas:
+            raise ValueError("approx and pallas are alternative selection "
+                             "strategies; pick one")
         self.k = int(k)
         self.fraction = float(fraction)
         self.approx = bool(approx)
+        self.pallas = bool(pallas)
 
     def _k_for(self, shape) -> int:
         n = int(np.prod(shape)) if shape else 1
@@ -55,6 +67,11 @@ class TopKCodec(Codec):
     def encode(self, grad, state=(), rng=None):
         flat = grad.reshape(-1)
         k = self._k_for(grad.shape)
+        if self.pallas:
+            from pytorch_ps_mpi_tpu.ops.topk_pallas import exact_topk
+
+            values, indices = exact_topk(flat, k)
+            return {"values": values, "indices": indices}, state
         if self.approx:
             _, indices = jax.lax.approx_max_k(jnp.abs(flat), k)
         else:
@@ -104,7 +121,7 @@ class TopKCodec(Codec):
     # streaming form: the concat list IS the accumulator (O(k) per fold,
     # one numpy scatter-add at finalize) — shared sparse helpers
     def agg_init(self, shape, dtype):
-        return sparse_agg_init()
+        return sparse_agg_init(shape)
 
     def agg_fold(self, acc, payload):
         sparse_agg_fold(acc, payload["values"], payload["indices"])

@@ -43,6 +43,64 @@ def _unpack_kernel(p_ref, out_ref):
 _BLOCK_ROWS = 256  # 256×8×128 f32 = 1 MiB per input tile — well under VMEM
 
 
+def _encode_kernel(rows_total, x_ref, out_ref, sum_ref):
+    """Fused encode: packed sign bits AND the |x| partial sum for the
+    mean-|g| scale in ONE read of the gradient tile. The scalar SMEM
+    accumulator is race-free across the sequential TPU grid; rows past
+    ``rows_total`` (the ragged trailing block Pallas pads) are masked
+    out of the sum (their packed bytes are garbage the caller never
+    reads — the output is sliced to n/8 bytes)."""
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        sum_ref[0, 0] = 0.0
+
+    x = x_ref[:]                                   # [rows, 8, 128] f32
+    bits = (x >= 0).astype(jnp.int32)
+    out_ref[:] = (bits * _weights()).sum(axis=1).astype(jnp.uint8)
+    rid = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) + i * _BLOCK_ROWS
+    sum_ref[0, 0] += jnp.sum(jnp.where(rid < rows_total, jnp.abs(x), 0.0))
+
+
+def encode_signs(flat: jax.Array):
+    """float32[n] (n % 1024 == 0) -> (uint8[n/8] packed bits, f32 |x|
+    sum). The fused form of ``mean(|g|)`` + ``pack_signs``: one gridded
+    pass reads the gradient ONCE where the two-step encode reads it
+    twice (the scale reduction, then the pack) — the memory-bound
+    encode's traffic halves. The sum accumulates per-block partials
+    sequentially in f32 (each block internally tree-reduced), so the
+    derived mean may differ from ``jnp.mean`` in the last ulps —
+    documented codec-config semantics, like the Pallas bit layout."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = flat.shape[0]
+    assert n % _GROUP == 0, n
+    rows = n // _GROUP
+    x3d = flat.reshape(rows, 8, _LANE)
+    grid = ((rows + _BLOCK_ROWS - 1) // _BLOCK_ROWS,)
+    packed, total = pl.pallas_call(
+        functools.partial(_encode_kernel, rows),
+        out_shape=(jax.ShapeDtypeStruct((rows, _LANE), jnp.uint8),
+                   jax.ShapeDtypeStruct((1, 1), jnp.float32)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, 8, _LANE), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=(pl.BlockSpec((_BLOCK_ROWS, _LANE), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        interpret=_interpret(),
+    )(x3d)
+    return packed.reshape(n // 8), total[0, 0]
+
+
 def pack_signs(flat: jax.Array) -> jax.Array:
     """float32[n] (n % 1024 == 0) -> uint8[n/8] of packed sign bits.
     Gridded over row tiles so arbitrarily large gradients stream through

@@ -779,6 +779,24 @@ def serve(
     draining = False
     numerics_stop = False
     next_numerics_probe = 0  # applied count of the next update-ratio probe
+    # native batched ingest (TCP + frames + native fast path): one C++
+    # pump-and-pop drains every queued push, validated, per call; the
+    # inbox serves them to the identical per-item bookkeeping below. In
+    # raw (aggregation) mode the items are VIEWS into the transport's
+    # batch buffer — consumed (copied into their round queue) before the
+    # next batched pop, which only happens once the inbox is empty.
+    batch_poll = getattr(server, "poll_grad_batch", None)
+    inbox: collections.deque = collections.deque()
+
+    def _next_item():
+        if inbox:
+            return inbox.popleft()
+        if batch_poll is not None:
+            batch = batch_poll(raw=agg_armed)
+            if batch is not None:
+                inbox.extend(batch)
+                return inbox.popleft() if inbox else None
+        return server.poll_grad(raw=True) if agg_armed else server.poll_grad()
 
     def _fire_server_faults() -> None:
         """Server-targeted faults fire when the global applied count
@@ -920,7 +938,7 @@ def serve(
                 _mark_dead_workers()
                 while _try_complete_round():
                     pass
-        item = server.poll_grad(raw=True) if agg_armed else server.poll_grad()
+        item = _next_item()
         if item is None:
             if draining:
                 break

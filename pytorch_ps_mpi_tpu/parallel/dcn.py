@@ -387,6 +387,19 @@ class WireAggregator:
             units = [np.asarray(x) for x in wire.plan.unpack_leaves(units)]
         return jax.tree.unflatten(wire.treedef, units)
 
+    def __del__(self):
+        # an abandoned round (degraded sync, dropped worker set) must
+        # hand its pooled sparse buffers back, or the pool stays cold
+        # and every later round pays the fresh-zeros allocation
+        try:
+            from pytorch_ps_mpi_tpu.codecs.base import sparse_agg_release
+
+            for acc in self._accs:
+                if isinstance(acc, dict):
+                    sparse_agg_release(acc)
+        except Exception:
+            pass  # interpreter teardown
+
 
 class ShmPSServer(PSServerTelemetry):
     """Owns params; publishes snapshots, consumes gradients in arrival

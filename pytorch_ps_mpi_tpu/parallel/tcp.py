@@ -54,6 +54,25 @@ from pytorch_ps_mpi_tpu.telemetry import PSServerTelemetry
 _lib: Optional[ctypes.CDLL] = None
 
 
+class _BatchMeta(ctypes.Structure):
+    """Mirror of native/tcpps.cpp BatchMeta (48 bytes, packed)."""
+
+    _pack_ = 1
+    _fields_ = [
+        ("worker", ctypes.c_uint32),
+        ("status", ctypes.c_uint32),
+        ("version", ctypes.c_uint64),
+        ("off", ctypes.c_uint64),
+        ("len", ctypes.c_uint64),
+        ("step", ctypes.c_uint32),
+        ("seq", ctypes.c_uint32),
+        ("send_wall", ctypes.c_double),
+    ]
+
+
+assert ctypes.sizeof(_BatchMeta) == 48
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     """Build (once) and load native/tcpps.cpp; None without a toolchain."""
     global _lib
@@ -102,6 +121,19 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                          ctypes.c_uint64, ctypes.c_uint64,
                                          ctypes.c_int]
     lib.tps_worker_close.argtypes = [ctypes.c_void_p]
+    # batched ingest + in-C++ frame validation (absent from a stale
+    # cached .so built before they existed; the mtime rebuild makes this
+    # guard a hand-copied-library corner case)
+    try:
+        lib.tps_server_set_frame_check.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.tps_server_pop_grad_batch.restype = ctypes.c_int
+        lib.tps_server_pop_grad_batch.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_uint64,
+            ctypes.POINTER(_BatchMeta), ctypes.c_int]
+        lib._has_batch = True
+    except AttributeError:
+        lib._has_batch = False
     _lib = lib
     return _lib
 
@@ -160,6 +192,25 @@ class TcpPSServer(PSServerTelemetry):
         if not self._h:
             raise RuntimeError(f"tps_server_create(port={port}) failed")
         self.port = int(lib.tps_server_port(self._h))
+        # native batched ingest (poll_grad_batch): the C++ side validates
+        # each inner PSF2 frame (magic/version, size, fingerprint, CRC32)
+        # and hands back only reason-coded metas + validated payload
+        # views, so the per-push Python cost is bookkeeping, not parsing.
+        # Armed whenever frames are on and the library has the entry
+        # points; PS_NO_NATIVE is consulted per call, not here.
+        self._batch_max = 0
+        if self.frame and getattr(lib, "_has_batch", False):
+            lib.tps_server_set_frame_check(
+                self._h, self._fingerprint, payload_bytes)
+            # batch buffer: up to 64 payloads, capped at ~16 MB so a
+            # BERT-scale identity wire doesn't allocate gigabytes
+            self._batch_max = max(1, min(64, (16 << 20) //
+                                         max(payload_bytes, 1)))
+            self._batch_buf = np.empty(
+                self._batch_max * payload_bytes, np.uint8)
+            self._batch_metas = (_BatchMeta * self._batch_max)()
+        self.native_batches = 0
+        self.native_batch_frames = 0
         self.version = 0
         if self.frame:
             # headroom to max_msg: a mismatched worker's oversized frame
@@ -258,6 +309,46 @@ class TcpPSServer(PSServerTelemetry):
             return int(n), wid, int(version.value)
 
         return self._frames.framed_poll(self, pop_once, raw=raw)
+
+    def poll_grad_batch(self, raw: bool = False) -> Optional[list]:
+        """Native batched ingest: ONE pump + ONE C++ pop drains up to
+        ``_batch_max`` queued pushes, each already validated (magic/
+        version, size, config fingerprint, CRC32) on the native side —
+        the serve loop's per-push cost drops to bookkeeping plus, in
+        ``raw`` mode, handing the validated payload VIEW straight to the
+        native fold. Returns the consumed ``(worker, version, grad)``
+        list ([] = nothing pending), or None when the fast path is
+        unavailable (frames off, stale library, or ``PS_NO_NATIVE``) —
+        callers fall back to :meth:`poll_grad`. Views returned in raw
+        mode alias the batch buffer: copy or fold before the NEXT
+        batched pop."""
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        if not self._batch_max or _native.fast_path_disabled():
+            return None
+        if raw and not self.wire:
+            raise ValueError("poll_grad_batch(raw=True) needs a codec wire")
+        self._lib.tps_server_pump(self._h)
+        self._refresh_read_stats()
+        n = self._lib.tps_server_pop_grad_batch(
+            self._h, _u8(self._batch_buf), self._batch_buf.nbytes,
+            self._batch_metas, self._batch_max)
+        if n <= 0:
+            return []
+        self.native_batches += 1
+        self.native_batch_frames += int(n)
+
+        def gen():
+            for i in range(n):
+                m = self._batch_metas[i]
+                wid = int(m.worker)
+                self._ever_connected.add(wid)
+                payload = (self._batch_buf[int(m.off):int(m.off) + int(m.len)]
+                           if not m.status else None)
+                yield (wid, int(m.version), int(m.status), payload,
+                       int(m.step), int(m.seq), float(m.send_wall))
+
+        return self._frames.framed_batch_consume(self, gen(), raw=raw)
 
     def poll_grad(self, raw: bool = False
                   ) -> Optional[Tuple[int, int, PyTree]]:

@@ -197,6 +197,70 @@ def read_lineage(buf: np.ndarray) -> Tuple[int, int, float]:
     return int(step), int(seq), float(send_wall)
 
 
+#: C++ FrameStatus codes (native/tcpps.cpp) → open_frame reason strings.
+BATCH_REASONS = {1: "short", 2: "version", 3: "magic", 4: "size",
+                 5: "config", 6: "corrupt"}
+
+
+def framed_batch_consume(server, frames_iter, raw: bool = False) -> list:
+    """The batched twin of :func:`framed_poll` for transports whose
+    native side already validated the frames (``tps_server_pop_grad_batch``
+    runs the magic/version/size/fingerprint/CRC checks in C++ and hands
+    back reason-coded metas + validated payload views). Applies the SAME
+    accounting — per-worker rejection counting, bounded staleness,
+    lineage feed, ``serve.consume`` spans, ``last_push_meta`` — so the
+    two ingest paths are indistinguishable to everything downstream.
+
+    ``frames_iter`` yields ``(worker, version, status, payload_view,
+    step, seq, send_wall)``; ``status`` 0 means validated. Returns the
+    consumed ``(worker, version, grad_or_payload)`` list (stale drops
+    and rejections are counted, not returned). Payload views alias the
+    transport's batch buffer — valid until the next batched pop."""
+    lt = getattr(server, "lineage_tracker", None)
+    out = []
+    for wid, version, status, payload, lstep, lseq, send_wall in frames_iter:
+        # any frame — valid or not — proves the worker is alive
+        server.last_seen[wid] = time.time()
+        if status:
+            server._reject_frame(wid, BATCH_REASONS.get(status, "magic"))
+            continue
+        recv_wall = time.time()
+        staleness = max(0, server.version - version)
+        server.staleness_seen[staleness] = (
+            server.staleness_seen.get(staleness, 0) + 1
+        )
+        server.grads_received += 1
+        server.bytes_received += payload.nbytes
+        meta = {
+            "worker": int(wid), "step": lstep, "seq": lseq,
+            "version_read": int(version), "staleness": int(staleness),
+            "bytes": int(payload.nbytes),
+            "send_wall": send_wall, "recv_wall": recv_wall,
+        }
+        if staleness <= server.max_staleness:
+            t_dec = time.monotonic()
+            if raw:
+                grad = payload
+                meta["decode_s"] = 0.0  # deferred to the round's ONE decode
+            else:
+                grad = server._decode_payload(payload)
+                meta["decode_s"] = round(time.monotonic() - t_dec, 6)
+            server.last_push_meta = meta
+            record_event("serve.consume", kind="span", ts=t_dec,
+                         dur=meta["decode_s"], step=lstep,
+                         src_worker=int(wid), seq=lseq,
+                         staleness=int(staleness))
+            if lt is not None:
+                lt.observe_consume(meta)
+            out.append((int(wid), int(version), grad))
+        else:
+            server.stale_drops += 1
+            if lt is not None:
+                meta["stale_drop"] = True
+                lt.observe_consume(meta)
+    return out
+
+
 def framed_poll(
     server, pop_once: Callable[[], Tuple[int, int, int]],
     raw: bool = False,

@@ -59,8 +59,12 @@ def build_and_load(src_name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
             import sys as _sys
 
             libs = ["-lrt"] if _sys.platform.startswith("linux") else []
-            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                   *extra_flags, "-o", tmp, src, *libs]
+            # -ffp-contract=off: the wc_fold_* kernels must not contract
+            # multiply+add into an FMA — the numpy fallback computes them
+            # as separate f32 ops and the native==numpy bit-exact parity
+            # contract (tests/test_native_fold.py) pins that
+            cmd = ["g++", "-O3", "-std=c++17", "-ffp-contract=off",
+                   "-shared", "-fPIC", *extra_flags, "-o", tmp, src, *libs]
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
             os.replace(tmp, so_path)
         return ctypes.CDLL(so_path)
@@ -82,6 +86,32 @@ def _build_lib() -> Optional[ctypes.CDLL]:
     lib.wc_rle0_encode.restype = ctypes.c_size_t
     lib.wc_rle0_decode.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
     lib.wc_rle0_decode.restype = ctypes.c_size_t
+    # fold kernels (absent from a stale cached .so built before they
+    # existed — probe one symbol and leave the rest unbound then; the
+    # mtime check above rebuilds on any source change, so this only
+    # guards a hand-copied old library)
+    try:
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.wc_fold_scaled_i8.argtypes = [f32p, i8p, ctypes.c_float,
+                                          ctypes.c_size_t]
+        lib.wc_fold_tern.argtypes = [f32p, u8p, ctypes.c_float,
+                                     ctypes.c_size_t]
+        lib.wc_fold_sign.argtypes = [i32p, u8p, ctypes.c_size_t]
+        lib.wc_fold_sparse.argtypes = [f32p, f32p, i32p, ctypes.c_size_t,
+                                       ctypes.c_size_t]
+        lib.wc_zero_sparse.argtypes = [f32p, i32p, ctypes.c_size_t,
+                                       ctypes.c_size_t]
+        lib.wc_fold_sparse_q8.argtypes = [f32p, i8p, f32p, i32p,
+                                          ctypes.c_size_t, ctypes.c_size_t,
+                                          ctypes.c_size_t]
+        lib.wc_fold_dense_f32.argtypes = [f32p, f32p, ctypes.c_size_t]
+        lib.wc_fold_dense_bf16.argtypes = [f32p, u16p, ctypes.c_size_t]
+        lib._has_folds = True
+    except AttributeError:
+        lib._has_folds = False
     return lib
 
 
@@ -233,6 +263,103 @@ def compress(data: bytes, elem_size: int = 4) -> bytes:
     if len(payload) >= raw.size:  # incompressible: store
         return _HDR.pack(_MAGIC, 0, 0, raw.size, crc) + data
     return _HDR.pack(_MAGIC, elem_size, flags, raw.size, crc) + payload
+
+
+# -- native fast path (fold kernels + batched ingest) ------------------------
+#
+# PS_NO_NATIVE=1 force-disables the OPTIONAL native fast paths — the
+# wc_fold_* homomorphic fold kernels below and the tcpps batched C++
+# frame ingest — proving the pure-Python/numpy fallbacks still carry
+# every feature. It does NOT disable the native transports themselves
+# (psqueue/tcpps ARE the shm/TCP wire; there is no Python substitute),
+# nor the shuffle/rle0 filters above (their numpy fallbacks engage only
+# when the toolchain is missing).
+
+def fast_path_disabled() -> bool:
+    """True when the ``PS_NO_NATIVE`` env var asks for pure-Python
+    fallbacks (any value except empty/``0``/``false``). Read per call:
+    tests flip it with monkeypatch."""
+    return os.environ.get("PS_NO_NATIVE", "0").strip().lower() not in (
+        "", "0", "false")
+
+
+def fold_lib() -> Optional[ctypes.CDLL]:
+    """The wirecodec library with the ``wc_fold_*`` kernels bound, or
+    None (``PS_NO_NATIVE`` set, no toolchain, or a stale pre-fold
+    cached build) — callers fall back to the numpy fold."""
+    if fast_path_disabled():
+        return None
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_folds", False):
+        return None
+    return lib
+
+
+def _f32(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
+
+
+def _i32(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def fold_scaled_i8(lib, acc: np.ndarray, q: np.ndarray, scale) -> None:
+    """acc += scale * q (int8 payload, f32 accumulator) in one pass."""
+    lib.wc_fold_scaled_i8(_f32(acc), _i8(q), ctypes.c_float(float(scale)),
+                          acc.size)
+
+
+def fold_tern(lib, acc: np.ndarray, packed: np.ndarray, scale) -> None:
+    """acc += scale * unpack_base4(packed) (terngrad) in one pass."""
+    lib.wc_fold_tern(_f32(acc), _u8(packed), ctypes.c_float(float(scale)),
+                     acc.size)
+
+
+def fold_sign(lib, votes: np.ndarray, packed: np.ndarray) -> None:
+    """votes += unpacked bits (little bitorder), int32 vote counters."""
+    lib.wc_fold_sign(_i32(votes), _u8(packed), votes.size)
+
+
+def fold_sparse(lib, acc: np.ndarray, values: np.ndarray,
+                indices: np.ndarray, acc_ptr=None) -> None:
+    """acc[idx] += val scatter-add; out-of-range indices dropped.
+    ``acc_ptr`` lets a hot caller reuse a cached ctypes pointer for the
+    long-lived accumulator (the data_as conversion is ~µs — real money
+    against a 2048-entry scatter)."""
+    lib.wc_fold_sparse(acc_ptr if acc_ptr is not None else _f32(acc),
+                       _f32(values), _i32(indices),
+                       values.size, acc.size)
+
+
+def zero_sparse(lib, acc: np.ndarray, indices: np.ndarray,
+                acc_ptr=None) -> None:
+    """acc[idx] = 0 for in-range idx — the pooled-buffer recycle pass."""
+    lib.wc_zero_sparse(acc_ptr if acc_ptr is not None else _f32(acc),
+                       _i32(indices), indices.size, acc.size)
+
+
+def fold_sparse_q8(lib, acc: np.ndarray, q: np.ndarray, scales: np.ndarray,
+                   indices: np.ndarray, acc_ptr=None) -> None:
+    """Dequantized (per-block int8 x scale) scatter-add in one pass."""
+    nb = scales.size
+    kb = q.size // max(nb, 1)
+    lib.wc_fold_sparse_q8(acc_ptr if acc_ptr is not None else _f32(acc),
+                          _i8(q), _f32(scales), _i32(indices),
+                          nb, kb, acc.size)
+
+
+def fold_dense_f32(lib, acc: np.ndarray, x: np.ndarray) -> None:
+    lib.wc_fold_dense_f32(_f32(acc), _f32(x), acc.size)
+
+
+def fold_dense_bf16(lib, acc: np.ndarray, x: np.ndarray) -> None:
+    lib.wc_fold_dense_bf16(
+        _f32(acc), x.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        acc.size)
 
 
 def decompress(blob: bytes) -> bytes:

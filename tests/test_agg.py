@@ -104,10 +104,13 @@ def test_streaming_fold_matches_decode_sum(name, kw, shape):
 @pytest.mark.parametrize("name,kw", [
     ("int8", {}), ("qsgd", {"levels": 16}), ("terngrad", {}),
 ])
-def test_streaming_fold_jitted_large_unit(name, kw):
+def test_streaming_fold_jitted_large_unit(name, kw, monkeypatch):
     """Units past the fold crossover run the jitted fused kernel —
     same result as decode_sum to f32 tolerance (and as the small-unit
-    numpy fold path, covered above)."""
+    numpy fold path, covered above). The native fast path outranks the
+    jit crossover when armed, so it is force-disabled here to pin the
+    jit fallback (native parity lives in tests/test_native_fold.py)."""
+    monkeypatch.setenv("PS_NO_NATIVE", "1")
     code = get_codec(name, **kw)
     shape = ((1 << 16) + 5,)  # past base.FOLD_JIT_MIN, ragged
     payloads = _payloads(code, shape, 3)

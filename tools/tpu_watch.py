@@ -93,9 +93,11 @@ def append_record(rec: dict) -> None:
         os.fsync(f.fileno())
 
 
-def probe(timeout: float = 75.0) -> bool:
-    """One trivial device op in a subprocess; True iff the accelerator
-    backend answered within the timeout."""
+def probe(timeout: float = 75.0) -> tuple[bool, str]:
+    """One trivial device op in a subprocess; ``(live, reason)`` where
+    ``reason`` says WHY the probe concluded down (timeout / crashed /
+    wrong backend) — 640 identical ``status: down`` rows taught us that
+    "down" alone is not actionable."""
     try:
         out = subprocess.run(
             [
@@ -109,9 +111,18 @@ def probe(timeout: float = 75.0) -> bool:
             text=True,
             cwd=REPO,
         )
-        return out.returncode == 0 and "tpu" in out.stdout
     except subprocess.TimeoutExpired:
-        return False
+        return False, f"probe timeout after {timeout:.0f}s (tunnel wedged)"
+    if out.returncode != 0:
+        # last stderr line is the operative error (plugin import failure,
+        # tunnel connection refused, ...)
+        tail = (out.stderr or "").strip().splitlines()
+        return False, f"probe rc={out.returncode}: " + (
+            tail[-1][:200] if tail else "no stderr")
+    backend = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "?"
+    if "tpu" not in out.stdout:
+        return False, f"backend is {backend!r}, not tpu (plugin not routed)"
+    return True, backend
 
 
 def run_stage(name: str, argv: list[str], timeout: int) -> bool:
@@ -207,26 +218,44 @@ def commit_capture() -> None:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=float, default=240,
-                    help="seconds between liveness probes while down")
+                    help="seconds between liveness probes while down "
+                         "(doubles per consecutive failure up to "
+                         "--max-interval; resets on a live probe)")
+    ap.add_argument("--max-interval", type=float, default=3840,
+                    help="exponential-backoff ceiling between down probes")
     ap.add_argument("--after-success", type=float, default=3600,
                     help="seconds to wait before re-sweeping after success")
     ap.add_argument("--once", action="store_true",
                     help="one probe+sweep attempt, then exit")
     args = ap.parse_args()
 
+    down_streak = 0
     while True:
-        live = probe()
-        append_record({"stage": "probe", "status": "live" if live else "down"})
+        live, reason = probe()
         if live:
+            append_record({"stage": "probe", "status": "live",
+                           "backend": reason})
+            down_streak = 0
             ok = sweep()
             commit_capture()
             if args.once:
                 sys.exit(0 if ok else 1)
             time.sleep(args.after_success)
         else:
+            # exponential backoff: a tunnel that has been down for a day
+            # gets probed every ~64 min, not every 4 — and each row says
+            # why it was down plus when the next attempt comes, so the
+            # log reads as a diagnosis, not noise
+            wait = min(args.interval * (2 ** down_streak),
+                       args.max_interval)
+            append_record({"stage": "probe", "status": "down",
+                           "reason": reason,
+                           "consecutive_down": down_streak + 1,
+                           "next_probe_s": round(wait, 1)})
+            down_streak += 1
             if args.once:
                 sys.exit(1)
-            time.sleep(args.interval)
+            time.sleep(wait)
 
 
 if __name__ == "__main__":
