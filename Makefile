@@ -12,6 +12,7 @@ test:
 	$(MAKE) read-smoke
 	$(MAKE) agg-smoke
 	$(MAKE) native-smoke
+	$(MAKE) obs-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -145,6 +146,22 @@ native:
 	g++ -O3 -std=c++17 -ffp-contract=off -shared -fPIC -o native/_build/libpsqueue.so native/psqueue.cpp -lrt
 	g++ -O3 -std=c++17 -ffp-contract=off -shared -fPIC -o native/_build/libtcpps.so native/tcpps.cpp -lrt
 
+# Observability-plane gate (in the default `make test` path): a fully
+# armed 2-worker run (metrics history + continuous profiler + SLO
+# watchdog + fleet registration) must answer windowed /history queries
+# with monotone timestamps matching the exact lineage distributions,
+# show the serve-loop frames in the flamegraph + nonzero native fold
+# cycle counters, stay within the standing ≤5% telemetry budget with
+# EVERYTHING armed, trip exactly one SLO burn verdict on an injected
+# straggler (zero on the healthy run, replayable from the persisted
+# history), and cover every live shard + the read tier + a restarted
+# supervisor generation in one /fleet scrape. Appends a bench_gate
+# trajectory row to benchmarks/results/obs_smoke.jsonl; the second
+# command re-asserts the recorder half of the telemetry budget.
+obs-smoke:
+	JAX_PLATFORMS=cpu python tools/obs_smoke.py
+	python tools/telemetry_smoke.py
+
 # Native fast-path gate (in the default `make test` path): both
 # libraries must build and load with the fold/batch entry points, every
 # fold-family codec must be BIT-exact native-vs-numpy over real
@@ -165,4 +182,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke

@@ -140,6 +140,33 @@ def main(argv=None):
     ap.add_argument("--snapshot-ring", type=int, default=None,
                     help="with --read-port: versions kept for delta "
                          "reads (default 8)")
+    ap.add_argument("--history", action="store_true",
+                    help="arm the in-process metrics TSDB: every "
+                         "canonical metric key retained as ring-"
+                         "buffered history (raw + 1s/10s/60s tiers), "
+                         "persisted as timeseries-server.jsonl in "
+                         "--telemetry-dir and served at /history")
+    ap.add_argument("--profile", action="store_true",
+                    help="arm the continuous sampling profiler (~100 Hz "
+                         "collapsed-stack flamegraph text with a hard "
+                         "self-overhead budget) in the server AND every "
+                         "worker; profile-*.txt land in --telemetry-dir "
+                         "and merge in the report")
+    ap.add_argument("--slo", action="store_true",
+                    help="arm the SLO burn-rate watchdog over the "
+                         "metrics history (implies --history): latched "
+                         "breach/recover verdicts into slo-server.jsonl "
+                         "+ the flight recorder, an 'slo' section in "
+                         "/health, and ps_slo_* scrape instruments")
+    ap.add_argument("--slo-target", action="append", default=[],
+                    help="override one SLO target, KEY=VALUE "
+                         "(repeatable; e.g. push_e2e_p95_ms=250)")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="fleet registration directory: this server "
+                         "registers its endpoint there (re-registering "
+                         "across supervisor restarts) and serves the "
+                         "merged /fleet snapshot; watch the pane with "
+                         "tools/ps_top.py --fleet DIR")
     ap.add_argument("--no-frame-check", action="store_true",
                     help="disable the self-verifying wire frames (CRC + "
                          "config fingerprint on every push; on by default "
@@ -245,11 +272,38 @@ def main(argv=None):
         for stale in glob.glob(os.path.join(args.telemetry_dir, "*.jsonl")) \
                 + glob.glob(os.path.join(args.telemetry_dir, "trace.json")) \
                 + glob.glob(os.path.join(args.telemetry_dir,
-                                         "postmortem-*.json")):
+                                         "postmortem-*.json")) \
+                + glob.glob(os.path.join(args.telemetry_dir,
+                                         "profile-*.txt")):
             os.remove(stale)
         cfg["telemetry_dir"] = args.telemetry_dir
         if args.metrics_port is None:
             args.metrics_port = 0
+    if (args.history or args.slo or args.profile) \
+            and not args.telemetry_dir:
+        ap.error("--history/--slo/--profile need --telemetry-dir (their "
+                 "timeseries-/slo-/profile- artifacts land there)")
+    if args.slo_target and not args.slo:
+        ap.error("--slo-target needs --slo")
+    if args.history or args.slo:
+        cfg["timeseries"] = True
+    if args.slo:
+        cfg["slo"] = True
+        if args.slo_target:
+            targets = {}
+            for kv in args.slo_target:
+                k, _, v = kv.partition("=")
+                try:
+                    targets[k] = float(v)
+                except ValueError:
+                    ap.error(f"--slo-target {kv!r} is not KEY=FLOAT")
+            cfg["slo_kw"] = {"targets": targets}
+    if args.profile:
+        cfg["profile"] = True
+    if args.fleet_dir:
+        cfg["fleet_dir"] = args.fleet_dir
+        if args.metrics_port is None:
+            args.metrics_port = 0  # registration needs a live endpoint
     # lineage tracing: explicit --trace demands its prerequisites; the
     # default (no flag) arms it whenever they are already met — one
     # --telemetry-dir flag keeps meaning "full telemetry"
@@ -462,13 +516,15 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
 
     # faults-*.jsonl are injected-fault logs (resilience layer),
     # beacon-*.jsonl are health-monitor side channels, numerics-*.jsonl
-    # are codec-fidelity/grad-norm trajectories, and lineage-*.jsonl are
-    # per-version push compositions — not flight-recorder files, so
-    # exclude them from the merged trace (telemetry_report's dir mode
-    # routes them to its numerics/lineage sections)
+    # are codec-fidelity/grad-norm trajectories, lineage-*.jsonl are
+    # per-version push compositions, timeseries-*.jsonl are retained
+    # metric histories, and slo-*.jsonl are SLO verdict events — not
+    # flight-recorder files, so exclude them from the merged trace
+    # (telemetry_report's dir mode routes each to its own section)
     files = sorted(f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
                    if not os.path.basename(f).startswith(
-                       ("faults-", "beacon-", "numerics-", "lineage-")))
+                       ("faults-", "beacon-", "numerics-", "lineage-",
+                        "timeseries-", "slo-")))
     events = []
     for f in files:
         events.extend(load_jsonl(f)[1])
@@ -482,7 +538,14 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
         device_trace_dir=device_trace_dir, device_t0_wall=device_t0_wall,
         lineage_rows=lineage_rows or None, clock_offsets=offsets,
     )
-    print(format_table(summarize(files + lineage_files, by_worker=False)))
+    # the observability-plane artifacts join the printed report through
+    # their own sections (history/profile/slo), never the span merge
+    obs_files = sorted(
+        glob.glob(os.path.join(tdir, "timeseries-*.jsonl"))
+        + glob.glob(os.path.join(tdir, "slo-*.jsonl"))
+        + glob.glob(os.path.join(tdir, "profile-*.txt")))
+    print(format_table(summarize(files + lineage_files + obs_files,
+                                 by_worker=False)))
     out = {
         "telemetry_trace": trace_path,
         "telemetry_trace_host_events": counts["host"],

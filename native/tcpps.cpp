@@ -40,6 +40,7 @@
 // shared medium). Zero/unset env = zero overhead (checked once).
 
 #include <arpa/inet.h>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdlib>
@@ -517,6 +518,48 @@ int tps_server_publish(void* sv, const uint8_t* buf, uint64_t len,
 // One non-blocking sweep: accept, read, parse, reply, flush. Returns the
 // number of complete frames/connection events progressed (0 = idle).
 //
+// -- pump cycle counters (continuous profiling, telemetry/profiler.py) ---
+// The Python stack sampler sees one opaque ctypes call for the whole
+// epoll pump; these process-global counters (calls / events / wall ns)
+// are its native-side ledger, read by tps_profile_stats the same
+// plain-ints-only way as tps_server_read_stats.
+static std::atomic<uint64_t> g_pump_calls{0};
+static std::atomic<uint64_t> g_pump_events{0};
+static std::atomic<uint64_t> g_pump_ns{0};
+static std::atomic<uint64_t> g_frames_validated{0};
+
+namespace {
+struct PumpProf {
+  timespec t0;
+  PumpProf() { clock_gettime(CLOCK_MONOTONIC, &t0); }
+  void done(int events) {
+    timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    uint64_t ns = (uint64_t)(t1.tv_sec - t0.tv_sec) * 1000000000ull +
+                  (uint64_t)(t1.tv_nsec - t0.tv_nsec);
+    g_pump_calls.fetch_add(1, std::memory_order_relaxed);
+    if (events > 0)
+      g_pump_events.fetch_add((uint64_t)events, std::memory_order_relaxed);
+    g_pump_ns.fetch_add(ns, std::memory_order_relaxed);
+  }
+};
+}  // namespace
+
+void tps_profile_stats(uint64_t* pump_calls, uint64_t* pump_events,
+                       uint64_t* pump_ns, uint64_t* frames_validated) {
+  *pump_calls = g_pump_calls.load(std::memory_order_relaxed);
+  *pump_events = g_pump_events.load(std::memory_order_relaxed);
+  *pump_ns = g_pump_ns.load(std::memory_order_relaxed);
+  *frames_validated = g_frames_validated.load(std::memory_order_relaxed);
+}
+
+void tps_profile_reset() {
+  g_pump_calls.store(0, std::memory_order_relaxed);
+  g_pump_events.store(0, std::memory_order_relaxed);
+  g_pump_ns.store(0, std::memory_order_relaxed);
+  g_frames_validated.store(0, std::memory_order_relaxed);
+}
+
 // With epoll armed (the default) the accept+recv phase is readiness-
 // driven: ONE epoll_wait(0) names exactly the sockets with pending
 // bytes, and only those pay a recv() syscall — an idle fleet member
@@ -527,6 +570,7 @@ int tps_server_publish(void* sv, const uint8_t* buf, uint64_t len,
 // kernel event to re-announce it, so readiness alone must never gate
 // handle_frames.
 int tps_server_pump(void* sv) {
+  PumpProf prof;
   Server* s = (Server*)sv;
   int events = 0;
   if (s->epfd >= 0) {
@@ -574,6 +618,7 @@ int tps_server_pump(void* sv) {
     if (dead) close_conn(s, i);
     else ++i;
   }
+  prof.done(events);
   return events;
 }
 
@@ -621,6 +666,7 @@ int tps_server_pop_grad_batch(void* sv, uint8_t* buf, uint64_t cap,
       PsfHeader h{};
       status = validate_frame(s, m, &payload, &plen, &h);
       if (status == FRAME_OK) {
+        g_frames_validated.fetch_add(1, std::memory_order_relaxed);
         meta.step = h.step;
         meta.seq = h.seq;
         meta.send_wall = h.send_wall;

@@ -29,23 +29,69 @@
 // FMA-contracted fold would be more accurate but would break the
 // native==numpy parity contract the tests pin.
 
+#include <atomic>
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
+
+// -- fold cycle counters (continuous profiling, telemetry/profiler.py) ----
+// A Python stack sampler cannot see inside one opaque ctypes call, so the
+// fold hot path keeps its own process-global counters: calls, elements
+// folded, and wall nanoseconds. One clock_gettime pair per fold call
+// (~40 ns) against payload-sized loops — negligible, and relaxed atomics
+// keep the counters safe if folds ever run off the serve thread.
+static std::atomic<uint64_t> g_fold_calls{0};
+static std::atomic<uint64_t> g_fold_elems{0};
+static std::atomic<uint64_t> g_fold_ns{0};
+
+namespace {
+struct FoldProf {
+  timespec t0;
+  explicit FoldProf() { clock_gettime(CLOCK_MONOTONIC, &t0); }
+  void done(size_t n) {
+    timespec t1;
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    uint64_t ns = (uint64_t)(t1.tv_sec - t0.tv_sec) * 1000000000ull +
+                  (uint64_t)(t1.tv_nsec - t0.tv_nsec);
+    g_fold_calls.fetch_add(1, std::memory_order_relaxed);
+    g_fold_elems.fetch_add((uint64_t)n, std::memory_order_relaxed);
+    g_fold_ns.fetch_add(ns, std::memory_order_relaxed);
+  }
+};
+}  // namespace
 
 extern "C" {
 
+// Read (and optionally reset) the fold cycle counters — the
+// "_native_read_stats-style refresh": Python copies three plain ints,
+// never holds a native pointer across calls.
+void wc_profile_stats(uint64_t* calls, uint64_t* elems, uint64_t* ns) {
+  *calls = g_fold_calls.load(std::memory_order_relaxed);
+  *elems = g_fold_elems.load(std::memory_order_relaxed);
+  *ns = g_fold_ns.load(std::memory_order_relaxed);
+}
+
+void wc_profile_reset() {
+  g_fold_calls.store(0, std::memory_order_relaxed);
+  g_fold_elems.store(0, std::memory_order_relaxed);
+  g_fold_ns.store(0, std::memory_order_relaxed);
+}
+
 // acc[i] += scale * q[i] — int8/qsgd scale-folded integer family.
 void wc_fold_scaled_i8(float* acc, const int8_t* q, float scale, size_t n) {
+  FoldProf prof;
   for (size_t i = 0; i < n; ++i) {
     float v = (float)q[i] * scale;
     acc[i] += v;
   }
+  prof.done(n);
 }
 
 // acc[i] += scale * (digit_i - 1) — terngrad base-4 2-bit unpack + MA.
 // packed holds 4 ternary digits {0,1,2} per byte, weights 1/4/16/64.
 void wc_fold_tern(float* acc, const uint8_t* packed, float scale, size_t n) {
+  FoldProf prof;
   size_t full = n / 4;
   for (size_t b = 0; b < full; ++b) {
     uint8_t p = packed[b];
@@ -65,11 +111,13 @@ void wc_fold_tern(float* acc, const uint8_t* packed, float scale, size_t n) {
     int digit = (packed[i / 4] >> (2 * (i % 4))) & 3;
     acc[i] += (float)(digit - 1) * scale;
   }
+  prof.done(n);
 }
 
 // votes[i] += bit_i — sign popcount vote counts (bitorder 'little',
 // matching np.unpackbits(bitorder='little') and the jnp pack weights).
 void wc_fold_sign(int32_t* votes, const uint8_t* packed, size_t n) {
+  FoldProf prof;
   size_t full = n / 8;
   for (size_t b = 0; b < full; ++b) {
     uint8_t p = packed[b];
@@ -78,6 +126,7 @@ void wc_fold_sign(int32_t* votes, const uint8_t* packed, size_t n) {
   }
   for (size_t i = full * 8; i < n; ++i)
     votes[i] += (packed[i / 8] >> (i % 8)) & 1;
+  prof.done(n);
 }
 
 // acc[idx[j]] += val[j] — sparse (idx, val) merge-fold straight into the
@@ -86,10 +135,12 @@ void wc_fold_sign(int32_t* votes, const uint8_t* packed, size_t n) {
 // the accumulation order matches the numpy np.add.at finalize exactly.
 void wc_fold_sparse(float* acc, const float* val, const int32_t* idx,
                     size_t k, size_t n) {
+  FoldProf prof;
   for (size_t j = 0; j < k; ++j) {
     int32_t i = idx[j];
     if (i >= 0 && (size_t)i < n) acc[i] += val[j];
   }
+  prof.done(k);
 }
 
 // Scatter-zero for the pooled sparse accumulator: re-zero exactly the
@@ -106,6 +157,7 @@ void wc_zero_sparse(float* acc, const int32_t* idx, size_t k, size_t n) {
 // of kb survivors — dequantize (q * scale) and scatter-add in one pass.
 void wc_fold_sparse_q8(float* acc, const int8_t* q, const float* scales,
                        const int32_t* idx, size_t nb, size_t kb, size_t n) {
+  FoldProf prof;
   for (size_t b = 0; b < nb; ++b) {
     float s = scales[b];
     const int8_t* qb = q + b * kb;
@@ -116,22 +168,27 @@ void wc_fold_sparse_q8(float* acc, const int8_t* q, const float* scales,
       if (i >= 0 && (size_t)i < n) acc[i] += v;
     }
   }
+  prof.done(nb * kb);
 }
 
 // acc[i] += x[i] — identity/f32 dense fold.
 void wc_fold_dense_f32(float* acc, const float* x, size_t n) {
+  FoldProf prof;
   for (size_t i = 0; i < n; ++i) acc[i] += x[i];
+  prof.done(n);
 }
 
 // acc[i] += (float)bf16[i] — bf16 payload cast-up fold (a bf16 is the
 // top 16 bits of the equal-valued f32; the cast is exact).
 void wc_fold_dense_bf16(float* acc, const uint16_t* x, size_t n) {
+  FoldProf prof;
   for (size_t i = 0; i < n; ++i) {
     uint32_t bits = (uint32_t)x[i] << 16;
     float v;
     std::memcpy(&v, &bits, 4);
     acc[i] += v;
   }
+  prof.done(n);
 }
 
 void wc_shuffle(const uint8_t* src, uint8_t* dst, size_t n_elems, size_t elem) {

@@ -287,6 +287,20 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
         probe_every = max(1, int((cfg.get("numerics_kw") or {}).get(
             "probe_every", NUMERICS_KNOBS["probe_every"])))
         prober = ProbeWriter(cfg["numerics_dir"], worker_id)
+    wprof = None
+    if cfg.get("profile") or cfg.get("profile_dir"):
+        prof_dir = cfg.get("profile_dir") or cfg.get("telemetry_dir")
+        if prof_dir:
+            # continuous profiling, worker half: the same collapsed-stack
+            # sampler the serve loop runs, one profile-worker-N.txt per
+            # process, merged by tools/telemetry_report.py
+            from pytorch_ps_mpi_tpu.telemetry.profiler import (
+                SamplingProfiler,
+            )
+
+            wprof = SamplingProfiler(
+                name=f"worker-{worker_id}", dir=prof_dir,
+                **(cfg.get("profile_kw") or {})).start()
     beacon = None
     if cfg.get("health_dir"):
         # the online-diagnosis side channel: one appended JSONL row per
@@ -418,6 +432,9 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
         if beacon is not None:
             beacon.close(retries=getattr(w, "retries", 0),
                          reconnects=getattr(w, "reconnects", 0))
+        if wprof is not None:
+            wprof.stop()
+            wprof.write()
     return pushed
 
 
@@ -607,6 +624,22 @@ def serve(
     ``benchmarks/fidelity_bench.py --aggregate``), so ``"auto"`` never
     arms it — approximate algebras require an explicit ``"on"``, the
     opt-in to that fidelity contract.
+
+    Fleet observability plane (``telemetry.timeseries`` / ``.slo`` /
+    ``.profiler`` / ``.fleet``): ``cfg["timeseries"]`` retains every
+    canonical metric key as ring-buffered history (raw + 1 s/10 s/60 s
+    tiers), sampled at this loop's tick cadence on this thread,
+    persisted as ``timeseries-server.jsonl`` and served at
+    ``/history?key=...&window=...``; ``cfg["slo"]`` arms the burn-rate
+    watchdog over that history (verdicts into ``slo-server.jsonl``, the
+    flight recorder, ``/health``'s ``slo`` section and the
+    ``ps_slo_*`` instruments); ``cfg["profile"]`` runs the continuous
+    sampling profiler (``profile-server.txt`` collapsed stacks, and in
+    every spawned worker too); ``cfg["fleet_dir"]`` registers this
+    server's endpoint for the fleet pane and serves the merged
+    ``/fleet`` snapshot. Final sections ride the returned metrics as
+    ``history`` / ``slo`` / ``profile``; the routes stay scrapable
+    until ``server.close()``.
 
     Resilience hooks:
 
@@ -1070,6 +1103,18 @@ def serve(
     if lint is not None:
         m["lineage"] = lint.snapshot()
         lint.close()
+    if server.timeseries_db is not None:
+        # one closing sample so the retained history ends on the FINAL
+        # counter state, not the last tick-cadence snapshot (force: the
+        # ingest throttle must not drop the run's last word)
+        server.timeseries_db.sample(server.metrics(), force=True)
+    obs = server.finalize_observability()
+    if obs:
+        # the observability-plane sections: "history" (TSDB meta),
+        # "slo" (rule states + verdicts), "profile" (top-N + file).
+        # /history and /fleet stay scrapable — and the fleet
+        # registration stays live — until server.close().
+        m.update(obs)
     if cfg.get("telemetry_dir"):
         # final scrape snapshot for offline tooling: telemetry_report
         # tabulates the labeled series (per-worker rejections, anomaly

@@ -468,6 +468,9 @@ class ShmPSServer(PSServerTelemetry):
         # §5.3: MPI aborted the whole job; here the server observes)
         self.last_seen: Dict[int, float] = {}
         self._t0 = time.time()
+        # uptime anchor for the canonical ts/uptime_s keys: monotonic,
+        # per server GENERATION (a supervisor restart resets it)
+        self._t0_mono = time.monotonic()
 
     def publish(self, params: PyTree) -> None:
         self.publish_flat(_flatten(params))
@@ -619,7 +622,10 @@ class ShmPSServer(PSServerTelemetry):
     def close(self):
         # the /metrics + /health endpoint (PSServerTelemetry mixin) dies
         # with the server — a supervisor restart can never leak a socket;
-        # the serving core's read tier follows the same rule
+        # the serving core's read tier follows the same rule, and the
+        # observability plane (profiler thread, TSDB flush, fleet
+        # registration) is torn down the same way
+        self.close_observability()
         self.close_metrics_http()
         sc = getattr(self, "serving_core", None)
         if sc is not None:

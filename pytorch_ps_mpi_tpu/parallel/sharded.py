@@ -162,6 +162,20 @@ def server_main(shard_id: int, n_shards: int, port: int,
         core = ServingCore(server, scfg, monitors=False,
                            tenant=f"shard{shard_id}")
 
+    # per-shard fleet observability plane: retained metrics history +
+    # SLO watchdog + continuous profiler, and — with cfg["fleet_dir"] —
+    # registration of THIS shard's endpoint under "shard<i>" so one
+    # /fleet scrape covers the whole sharded fleet (a restarted shard
+    # re-registers under the same name and rejoins the pane). Fleet
+    # membership NEEDS a live endpoint: a fleet_dir with no explicit
+    # metrics/health port still binds one (auto-assigned, in the hello)
+    if (cfg.get("fleet_dir") or cfg.get("fleet")) and health_port is None:
+        health_port = server.start_metrics_http(0)
+    ocfg = dict(cfg)
+    ocfg["fleet_role"] = "shard"
+    ocfg.pop("fleet_name", None)
+    server.arm_observability(ocfg, name=f"shard{shard_id}")
+
     ckpt = None
     applied_before = 0
     checkpoint_every = int(cfg.get("checkpoint_every", 50))
@@ -215,7 +229,15 @@ def server_main(shard_id: int, n_shards: int, port: int,
         # Workers that instead survive a server crash and push only their
         # remaining steps exit via the bounded server_timeout, not a hang.
         deadline = time.time() + float(cfg.get("server_timeout", 300.0))
+        next_tick = 0.0
         while server.grads_received < expected and time.time() < deadline:
+            now = time.monotonic()
+            if now >= next_tick:
+                next_tick = now + float(cfg.get("tick_interval", 0.2))
+                if server.timeseries_db is not None:
+                    # TSDB sample + SLO sweep, serve-thread only — the
+                    # same tick discipline as the single-server loop
+                    server.observability_tick()
             item = server.poll_grad()
             if item is None:
                 time.sleep(0.0005)
@@ -257,6 +279,8 @@ def server_main(shard_id: int, n_shards: int, port: int,
                                if tracker is not None else {}),
             serving=json.dumps(core.serving_snapshot()
                                if core is not None else {}),
+            slo=json.dumps(server.slo_watchdog.snapshot()
+                           if server.slo_watchdog is not None else {}),
         )
     finally:
         if tracker is not None:

@@ -138,6 +138,27 @@ def get_lib() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def native_profile_stats() -> Optional[dict]:
+    """The epoll-pump cycle counters (calls / events / wall ns / frames
+    validated) — the native half of continuous profiling
+    (telemetry.profiler). Reads the ALREADY-loaded library only (never
+    triggers a build); None when unavailable or built before the
+    counters existed."""
+    lib = _lib
+    if lib is None or not hasattr(lib, "tps_profile_stats"):
+        return None
+    calls = ctypes.c_uint64()
+    events = ctypes.c_uint64()
+    ns = ctypes.c_uint64()
+    frames = ctypes.c_uint64()
+    lib.tps_profile_stats(ctypes.byref(calls), ctypes.byref(events),
+                          ctypes.byref(ns), ctypes.byref(frames))
+    return {"pump_calls": int(calls.value),
+            "pump_events": int(events.value),
+            "pump_ns": int(ns.value),
+            "frames_validated": int(frames.value)}
+
+
 class TcpPSServer(PSServerTelemetry):
     """Owns params; serves snapshots and consumes gradients arriving over
     TCP in arrival order. Same role/surface as ``ShmPSServer``; pass
@@ -228,6 +249,9 @@ class TcpPSServer(PSServerTelemetry):
         self.last_seen: Dict[int, float] = {}
         self._ever_connected: set = set()
         self._t0 = time.time()
+        # uptime anchor for the canonical ts/uptime_s keys: monotonic,
+        # per server GENERATION (a supervisor restart resets it)
+        self._t0_mono = time.monotonic()
         # /metrics + /health HTTP: start_metrics_http / close_metrics_http
         # live on PSServerTelemetry (shared with the shm server)
         self._metrics_http = None
@@ -456,6 +480,9 @@ class TcpPSServer(PSServerTelemetry):
         return out
 
     def close(self):
+        # observability plane first (profiler thread, TSDB flush, fleet
+        # deregistration), then the endpoint it was served from
+        self.close_observability()
         self.close_metrics_http()
         # the read tier dies with the server (same rule as the /metrics
         # endpoint): a supervisor restart can never leak its listener

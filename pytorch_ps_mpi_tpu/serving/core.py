@@ -158,6 +158,8 @@ class ServingCore:
         # standalone core (no transport server): serve /metrics + /health
         # from an endpoint of our own, same routes as PSServerTelemetry
         self._own_http = None
+        self._fleet = None
+        self._fleet_registration = None
         if server is None:
             http_port = cfg.get("metrics_port")
             if http_port is None:
@@ -171,10 +173,34 @@ class ServingCore:
                     self._reg.prometheus_text, port=int(http_port),
                     routes={"/health": lambda: (json.dumps(
                         {"armed": False, "workers": [],
+                         "ts": time.time(),
+                         "uptime_s": round(
+                             time.monotonic() - self._t0, 3),
                          "serving": self.serving_snapshot()}),
                         "application/json")},
                 )
                 self.metrics_http_port = self._own_http.port
+            # the read tier joins the fleet pane like any server: with a
+            # fleet_dir it registers its endpoint (default name "read-
+            # tier") and serves the merged /fleet snapshot itself
+            if cfg.get("fleet") or cfg.get("fleet_dir"):
+                from pytorch_ps_mpi_tpu.telemetry import fleet as _fleet
+
+                self._fleet = _fleet.FleetMonitor(
+                    endpoints=cfg.get("fleet_endpoints"),
+                    fleet_dir=cfg.get("fleet_dir"),
+                    **(cfg.get("fleet_kw") or {}))
+                if self._own_http is not None:
+                    self._own_http.add_route(
+                        "/fleet", self._fleet.render_http)
+                    if cfg.get("fleet_dir"):
+                        fname = str(cfg.get("fleet_name") or "read-tier")
+                        _fleet.register_endpoint(
+                            cfg["fleet_dir"], fname,
+                            self._own_http.port,
+                            role=cfg.get("fleet_role", "read"))
+                        self._fleet_registration = (cfg["fleet_dir"],
+                                                    fname)
         self._register_scrape()
 
     # -- monitor plumbing -------------------------------------------------
@@ -220,6 +246,12 @@ class ServingCore:
                 int(http_port))
             print(f"prometheus /metrics + /health on port "
                   f"{self.metrics_http_port}", flush=True)
+        # the fleet observability plane (metrics history / SLO watchdog /
+        # continuous profiler / fleet pane) — attached AFTER the endpoint
+        # so fleet registration can carry the bound port; the mixin owns
+        # the construction so the sharded shard-server wires identically
+        if hasattr(server, "arm_observability"):
+            server.arm_observability(cfg)
 
     def tick(self) -> None:
         """Monitor upkeep at the owning loop's tick cadence (same-thread
@@ -228,6 +260,11 @@ class ServingCore:
             self.health.tick()
         if self.numerics is not None:
             self.numerics.tick()
+        srv = self.server
+        if srv is not None and srv.timeseries_db is not None:
+            # TSDB sample + SLO sweep (both self-throttled) — one attr
+            # check per tick when the observability plane is unarmed
+            srv.observability_tick()
 
     # -- publish ----------------------------------------------------------
     def _ensure_tenant(self, tenant: str, template: PyTree
@@ -504,6 +541,17 @@ class ServingCore:
 
     def _register_scrape(self) -> None:
         def collect(r) -> None:
+            if self.server is None:
+                # a standalone (read-only) core has no ps_server_registry
+                # emitting the fleet poller's ordering/aging gauges —
+                # emit them here so a restarted read tier is detectable
+                # (uptime resets) and its samples can be aged
+                r.gauge("ps_scrape_ts_seconds",
+                        "wall-clock timestamp of this scrape").set(
+                            time.time())
+                r.gauge("ps_uptime_seconds",
+                        "monotonic age of this serving-core generation"
+                        ).set(max(0.0, time.monotonic() - self._t0))
             m = self.read_metrics()
             r.counter("ps_reads_total",
                       "read-tier requests served (all kinds)").set(
@@ -553,6 +601,13 @@ class ServingCore:
         if self.read_server is not None:
             self.read_server.close()
             self.read_server = None
+        reg, self._fleet_registration = self._fleet_registration, None
+        if reg is not None:
+            from pytorch_ps_mpi_tpu.telemetry.fleet import (
+                deregister_endpoint,
+            )
+
+            deregister_endpoint(*reg)
         if self._own_http is not None:
             self._own_http.close()
             self._own_http = None

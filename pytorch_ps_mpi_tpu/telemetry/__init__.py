@@ -38,9 +38,29 @@ dicts). One system, three faces:
   quarantines non-finite pushes with a skip/zero/abort policy, and
   writes divergence postmortems.
 
+- :mod:`timeseries <.timeseries>` — the layer that makes the streams
+  RETAINED: :class:`MetricsHistory`, a dependency-free in-process TSDB
+  (raw + 1 s/10 s/60 s downsampled rings per canonical metric key,
+  sampled at the serve loop's tick cadence, persisted with bounded
+  retention, served at ``/history``).
+- :mod:`profiler <.profiler>` — the layer that watches the TIME:
+  :class:`SamplingProfiler`, an always-on ~100 Hz collapsed-stack
+  sampler with a hard self-overhead budget, plus the native fold/pump
+  cycle counters (``wirecodec``/``tcpps``).
+- :mod:`slo <.slo>` — the layer that turns history into ALERTS:
+  :class:`SLOWatchdog`, multi-window burn-rate rules over the TSDB with
+  bench-derived targets, latched replayable verdicts, and the
+  ``ps_slo_*`` scrape instruments.
+- :mod:`fleet <.fleet>` — the layer that merges the PANES:
+  :class:`FleetMonitor` polls every registered endpoint (sharded
+  servers, supervisor generations, the read tier) into one ``/fleet``
+  snapshot with summed counters, worst-verdict rollup and per-shard
+  skew detection; ``tools/ps_top.py --fleet`` renders it live.
+
 ``tools/telemetry_report.py`` turns a recorded JSONL into the per-phase
 summary table; ``make telemetry-smoke`` bounds the enabled-recorder
-overhead against the disabled path.
+overhead against the disabled path; ``make obs-smoke`` gates the
+observability plane end-to-end.
 """
 
 from pytorch_ps_mpi_tpu.telemetry.recorder import (
@@ -86,6 +106,27 @@ from pytorch_ps_mpi_tpu.telemetry.trace_export import (
     export_chrome_trace,
     merged_trace_events,
 )
+from pytorch_ps_mpi_tpu.telemetry.timeseries import (
+    MetricsHistory,
+    history_from_rows,
+    load_timeseries_rows,
+)
+from pytorch_ps_mpi_tpu.telemetry.profiler import (
+    SamplingProfiler,
+    load_profile,
+    merge_profiles,
+    top_frames,
+)
+from pytorch_ps_mpi_tpu.telemetry.slo import (
+    SLOWatchdog,
+    derive_targets,
+)
+from pytorch_ps_mpi_tpu.telemetry.fleet import (
+    FleetMonitor,
+    deregister_endpoint,
+    parse_prometheus_text,
+    register_endpoint,
+)
 
 __all__ = [
     "FlightRecorder",
@@ -119,4 +160,17 @@ __all__ = [
     "update_weight_ratio",
     "export_chrome_trace",
     "merged_trace_events",
+    "MetricsHistory",
+    "history_from_rows",
+    "load_timeseries_rows",
+    "SamplingProfiler",
+    "load_profile",
+    "merge_profiles",
+    "top_frames",
+    "SLOWatchdog",
+    "derive_targets",
+    "FleetMonitor",
+    "deregister_endpoint",
+    "parse_prometheus_text",
+    "register_endpoint",
 ]

@@ -468,9 +468,14 @@ class HealthMonitor:
                 # gradient-composed publish (1.0 = compressed-domain
                 # rounds), explicit-request fallbacks
                 "agg_mode", "decodes_per_publish", "agg_fallbacks")})
+        t_wall = time.time()
         out = {
             "armed": True,
-            "t_wall": time.time(),
+            "t_wall": t_wall,
+            # canonical sample-ordering fields (this PR's satellite):
+            # every /health payload carries ts + uptime_s so the fleet
+            # poller can order and age member samples uniformly
+            "ts": t_wall,
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "n_workers": self.num_workers,
             "fleet": fleet,
@@ -490,6 +495,14 @@ class HealthMonitor:
             # depth, per-tenant read counts, shed/coalesce counters —
             # the read tier's half of the fleet picture
             out["serving"] = sc.serving_snapshot()
+        wd = getattr(self.server, "slo_watchdog", None)
+        if wd is not None:
+            # the slo section: per-rule burn rates, latched breach
+            # states, recent verdicts — what the fleet pane rolls up
+            out["slo"] = wd.snapshot()
+        db = getattr(self.server, "timeseries_db", None)
+        if db is not None:
+            out["history"] = db.snapshot()
         return out
 
     def render_json(self) -> str:

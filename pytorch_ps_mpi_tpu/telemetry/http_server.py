@@ -11,14 +11,35 @@ paths to render callables returning ``(body_str, content_type)`` — the
 ops side-channel the diagnosis layer uses for its ``/health`` JSON
 (:mod:`.diagnosis`). Routes are resolved at REQUEST time, so a route
 registered after construction (a health monitor attached mid-run) is
-served without restarting the listener.
+served without restarting the listener. A route callable that accepts
+a positional argument receives the parsed query string as a flat dict
+(last value wins) — how ``/history?key=...&window=...`` and
+``/fleet?force=1`` take parameters without a second dispatch layer.
 """
 
 from __future__ import annotations
 
+import inspect
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
+
+
+def _wants_query(fn: Callable) -> bool:
+    """True when ``fn`` can take the query dict as its one positional
+    argument (bound methods and lambdas alike); resolved ONCE at
+    registration, so request dispatch stays a plain call."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            return True
+        if p.kind == p.VAR_POSITIONAL:
+            return True
+    return False
 
 _CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -39,12 +60,19 @@ class MetricsHTTPServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0].rstrip("/")
+                path, _, qs = self.path.partition("?")
+                path = path.rstrip("/")
                 try:
                     if path in ("/metrics", ""):
                         body, ctype = outer._render(), _CONTENT_TYPE
                     elif path in outer.routes:
-                        body, ctype = outer.routes[path]()
+                        fn, wants_query = outer.routes[path]
+                        if wants_query:
+                            query = {k: v[-1] for k, v in
+                                     urllib.parse.parse_qs(qs).items()}
+                            body, ctype = fn(query)
+                        else:
+                            body, ctype = fn()
                     else:
                         self.send_error(404)
                         return
@@ -62,8 +90,10 @@ class MetricsHTTPServer:
                 pass
 
         self._render = render
-        self.routes: Dict[str, Callable[[], Tuple[str, str]]] = dict(
-            routes or {})
+        # path -> (callable, wants_query) — signature resolved once here
+        self.routes: Dict[str, Tuple[Callable, bool]] = {}
+        for p, fn in (routes or {}).items():
+            self.routes[p.rstrip("/") or p] = (fn, _wants_query(fn))
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self.port = int(self._httpd.server_address[1])
@@ -74,10 +104,10 @@ class MetricsHTTPServer:
         self._thread.start()
 
     def add_route(self, path: str,
-                  render: Callable[[], Tuple[str, str]]) -> None:
-        """Register ``path`` → ``render() -> (body, content_type)`` on
-        the live listener (request-time dispatch — no restart)."""
-        self.routes[path.rstrip("/")] = render
+                  render: Callable[..., Tuple[str, str]]) -> None:
+        """Register ``path`` → ``render([query]) -> (body, content_type)``
+        on the live listener (request-time dispatch — no restart)."""
+        self.routes[path.rstrip("/")] = (render, _wants_query(render))
 
     def close(self) -> None:
         httpd, self._httpd = self._httpd, None

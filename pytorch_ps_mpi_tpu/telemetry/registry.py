@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 _INF = float("inf")
@@ -285,6 +286,12 @@ class MetricsRegistry:
 #: (the reference's msg/packaged-bytes accounting, ``ps.py:135-136``,
 #: plus the async protocol's staleness drop counter).
 PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
+    # sample ordering/aging for the fleet poller (telemetry.fleet): ts
+    # is the wall clock at metrics() time, uptime_s the monotonic age of
+    # this server PROCESS GENERATION (a supervisor restart resets it —
+    # how the poller tells a respawned generation from a stale scrape)
+    "ts",
+    "uptime_s",
     "grads_received",
     "bytes_received",
     "raw_bytes_per_grad",
@@ -408,7 +415,12 @@ def ps_server_metrics(server) -> Dict[str, float]:
     # the transport's own worker-read path (TCP GET_PARAMS) counts too:
     # totals and cheap not-modified replies ride the same canonical keys
     nat_total, nat_nm = getattr(server, "_native_read_stats", (0, 0))
+    t0_mono = getattr(server, "_t0_mono", None)
+    if t0_mono is None:  # fake/test servers: anchor at first metrics()
+        t0_mono = server.__dict__.setdefault("_t0_mono", time.monotonic())
     return {
+        "ts": time.time(),
+        "uptime_s": max(0.0, time.monotonic() - t0_mono),
         "grads_received": float(server.grads_received),
         "bytes_received": float(server.bytes_received),
         "raw_bytes_per_grad": raw,
@@ -468,6 +480,13 @@ def ps_server_registry(
 
     def collect(r: MetricsRegistry) -> None:
         m = ps_server_metrics(server)
+        # sample ordering/aging for the fleet poller: every scrape is
+        # stamped with its wall time + the server generation's uptime
+        r.gauge("ps_scrape_ts_seconds",
+                "wall-clock timestamp of this scrape").set(m["ts"])
+        r.gauge("ps_uptime_seconds",
+                "monotonic age of this server generation").set(
+                    m["uptime_s"])
         r.counter("ps_grads_received_total",
                   "gradients consumed by the server").set(m["grads_received"])
         r.counter("ps_wire_bytes_received_total",
@@ -591,6 +610,18 @@ class PSServerTelemetry:
     #: the canonical ``reads_*`` metrics source), set by
     #: :class:`~pytorch_ps_mpi_tpu.serving.ServingCore` on construction
     serving_core: Optional[Any] = None
+    #: the retained metrics history (``/history``'s source), set by
+    #: :meth:`arm_observability` — see :mod:`.timeseries`
+    timeseries_db: Optional[Any] = None
+    #: the SLO burn-rate watchdog (``/health``'s ``slo`` section + the
+    #: ``ps_slo_*`` instruments), set by :meth:`arm_observability`
+    slo_watchdog: Optional[Any] = None
+    #: the fleet poller (``/fleet``'s source), set by
+    #: :meth:`arm_observability` — see :mod:`.fleet`
+    fleet_monitor: Optional[Any] = None
+    #: the continuous sampling profiler, set (and started) by
+    #: :meth:`arm_observability` — see :mod:`.profiler`
+    profiler: Optional[Any] = None
 
     @property
     def frames_rejected(self) -> Dict[int, int]:
@@ -626,14 +657,47 @@ class PSServerTelemetry:
 
         mon = self.health_monitor
         if mon is None:
-            doc: Dict[str, Any] = {"armed": False, "workers": []}
+            m = ps_server_metrics(self)
+            # ts/uptime_s on the monitor-less document too: the fleet
+            # poller orders and ages every member's samples uniformly
+            doc: Dict[str, Any] = {"armed": False, "workers": [],
+                                   "ts": m["ts"],
+                                   "uptime_s": round(m["uptime_s"], 3)}
             sc = self.serving_core
             if sc is not None and sc.armed:
                 # a read-only / monitor-less server still reports its
                 # serving tier: ring occupancy, queue depth, read counts
                 doc["serving"] = sc.serving_snapshot()
+            if self.slo_watchdog is not None:
+                doc["slo"] = self.slo_watchdog.snapshot()
+            if self.timeseries_db is not None:
+                doc["history"] = self.timeseries_db.snapshot()
             return json.dumps(doc)
         return mon.render_json()
+
+    def history_json(self, query: Optional[Dict[str, Any]] = None
+                     ) -> "tuple[str, str]":
+        """The ``/history`` body: the TSDB's query reply, or an explicit
+        not-armed marker (same discipline as the unarmed ``/health``)."""
+        import json
+
+        db = self.timeseries_db
+        if db is None:
+            return (json.dumps({"armed": False, "key_names": []}),
+                    "application/json")
+        return db.render_http(query)
+
+    def fleet_json(self, query: Optional[Dict[str, Any]] = None
+                   ) -> "tuple[str, str]":
+        """The ``/fleet`` body: the fleet poller's merged snapshot, or
+        an explicit not-armed marker."""
+        import json
+
+        fm = self.fleet_monitor
+        if fm is None:
+            return (json.dumps({"armed": False, "members": {}}),
+                    "application/json")
+        return fm.render_http(query)
 
     def start_metrics_http(self, port: int = 0,
                            host: str = "0.0.0.0") -> int:
@@ -648,12 +712,16 @@ class PSServerTelemetry:
                 MetricsHTTPServer,
             )
 
-            # the route reads health_monitor at REQUEST time: a monitor
+            # the routes read their monitors at REQUEST time: a monitor
             # attached after the listener started is served immediately
+            # (/history and /fleet render the explicit not-armed marker
+            # until arm_observability attaches their sources)
             self._metrics_http = MetricsHTTPServer(
                 self.prometheus_text, port=port, host=host,
                 routes={"/health": lambda: (self.health_json(),
-                                            "application/json")},
+                                            "application/json"),
+                        "/history": self.history_json,
+                        "/fleet": self.fleet_json},
             )
         return self._metrics_http.port
 
@@ -662,3 +730,122 @@ class PSServerTelemetry:
         self._metrics_http = None
         if http is not None:
             http.close()
+
+    # -- fleet observability plane (timeseries / profiler / SLO / fleet) --
+    def arm_observability(self, cfg: Dict[str, Any], *,
+                          name: str = "server") -> None:
+        """Attach the retained-history plane from the job ``cfg`` — the
+        one wiring point every core-based server shares (``serve()``
+        through the ServingCore, ``sharded.server_main`` directly):
+
+        - ``cfg["timeseries"]`` / ``timeseries_kw`` — the in-process
+          TSDB, sampled by :meth:`observability_tick` on the serve
+          thread, persisted into ``timeseries_dir`` (falls back to
+          ``telemetry_dir``), served at ``/history``;
+        - ``cfg["slo"]`` / ``slo_kw`` — the burn-rate watchdog over that
+          TSDB (auto-arms it), verdicts into ``slo-<name>.jsonl`` + the
+          flight recorder + ``/health``'s ``slo`` section;
+        - ``cfg["profile"]`` / ``profile_dir`` / ``profile_kw`` — the
+          continuous sampling profiler, started here, written to
+          ``profile-<name>.txt`` by :meth:`close_observability`;
+        - ``cfg["fleet"]`` / ``fleet_dir`` / ``fleet_kw`` — the fleet
+          poller behind ``/fleet``; with a ``fleet_dir`` and a live
+          metrics endpoint this server also REGISTERS itself there
+          (name ``cfg["fleet_name"]`` or ``name``), so a supervisor-
+          respawned generation rejoins the pane under the same name.
+        """
+        out_dir = cfg.get("timeseries_dir") or cfg.get("telemetry_dir")
+        if (cfg.get("timeseries") or cfg.get("timeseries_kw")
+                or cfg.get("slo") or cfg.get("slo_kw")):
+            from pytorch_ps_mpi_tpu.telemetry.timeseries import (
+                MetricsHistory,
+            )
+
+            self.timeseries_db = MetricsHistory(
+                dir=out_dir, name=name,
+                **(cfg.get("timeseries_kw") or {}))
+        if cfg.get("slo") or cfg.get("slo_kw"):
+            from pytorch_ps_mpi_tpu.telemetry.slo import SLOWatchdog
+
+            # attaches itself to self.slo_watchdog + scrape registry
+            SLOWatchdog(self, cfg, history=self.timeseries_db,
+                        name=name, dir=out_dir)
+        if cfg.get("profile") or cfg.get("profile_dir"):
+            from pytorch_ps_mpi_tpu.telemetry.profiler import (
+                SamplingProfiler,
+            )
+
+            self.profiler = SamplingProfiler(
+                name=name,
+                dir=cfg.get("profile_dir") or cfg.get("telemetry_dir"),
+                **(cfg.get("profile_kw") or {})).start()
+        if cfg.get("fleet") or cfg.get("fleet_dir"):
+            from pytorch_ps_mpi_tpu.telemetry import fleet as _fleet
+
+            self.fleet_monitor = _fleet.FleetMonitor(
+                endpoints=cfg.get("fleet_endpoints"),
+                fleet_dir=cfg.get("fleet_dir"),
+                **(cfg.get("fleet_kw") or {}))
+            http = getattr(self, "_metrics_http", None)
+            if cfg.get("fleet_dir") and http is not None:
+                fname = str(cfg.get("fleet_name") or name)
+                _fleet.register_endpoint(
+                    cfg["fleet_dir"], fname, http.port,
+                    role=cfg.get("fleet_role", "server"))
+                self.__dict__["_fleet_registration"] = (
+                    cfg["fleet_dir"], fname)
+
+    def observability_tick(self) -> None:
+        """Sample the TSDB + evaluate the SLO rules — called from the
+        owning loop at tick cadence, same thread as the transport pumps
+        (file appends and plain-dict folds only). One attr check when
+        nothing is armed."""
+        db = self.timeseries_db
+        if db is not None:
+            db.sample(self.metrics())
+            wd = self.slo_watchdog
+            if wd is not None:
+                wd.evaluate()
+
+    def finalize_observability(self) -> Dict[str, Any]:
+        """Flush/stop the observability plane and return the final
+        section snapshots + artifact paths. Idempotent (the serve loop
+        calls it to collect its metrics sections; ``close()`` calls it
+        again as a backstop). The sources — and the fleet registration —
+        stay ATTACHED: ``/history`` and ``/fleet`` keep answering, and
+        the member keeps its pane card, until the endpoint itself dies
+        with ``server.close()``, same lifetime as ``/metrics`` and
+        ``/health``."""
+        out: Dict[str, Any] = {}
+        first = not self.__dict__.get("_obs_closed", False)
+        self.__dict__["_obs_closed"] = True
+        prof = self.profiler
+        if prof is not None:
+            prof.stop()
+            path = prof.write() if first else None
+            out["profile"] = prof.snapshot()
+            if path is not None:
+                out["profile"]["file"] = path
+        db = self.timeseries_db
+        if db is not None:
+            db.close()  # flush buffered rows; queries keep working
+            out["history"] = db.snapshot()
+        wd = self.slo_watchdog
+        if wd is not None:
+            wd.close()
+            out["slo"] = wd.snapshot()
+        return out
+
+    def close_observability(self) -> Dict[str, Any]:
+        """:meth:`finalize_observability` + fleet deregistration — the
+        transport ``close()`` teardown: the member leaves the pane only
+        when the server generation really dies."""
+        out = self.finalize_observability()
+        reg = self.__dict__.pop("_fleet_registration", None)
+        if reg is not None:
+            from pytorch_ps_mpi_tpu.telemetry.fleet import (
+                deregister_endpoint,
+            )
+
+            deregister_endpoint(*reg)
+        return out

@@ -124,6 +124,27 @@ def get_lib() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def fold_profile_stats() -> Optional[dict]:
+    """The wc_fold_* cycle counters (calls / elements / wall ns) — the
+    native half of continuous profiling (telemetry.profiler). Reads the
+    ALREADY-loaded library only (never triggers a build: a process that
+    armed no folds reports nothing, not zeros); None when unavailable
+    or built before the counters existed."""
+    lib = _lib
+    if lib is None or not getattr(lib, "_has_folds", False):
+        return None
+    if not hasattr(lib, "wc_profile_stats"):
+        return None
+    calls = ctypes.c_uint64()
+    elems = ctypes.c_uint64()
+    ns = ctypes.c_uint64()
+    lib.wc_profile_stats(ctypes.byref(calls), ctypes.byref(elems),
+                         ctypes.byref(ns))
+    return {"fold_calls": int(calls.value),
+            "fold_elems": int(elems.value),
+            "fold_ns": int(ns.value)}
+
+
 def _u8(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 

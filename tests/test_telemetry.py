@@ -58,6 +58,15 @@ def test_server_metrics_canonical_schema(transport):
         m = server.metrics()
         assert tuple(sorted(m)) == tuple(sorted(PS_SERVER_METRIC_KEYS))
         assert all(type(v) is float for v in m.values()), m
+        # the fleet-poller ordering/aging fields: ts is the wall clock
+        # at metrics() time, uptime_s the server generation's monotonic
+        # age — fresh server, so small but nonnegative and advancing
+        import time
+
+        assert abs(m["ts"] - time.time()) < 60.0
+        assert 0.0 <= m["uptime_s"] < 60.0
+        m2 = server.metrics()
+        assert m2["ts"] >= m["ts"] and m2["uptime_s"] >= m["uptime_s"]
     finally:
         server.close()
 
@@ -69,7 +78,14 @@ def test_server_metrics_identical_across_transports():
     s1 = _make_server("shm", tpl)
     s2 = _make_server("tcp", tpl)
     try:
-        assert s1.metrics() == s2.metrics()
+        m1, m2 = s1.metrics(), s2.metrics()
+        # ts/uptime_s are clock-valued by design (the fleet poller's
+        # sample-ordering fields) — present on both, compared apart
+        for m in (m1, m2):
+            assert "ts" in m and "uptime_s" in m
+        drop = ("ts", "uptime_s")
+        assert {k: v for k, v in m1.items() if k not in drop} \
+            == {k: v for k, v in m2.items() if k not in drop}
     finally:
         s1.close()
         s2.close()

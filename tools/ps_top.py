@@ -40,12 +40,24 @@ gating → numerics → reads) · ``n`` jump straight to the numerics sort
 (NaN count, then grad norm) · ``e`` jump to the exact-e2e-latency
 sort · ``d`` jump to the reads sort · ``r`` force an immediate
 refresh.
+
+``--fleet`` switches to the fleet pane: ``target`` is then a fleet
+registration DIRECTORY (``cfg["fleet_dir"]`` — sharded servers,
+supervisor generations and the read tier register themselves there) or
+a comma-separated list of base endpoints (``host:port`` / URLs). One
+frame shows the merged rollup (summed counters, worst verdict, SLO
+breach totals), per-shard skew flags, one row per member, and history
+sparklines per metric pulled from each member's ``/history`` route::
+
+  python tools/ps_top.py --fleet /tmp/run/fleet
+  python tools/ps_top.py --fleet 127.0.0.1:9100,127.0.0.1:9101 --once
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.request
@@ -59,6 +71,130 @@ _VERDICT_ORDER = {"quarantined": 0, "missing": 1, "churning": 2, "slow": 3,
 _COLOR = {"ok": "\x1b[32m", "slow": "\x1b[33m", "churning": "\x1b[35m",
           "missing": "\x1b[31m", "quarantined": "\x1b[31m"}
 _RESET = "\x1b[0m"
+
+
+#: (key, counter?) sparkline rows per member in the fleet pane —
+#: counters spark their per-sample DELTAS (activity), gauges the values
+FLEET_SPARK_KEYS = (("grads_received", True), ("staleness_p95", False),
+                    ("push_e2e_p95_ms", False), ("reads_total", True))
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(vals: List[float], width: int = 24) -> str:
+    """Unicode min-max sparkline of the last ``width`` values (pure)."""
+    vals = [float(v) for v in vals][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        return _SPARK_BLOCKS[0] * len(vals)
+    return "".join(
+        _SPARK_BLOCKS[min(7, int((v - lo) / (hi - lo) * 7.999))]
+        for v in vals)
+
+
+def fetch_history_values(base_url: str, key: str, window: float = 120.0,
+                         timeout: float = 2.0) -> List[float]:
+    """One member's ``/history`` points for ``key`` → the value list
+    ([] on any failure — a dead member must not kill the pane)."""
+    url = (f"{base_url.rstrip('/')}/history?key={key}"
+           f"&window={window:g}")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            doc = json.loads(r.read().decode())
+        return [float(p[1]) for p in doc.get("points") or []]
+    except Exception:
+        return []
+
+
+def render_fleet(snap: Dict[str, Any],
+                 histories: Optional[Dict[Any, List[float]]] = None,
+                 color: bool = False) -> str:
+    """One fleet-pane frame from a ``/fleet`` document plus optional
+    ``{(member, key): values}`` history series (pure — the testable
+    core, like :func:`render_table`)."""
+    if not snap.get("armed", True) and not snap.get("members"):
+        return "fleet monitor not armed / no members registered"
+    lines: List[str] = []
+    fleet = snap.get("fleet", {})
+    slo = snap.get("slo", {})
+    worst = fleet.get("worst_verdict") or "-"
+    lines.append(
+        f"ps_top --fleet  members={snap.get('n_ok', 0)}/"
+        f"{snap.get('n_members', 0)} ok  "
+        f"grads={int(fleet.get('grads_received', 0))}  "
+        f"stale_drops={int(fleet.get('stale_drops', 0))}  "
+        f"reads={int(fleet.get('reads_total', 0))}  "
+        f"shed={int(fleet.get('reads_shed', 0))}  "
+        f"worst={worst}  "
+        f"slo_breaches={int(slo.get('breaches_total', 0))}"
+        + (f"  BURNING: {','.join(slo.get('burning', []))}"
+           if slo.get("burning") else "")
+    )
+    for key, s in sorted((snap.get("skew") or {}).items()):
+        flag = "SKEW" if s.get("flagged") else "ok"
+        lines.append(
+            f"  skew[{key}]: min={s.get('min', 0):g} "
+            f"max={s.get('max', 0):g} "
+            f"spread={s.get('spread_frac', 0) * 100:.0f}% [{flag}]")
+    cols = ["member", "role", "ok", "verdict", "grads", "version",
+            "stale-p95", "e2e-p95", "reads", "up", "age"]
+    rows = []
+    members = sorted((snap.get("members") or {}).values(),
+                     key=lambda m: m.get("name", ""))
+    for m in members:
+        mm = m.get("metrics") or {}
+        rows.append([
+            str(m.get("name")), str(m.get("role", "-")),
+            "yes" if m.get("ok") else (m.get("error") or "no"),
+            m.get("verdict") or "-",
+            str(int(mm.get("grads_received", 0))),
+            str(int(mm.get("publish_version", 0))),
+            f"{mm.get('staleness_p95', 0):.1f}",
+            f"{mm.get('push_e2e_p95_ms', 0):.1f}",
+            str(int(mm.get("reads_total", 0))),
+            f"{m.get('uptime_s') or 0:.0f}s",
+            "-" if m.get("age_s") is None else f"{m['age_s']:.1f}s",
+        ])
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    fmt = "  ".join(f"{{:<{w}}}" if i in (0, 1, 2, 3) else f"{{:>{w}}}"
+                    for i, w in enumerate(widths))
+    lines.append(fmt.format(*cols))
+    lines.append("  ".join("-" * w for w in widths))
+    for m, r in zip(members, rows):
+        line = fmt.format(*r)
+        if color and (m.get("verdict") in _COLOR):
+            line = _COLOR[m["verdict"]] + line + _RESET
+        lines.append(line)
+    if histories:
+        lines.append("")
+        lines.append("history (sparklines, oldest→newest):")
+        for (member, key), vals in sorted(histories.items()):
+            if not vals:
+                continue
+            lines.append(f"  {member:<12} {key:<18} "
+                         f"{sparkline(vals)}  last={vals[-1]:g}")
+    lines.append("[fleet]  q quit · p pause · r refresh")
+    return "\n".join(lines)
+
+
+def fleet_histories(snap: Dict[str, Any], window: float = 120.0
+                    ) -> Dict[Any, List[float]]:
+    """Pull the sparkline series for every ok member (counters become
+    per-sample deltas so the spark shows ACTIVITY, not a ramp)."""
+    out: Dict[Any, List[float]] = {}
+    for name, m in (snap.get("members") or {}).items():
+        if not m.get("ok"):
+            continue
+        for key, is_counter in FLEET_SPARK_KEYS:
+            vals = fetch_history_values(m["url"], key, window=window)
+            if is_counter and len(vals) > 1:
+                vals = [max(0.0, b - a) for a, b in zip(vals, vals[1:])]
+            if vals and any(v != 0 for v in vals):
+                out[(name, key)] = vals
+    return out
 
 
 def normalize_url(target: str) -> str:
@@ -252,6 +388,65 @@ class _Keys:
                 sys.stdin.fileno(), self._termios.TCSADRAIN, self._old)
 
 
+def _fleet_monitor(target: str):
+    """A FleetMonitor from the CLI target: a registration directory or
+    a comma-separated endpoint list."""
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from pytorch_ps_mpi_tpu.telemetry.fleet import FleetMonitor
+
+    if os.path.isdir(target):
+        return FleetMonitor(fleet_dir=target)
+    return FleetMonitor(endpoints=[t for t in target.split(",") if t])
+
+
+def _fleet_main(args) -> int:
+    mon = _fleet_monitor(args.target)
+
+    def frame() -> str:
+        snap = mon.poll(force=True)
+        return render_fleet(snap, fleet_histories(
+            snap, window=args.spark_window), color=not args.no_color)
+
+    if args.once:
+        print(render_fleet(mon.poll(force=True), fleet_histories(
+            mon.poll(), window=args.spark_window), color=False))
+        return 0
+    keys = _Keys()
+    paused = False
+    deadline = time.time() + args.duration if args.duration else None
+    out = "(waiting for first fleet poll...)"
+    try:
+        while True:
+            if not paused:
+                try:
+                    out = frame()
+                except Exception as e:
+                    out = f"fleet poll failed: {type(e).__name__}: {e}"
+            sys.stdout.write("\x1b[2J\x1b[H" + out
+                             + ("\n[PAUSED]" if paused else "") + "\n")
+            sys.stdout.flush()
+            t_next = time.time() + args.interval
+            while time.time() < t_next:
+                k = keys.poll()
+                if k == "q":
+                    return 0
+                if k == "p":
+                    paused = not paused
+                    break
+                if k == "r":
+                    break
+                if deadline and time.time() > deadline:
+                    return 0
+                time.sleep(0.05)
+            if deadline and time.time() > deadline:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        keys.restore()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("target",
@@ -263,7 +458,17 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=0.0,
                     help="exit after this many seconds (0 = forever)")
     ap.add_argument("--no-color", action="store_true")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet pane: target is a fleet registration "
+                         "dir (cfg['fleet_dir']) or comma-separated "
+                         "base endpoints")
+    ap.add_argument("--spark-window", type=float, default=120.0,
+                    help="fleet mode: history window for the "
+                         "sparklines (seconds)")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return _fleet_main(args)
     url = normalize_url(args.target)
 
     if args.once:

@@ -23,6 +23,16 @@ Prometheus scrape snapshots (``*.prom`` — ``serve()`` drops
 INCLUDING worker-labeled series (``ps_frames_rejected_total{worker="1"}``,
 ``ps_worker_anomaly_total{...}`` — previously silently ignored): labeled
 instruments are tabulated per worker in their own section.
+
+The fleet observability plane's artifacts get their own sections, all
+routed AWAY from the recorder-span merge: ``timeseries-*.jsonl``
+(``telemetry.timeseries``) → the **history** section (per-key
+first/last/min/max/p95 over the retained samples),
+``profile-*.txt`` (``telemetry.profiler`` collapsed stacks) → the
+**profile** section (profiles from every process MERGED, top-N
+self-time table + native fold/pump cycle counters), and
+``slo-*.jsonl`` (``telemetry.slo``) → the **slo** section (verdict
+counts per rule, breach/recover listing).
 """
 
 from __future__ import annotations
@@ -67,6 +77,8 @@ def collect_files(paths: List[str]) -> List[str]:
             out.extend(sorted(glob.glob(os.path.join(p, "*.prom"))))
             out.extend(sorted(glob.glob(
                 os.path.join(p, "postmortem-*.json"))))
+            out.extend(sorted(glob.glob(
+                os.path.join(p, "profile-*.txt"))))
         else:
             out.append(p)
     if not out:
@@ -74,31 +86,12 @@ def collect_files(paths: List[str]) -> List[str]:
     return out
 
 
-def parse_prometheus_text(text: str) -> List[Dict[str, Any]]:
-    """Prometheus exposition text → ``[{name, labels, value}]`` rows
-    (``# HELP``/``# TYPE`` skipped; label values unescaped enough for
-    the simple labels this stack emits)."""
-    import re
-
-    series: List[Dict[str, Any]] = []
-    line_re = re.compile(
-        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)\s*$")
-    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = line_re.match(line)
-        if not m:
-            continue
-        name, labels_text, raw = m.groups()
-        try:
-            value = float(raw.replace("+Inf", "inf"))
-        except ValueError:
-            continue
-        labels = dict(label_re.findall(labels_text)) if labels_text else {}
-        series.append({"name": name, "labels": labels, "value": value})
-    return series
+# the ONE prometheus-text parser — the fleet poller and this report
+# share it (it moved to the package so in-process consumers need no
+# tools/ import); re-exported here for existing callers
+from pytorch_ps_mpi_tpu.telemetry.fleet import (  # noqa: E402
+    parse_prometheus_text,
+)
 
 
 def _summarize_numerics(traj_rows: List[Dict[str, Any]],
@@ -200,6 +193,94 @@ def _summarize_lineage(rows: List[Dict[str, Any]]
     }
 
 
+def _summarize_history(rows: List[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """The history section: per-key first/last/min/max/p95 over the
+    persisted ``timeseries-*.jsonl`` samples — dead keys (all zeros)
+    dropped so the table shows the metrics that MOVED."""
+    if not rows:
+        return None
+    per_key: Dict[str, List[float]] = {}
+    t_first = t_last = None
+    for r in rows:
+        t = float(r["t"])
+        t_first = t if t_first is None else min(t_first, t)
+        t_last = t if t_last is None else max(t_last, t)
+        for k, v in r["m"].items():
+            per_key.setdefault(k, []).append(float(v))
+    keys = []
+    for k, vals in sorted(per_key.items()):
+        if not any(v != 0.0 for v in vals):
+            continue
+        s = sorted(vals)
+        keys.append({
+            "key": k, "n": len(vals),
+            "first": vals[0], "last": vals[-1],
+            "min": s[0], "max": s[-1],
+            "p95": _percentile(s, 0.95),
+        })
+    return {
+        "samples": len(rows),
+        "span_s": round((t_last - t_first), 3) if rows else 0.0,
+        "keys": keys,
+    }
+
+
+def _summarize_profiles(paths: List[str]) -> Optional[Dict[str, Any]]:
+    """The profile section: every process's collapsed stacks MERGED,
+    top-N self-time, per-file meta (rate/overhead), and the native
+    fold/pump cycle counters summed across processes."""
+    if not paths:
+        return None
+    from pytorch_ps_mpi_tpu.telemetry.profiler import (
+        load_profile,
+        top_frames,
+    )
+
+    merged: Dict[str, int] = {}
+    files = []
+    native: Dict[str, Dict[str, int]] = {}
+    for p in paths:
+        meta, counts = load_profile(p)
+        for stack, n in counts.items():
+            merged[stack] = merged.get(stack, 0) + n
+        files.append({"file": os.path.basename(p),
+                      "name": meta.get("name"),
+                      "samples": meta.get("samples"),
+                      "hz_effective": meta.get("hz_effective"),
+                      "overhead_frac": meta.get("overhead_frac")})
+        for lib, stats in (meta.get("native") or {}).items():
+            acc = native.setdefault(lib, {})
+            for k, v in stats.items():
+                acc[k] = acc.get(k, 0) + int(v)
+    return {
+        "files": files,
+        "samples": sum(merged.values()),
+        "stacks": len(merged),
+        "top": top_frames(merged, 15),
+        "native": native,
+    }
+
+
+def _summarize_slo(rows: List[Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    """The slo section: verdict counts per rule + the event listing."""
+    if not rows:
+        return None
+    per_rule: Dict[str, Dict[str, int]] = {}
+    for r in rows:
+        d = per_rule.setdefault(str(r.get("rule")),
+                                {"breach": 0, "recover": 0})
+        kind = r.get("kind")
+        if kind in d:
+            d[kind] += 1
+    return {
+        "verdicts": len(rows),
+        "rules": [{"rule": k, **v} for k, v in sorted(per_rule.items())],
+        "events": rows[-32:],
+    }
+
+
 def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     """Merged summary over every file: per-span-name stats, event counts,
     and recorder meta (dropped counts make truncation visible)."""
@@ -211,8 +292,37 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     probe_rows: List[Dict[str, Any]] = []
     postmortems: List[Dict[str, Any]] = []
     lineage_rows: List[Dict[str, Any]] = []
+    ts_rows: List[Dict[str, Any]] = []
+    slo_rows: List[Dict[str, Any]] = []
+    profile_paths: List[str] = []
     for path in files:
         base = os.path.basename(path)
+        if base.startswith("profile-") and path.endswith(".txt"):
+            # collapsed-stack profiles (telemetry.profiler) — merged
+            # across processes into the profile section
+            profile_paths.append(path)
+            continue
+        if base.startswith("timeseries-") and path.endswith(".jsonl"):
+            # retained metric history (telemetry.timeseries) — routed to
+            # the history section, never the recorder-span merge
+            from pytorch_ps_mpi_tpu.telemetry.timeseries import (
+                load_timeseries_rows,
+            )
+
+            ts_rows.extend(load_timeseries_rows(path))
+            continue
+        if base.startswith("slo-") and path.endswith(".jsonl"):
+            # SLO verdict events (telemetry.slo) — their own section
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        slo_rows.append(json.loads(line))
+                    except ValueError:
+                        continue
+            continue
         if base.startswith("postmortem-") and path.endswith(".json"):
             # a divergence postmortem dump (telemetry.numerics) — one
             # JSON document, NOT an event JSONL; surface its headline
@@ -309,6 +419,9 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
         ),
         "numerics": _summarize_numerics(traj_rows, probe_rows, postmortems),
         "lineage": _summarize_lineage(lineage_rows),
+        "history": _summarize_history(ts_rows),
+        "profile": _summarize_profiles(profile_paths),
+        "slo": _summarize_slo(slo_rows),
         "dropped_total": sum(m.get("dropped") or 0 for m in meta),
     }
 
@@ -416,6 +529,54 @@ def format_table(summary: Dict[str, Any]) -> str:
                 f"  critical path: worker {c['worker']} "
                 f"[{c['stage']}] gated {c['rounds']} rounds"
             )
+    hist = summary.get("history")
+    if hist:
+        lines.append("")
+        lines.append(
+            f"history ({hist['samples']} samples over "
+            f"{hist['span_s']:.1f}s):")
+        hcols = ["key", "n", "first", "last", "min", "max", "p95"]
+        hrows = [[k["key"], str(k["n"])]
+                 + [f"{k[c]:.4g}" for c in ("first", "last", "min",
+                                            "max", "p95")]
+                 for k in hist["keys"]]
+        hw = [max(len(c), *(len(r[i]) for r in hrows)) if hrows
+              else len(c) for i, c in enumerate(hcols)]
+        hfmt = "  ".join(f"{{:<{w}}}" if i == 0 else f"{{:>{w}}}"
+                         for i, w in enumerate(hw))
+        lines.append("  " + hfmt.format(*hcols))
+        for r in hrows:
+            lines.append("  " + hfmt.format(*r))
+    prof = summary.get("profile")
+    if prof:
+        lines.append("")
+        files_txt = ", ".join(
+            f"{f['name'] or f['file']} ({f['samples']} samples @ "
+            f"{f['hz_effective'] or 0:.0f}Hz, "
+            f"{(f['overhead_frac'] or 0) * 100:.2f}% self)"
+            for f in prof["files"])
+        lines.append(f"profile (merged {len(prof['files'])} processes: "
+                     f"{files_txt}):")
+        for t in prof["top"]:
+            lines.append(
+                f"  {t['self_frac'] * 100:5.1f}%  self={t['self']:<6d} "
+                f"cum={t['cum']:<6d} {t['frame']}")
+        for lib, stats in sorted(prof.get("native", {}).items()):
+            stats_txt = "  ".join(f"{k}={v}" for k, v in sorted(
+                stats.items()))
+            lines.append(f"  native [{lib}]: {stats_txt}")
+    slo = summary.get("slo")
+    if slo:
+        lines.append("")
+        lines.append(f"slo ({slo['verdicts']} verdicts):")
+        for r in slo["rules"]:
+            lines.append(f"  {r['rule']}: {r['breach']} breach / "
+                         f"{r['recover']} recover")
+        for e in slo["events"][-8:]:
+            lines.append(
+                f"  {e.get('kind')} {e.get('rule')} "
+                f"burn_short={e.get('burn_short')} "
+                f"burn_long={e.get('burn_long')} t={e.get('t')}")
     if summary["dropped_total"]:
         lines.append("")
         lines.append(
