@@ -8,10 +8,12 @@
 # parameter-serving read-tier gate (`make read-smoke`).
 test:
 	python -m pytest tests/ -q
+	$(MAKE) analyze
 	$(MAKE) trace-smoke
 	$(MAKE) read-smoke
 	$(MAKE) agg-smoke
 	$(MAKE) native-smoke
+	$(MAKE) native-asan
 	$(MAKE) obs-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
@@ -135,6 +137,35 @@ bench:
 tpu-watch:
 	python tools/tpu_watch.py
 
+# Static-analysis gate (in the default `make test` path): analyze_smoke
+# runs `python -m tools.psanalyze` on the tree (must be SILENT — the
+# five rules: thread-affinity, cfg-schema, metrics-surface,
+# codec-contract, abi-drift) and then proves each rule still fires on
+# its seeded defect (plus pragma suppression and a caught ASan
+# overflow). Appends a bench_gate trajectory row to
+# benchmarks/results/analyze_smoke.jsonl gating analyze wall time.
+analyze:
+	python tools/analyze_smoke.py
+
+# Sanitizer-hardened native builds (native-asan is in the default
+# `make test` path; see tools/native_sanitize.py): each mode compiles
+# all three libraries with the sanitizer into native/_build/<mode>/,
+# runs the native lifecycle drivers (precise leak check — no
+# interpreter to suppress around), and for asan/ubsan re-runs the
+# tests/test_native_fold.py parity suite + live batched ingest with
+# the runtime LD_PRELOADed and LSan armed (tools/lsan.supp). The TSan
+# leg drives the tcpps pump + psqueue seqlock as instrumented
+# executables (LD_PRELOADing libtsan under uninstrumented CPython
+# reports interpreter false positives).
+native-asan:
+	python tools/native_sanitize.py --mode asan
+
+native-ubsan:
+	python tools/native_sanitize.py --mode ubsan
+
+native-tsan:
+	python tools/native_sanitize.py --mode tsan
+
 # -ffp-contract=off: the wc_fold_* kernels may not fuse multiply+add
 # into FMAs — bit-exact parity with the numpy fallback (enforced by
 # tests/test_native_fold.py and the native-smoke gate) pins separate
@@ -182,4 +213,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke analyze native-asan native-ubsan native-tsan

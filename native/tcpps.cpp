@@ -878,4 +878,36 @@ void tps_worker_close(void* wv) {
   delete w;
 }
 
+// ---- ABI self-description -------------------------------------------------
+// The runtime twin of psanalyze's abi-drift rule: tcp.py re-reads the
+// wire constants from the LOADED library at bind time and refuses the
+// library on any mismatch with resilience/frames.py — so a stale or
+// hand-copied .so whose header layout or reason codes drifted fails at
+// load, not as a silent mis-decode mid-training.
+
+uint32_t tps_abi_psf_magic(void) { return kPsfMagicV2; }
+
+uint32_t tps_abi_psf_magic_v1(void) { return kPsfMagicV1; }
+
+uint32_t tps_abi_psf_header_bytes(void) { return (uint32_t)kPsfHeader; }
+
+uint32_t tps_abi_batch_meta_bytes(void) {
+  return (uint32_t)sizeof(BatchMeta);
+}
+
+// Reason string for a FrameStatus code (NULL for unknown/OK) — the
+// enum's names are the protocol, not just labels: Python counts
+// rejections under these exact strings.
+const char* tps_abi_frame_status_name(uint32_t code) {
+  switch (code) {
+    case FRAME_SHORT: return "short";
+    case FRAME_VERSION: return "version";
+    case FRAME_MAGIC: return "magic";
+    case FRAME_SIZE: return "size";
+    case FRAME_CONFIG: return "config";
+    case FRAME_CORRUPT: return "corrupt";
+    default: return nullptr;
+  }
+}
+
 }  // extern "C"

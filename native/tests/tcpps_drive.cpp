@@ -1,0 +1,117 @@
+// Sanitizer driver for the tcpps pump (tools/native_sanitize.py):
+// server pump + batched pop on the main thread, a worker pushing
+// framed gradients from a second thread, the profile-stats atomics
+// polled from a third — the full create/connect/publish/push/pop/close
+// lifecycle.
+//
+// Compiled as an EXECUTABLE including tcpps.cpp directly, once per
+// sanitizer mode:
+// - -fsanitize=thread (make native-tsan): TSan wants the whole program
+//   instrumented — LD_PRELOADing libtsan under an uninstrumented
+//   CPython reports false races in the interpreter itself. The
+//   Python-facing contract ("one thread owns the handle") is what
+//   psanalyze's thread-affinity rule checks statically; this checks
+//   the native side's actual shared state (the socket and the g_*
+//   profile atomics) between pump, worker, and stats reader.
+// - -fsanitize=address / undefined (make native-asan / native-ubsan):
+//   the PRECISE leak/overflow check on the handle lifecycle. The
+//   pytest leg's leak check must suppress everything allocated under
+//   libpython frames (LSan matches any frame, and ctypes calls bottom
+//   out there), so leaks in the libraries themselves are proven here,
+//   where there is no interpreter to suppress around.
+
+#include "../tcpps.cpp"
+
+#include <cassert>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+namespace {
+
+std::vector<uint8_t> make_psf2_frame(uint64_t fingerprint,
+                                     uint32_t payload_len) {
+  std::vector<uint8_t> payload(payload_len);
+  for (uint32_t i = 0; i < payload_len; ++i)
+    payload[i] = (uint8_t)(i * 31 + 7);
+  PsfHeader h{};
+  h.magic = kPsfMagicV2;
+  h.payload_len = payload_len;
+  h.crc = crc32_of(payload.data(), payload.size());
+  h.fingerprint = fingerprint;
+  h.step = 3;
+  h.seq = 11;
+  h.send_wall = 1234.5;
+  std::vector<uint8_t> frame(sizeof(h) + payload.size());
+  std::memcpy(frame.data(), &h, sizeof(h));
+  std::memcpy(frame.data() + sizeof(h), payload.data(), payload.size());
+  return frame;
+}
+
+}  // namespace
+
+int main() {
+  constexpr uint64_t kFingerprint = 0x5053414e414c59ULL;  // arbitrary
+  constexpr uint32_t kPayload = 4096;
+  constexpr int kPushes = 64;
+
+  void* sv = tps_server_create(0, 1, 1 << 20);
+  assert(sv && "server create failed");
+  uint16_t port = tps_server_port(sv);
+  tps_server_set_frame_check(sv, kFingerprint, kPayload);
+  std::vector<uint8_t> params(kPayload, 0xAB);
+  assert(tps_server_publish(sv, params.data(), params.size(), 1) == 0);
+
+  std::thread worker([&] {
+    void* wv = tps_worker_connect("127.0.0.1", port, 0, 10000);
+    assert(wv && "worker connect failed");
+    std::vector<uint8_t> buf(1 << 20);
+    uint64_t version = 0;
+    int64_t n = tps_worker_read_params(wv, buf.data(), buf.size(),
+                                       &version, 10000, 0);
+    assert(n == (int64_t)kPayload && version == 1);
+    std::vector<uint8_t> frame = make_psf2_frame(kFingerprint, kPayload);
+    for (int i = 0; i < kPushes; ++i) {
+      int rc = tps_worker_push_grad(wv, frame.data(), frame.size(),
+                                    version, 10000);
+      assert(rc == 1 && "push failed");
+    }
+    tps_worker_close(wv);
+  });
+
+  std::atomic<bool> done{false};
+  std::thread stats([&] {
+    // the cross-thread surface Python's profiler actually touches:
+    // plain atomics, read while the pump is hot
+    while (!done.load(std::memory_order_relaxed)) {
+      uint64_t calls, events, ns, frames;
+      tps_profile_stats(&calls, &events, &ns, &frames);
+    }
+  });
+
+  std::vector<uint8_t> batch(1 << 20);
+  std::vector<BatchMeta> metas(16);
+  int got = 0;
+  while (got < kPushes) {
+    tps_server_pump(sv);
+    int n = tps_server_pop_grad_batch(sv, batch.data(), batch.size(),
+                                      metas.data(), (int)metas.size());
+    for (int i = 0; i < n; ++i) {
+      assert(metas[i].status == FRAME_OK && "frame rejected");
+      assert(metas[i].len == kPayload);
+      assert(metas[i].step == 3 && metas[i].seq == 11);
+    }
+    got += n;
+  }
+  worker.join();
+  done.store(true, std::memory_order_relaxed);
+  stats.join();
+
+  uint64_t calls, events, ns, frames;
+  tps_profile_stats(&calls, &events, &ns, &frames);
+  assert(frames == (uint64_t)kPushes && "validated-frame count drifted");
+  tps_server_close(sv);
+  std::printf("tcpps_drive: %d framed pushes pumped, %llu validated\n",
+              got, (unsigned long long)frames);
+  return 0;
+}

@@ -1,0 +1,115 @@
+// Sanitizer driver for wirecodec + psqueue (tools/native_sanitize.py):
+// the filter/fold kernels over adversarial sizes and the full shm
+// segment lifecycle (create/open/publish/seqlock-read/push/pop/reset/
+// close), compiled as one executable per sanitizer mode (ASan leak
+// check, UBSan, or TSan on the seqlock paths). See tcpps_drive.cpp for
+// why the precise leak check lives in native drivers rather than the
+// LD_PRELOADed pytest leg.
+
+#include "../wirecodec.cpp"
+#include "../psqueue.cpp"
+
+#include <cassert>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+int main() {
+  // ---- wirecodec: shuffle/rle0 roundtrip + every fold kernel --------
+  for (size_t n : {0ul, 1ul, 3ul, 63ul, 64ul, 1000ul, 4096ul}) {
+    std::vector<uint8_t> raw(n * 4);
+    for (size_t i = 0; i < raw.size(); ++i)
+      raw[i] = (uint8_t)((i % 7 == 0) ? 0 : i * 13);  // zero runs + noise
+    std::vector<uint8_t> shuf(raw.size()), unshuf(raw.size());
+    if (n) {
+      wc_shuffle(raw.data(), shuf.data(), n, 4);
+      wc_unshuffle(shuf.data(), unshuf.data(), n, 4);
+      assert(unshuf == raw && "shuffle roundtrip");
+    }
+    size_t cap = wc_rle0_max_out(raw.size());
+    std::vector<uint8_t> enc(cap), dec(raw.size());
+    size_t esz = wc_rle0_encode(raw.data(), raw.size(), enc.data(), cap);
+    size_t dsz = wc_rle0_decode(enc.data(), esz, dec.data(), raw.size());
+    assert(dsz == raw.size() && dec == raw && "rle0 roundtrip");
+  }
+  {
+    constexpr size_t n = 1027;  // off the 4-lane alignment on purpose
+    std::vector<float> acc(n, 0.0f);
+    std::vector<int8_t> q(n);
+    for (size_t i = 0; i < n; ++i) q[i] = (int8_t)(i % 251 - 125);
+    wc_fold_scaled_i8(acc.data(), q.data(), 0.5f, n);
+    std::vector<uint8_t> packed((n + 3) / 4, 0b10010011);
+    wc_fold_tern(acc.data(), packed.data(), 0.25f, n);
+    std::vector<int32_t> votes(n, 0);
+    std::vector<uint8_t> bits((n + 7) / 8, 0xA5);
+    wc_fold_sign(votes.data(), bits.data(), n);
+    std::vector<float> val = {1.f, 2.f, 3.f};
+    std::vector<int32_t> idx = {0, (int32_t)n - 1, (int32_t)n + 5};
+    wc_fold_sparse(acc.data(), val.data(), idx.data(), val.size(), n);
+    wc_zero_sparse(acc.data(), idx.data(), idx.size(), n);
+    std::vector<int8_t> q8(8, 42);
+    std::vector<float> scales = {0.1f, 0.2f};
+    std::vector<int32_t> sidx = {1, 2, 3, 4, 5, 6, 7, 8};
+    wc_fold_sparse_q8(acc.data(), q8.data(), scales.data(), sidx.data(),
+                      2, 4, n);
+    std::vector<float> x(n, 1.5f);
+    wc_fold_dense_f32(acc.data(), x.data(), n);
+    std::vector<uint16_t> bf(n, 0x3FC0);  // 1.5 in bf16
+    wc_fold_dense_bf16(acc.data(), bf.data(), n);
+    uint64_t calls, elems, ns;
+    wc_profile_stats(&calls, &elems, &ns);
+    assert(calls >= 7 && "fold profile counters should have advanced");
+    wc_profile_reset();
+  }
+
+  // ---- psqueue: segment lifecycle under a concurrent worker ---------
+  const char* seg = "/psanalyze-wcpsq-drive";
+  constexpr uint64_t kParamCap = 1 << 16;
+  constexpr uint64_t kGradCap = 1 << 14;
+  constexpr int kPushes = 200;
+  void* sv = psq_create(seg, 2, kParamCap, kGradCap);
+  assert(sv && "psq_create failed");
+  assert(psq_n_workers(sv) == 2);
+  std::vector<uint8_t> params(kParamCap, 0x5A);
+  assert(psq_publish_params(sv, params.data(), params.size(), 1) == 0);
+
+  std::thread worker([&] {
+    void* wv = psq_open(seg);
+    assert(wv && "psq_open failed");
+    std::vector<uint8_t> buf(kParamCap);
+    uint64_t ver = 0;
+    int64_t n = psq_read_params(wv, buf.data(), buf.size(), &ver);
+    assert(n == (int64_t)kParamCap && ver >= 1);
+    (void)psq_params_version(wv);
+    std::vector<uint8_t> grad(kGradCap, 0x33);
+    for (int i = 0; i < kPushes;) {
+      if (psq_push_grad(wv, 0, grad.data(), grad.size(), ver) == 1)
+        ++i;  // 0 = mailbox still full, retry
+    }
+    psq_close(wv);
+  });
+
+  std::vector<uint8_t> gbuf(kGradCap);
+  uint32_t wid = 0, cursor = 0;
+  uint64_t gver = 0;
+  int got = 0;
+  while (got < kPushes) {
+    // keep republishing while draining: the seqlock writer vs the
+    // worker's reader is the cross-thread pair TSan watches
+    assert(psq_publish_params(sv, params.data(), params.size(),
+                              2 + got) == 0);
+    int64_t n = psq_pop_grad(sv, gbuf.data(), gbuf.size(), &wid, &gver,
+                             &cursor);
+    if (n > 0) {
+      assert(n == (int64_t)kGradCap && wid == 0);
+      ++got;
+    }
+    (void)psq_grad_pending(sv, 0);
+  }
+  worker.join();
+  assert(psq_reset_slot(sv, 1) == 0);
+  psq_close(sv);
+  std::printf("wcpsq_drive: folds + rle0 ok, %d shm pushes drained\n",
+              got);
+  return 0;
+}

@@ -134,8 +134,52 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib._has_batch = True
     except AttributeError:
         lib._has_batch = False
+    _verify_abi(lib)
     _lib = lib
     return _lib
+
+
+def _verify_abi(lib: ctypes.CDLL) -> None:
+    """Load-time twin of psanalyze's abi-drift rule: re-read the PSF2
+    wire constants from the loaded library and refuse it on any
+    mismatch with ``resilience/frames.py`` — drift becomes a loud load
+    failure instead of a silent mis-decode. A library predating the
+    ``tps_abi_*`` exports (hand-copied; the mtime check rebuilds any
+    stale cache) skips the check rather than failing every import."""
+    if not hasattr(lib, "tps_abi_psf_header_bytes"):
+        return
+    from pytorch_ps_mpi_tpu.resilience import frames as _frames
+
+    lib.tps_abi_psf_magic.restype = ctypes.c_uint32
+    lib.tps_abi_psf_magic_v1.restype = ctypes.c_uint32
+    lib.tps_abi_psf_header_bytes.restype = ctypes.c_uint32
+    lib.tps_abi_batch_meta_bytes.restype = ctypes.c_uint32
+    lib.tps_abi_frame_status_name.restype = ctypes.c_char_p
+    lib.tps_abi_frame_status_name.argtypes = [ctypes.c_uint32]
+    checks = (
+        ("PSF2 header bytes", int(lib.tps_abi_psf_header_bytes()),
+         _frames.HEADER_BYTES),
+        ("PSF2 magic", int(lib.tps_abi_psf_magic()),
+         _frames.FRAME_MAGIC),
+        ("PSF1 magic", int(lib.tps_abi_psf_magic_v1()),
+         _frames.FRAME_MAGIC_V1),
+        ("BatchMeta bytes", int(lib.tps_abi_batch_meta_bytes()),
+         ctypes.sizeof(_BatchMeta)),
+    )
+    for what, native_v, py_v in checks:
+        if native_v != py_v:
+            raise RuntimeError(
+                f"native/tcpps.cpp ABI drift: {what} is {native_v} in "
+                f"the loaded library but {py_v} on the Python side — "
+                "rebuild native/_build or reconcile the constants")
+    for code, want in _frames.BATCH_REASONS.items():
+        got = lib.tps_abi_frame_status_name(code)
+        got = got.decode() if got is not None else None
+        if got != want:
+            raise RuntimeError(
+                "native/tcpps.cpp ABI drift: frame-status code "
+                f"{code} is {got!r} in the loaded library but "
+                f"{want!r} in frames.BATCH_REASONS")
 
 
 def native_profile_stats() -> Optional[dict]:

@@ -76,6 +76,7 @@ from pytorch_ps_mpi_tpu.telemetry.recorder import (
 from pytorch_ps_mpi_tpu.telemetry.registry import (
     Counter,
     Gauge,
+    HEALTH_FLEET_ROLLUP_KEYS,
     Histogram,
     MetricsRegistry,
     PS_SERVER_METRIC_KEYS,
@@ -141,6 +142,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "HEALTH_FLEET_ROLLUP_KEYS",
     "PS_SERVER_METRIC_KEYS",
     "PSServerTelemetry",
     "ps_server_metrics",

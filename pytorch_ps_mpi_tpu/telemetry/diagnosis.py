@@ -457,17 +457,15 @@ class HealthMonitor:
         }
         if self.server is not None:
             from pytorch_ps_mpi_tpu.telemetry.registry import (
+                HEALTH_FLEET_ROLLUP_KEYS,
                 ps_server_metrics,
             )
 
             m = ps_server_metrics(self.server)
-            fleet.update({k: m[k] for k in (
-                "grads_received", "stale_drops",
-                "staleness_p50", "staleness_p95", "staleness_p99",
-                # homomorphic-aggregation rollup: mode flag, decodes per
-                # gradient-composed publish (1.0 = compressed-domain
-                # rounds), explicit-request fallbacks
-                "agg_mode", "decodes_per_publish", "agg_fallbacks")})
+            # the rollup subset is IMPORTED from the canonical schema's
+            # home (not hand-listed here) so the two can never drift —
+            # psanalyze's metrics-surface rule checks it statically too
+            fleet.update({k: m[k] for k in HEALTH_FLEET_ROLLUP_KEYS})
         t_wall = time.time()
         out = {
             "armed": True,

@@ -366,6 +366,24 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "reads_not_modified",
 )
 
+#: The canonical-key subset the ``/health`` fleet rollup republishes
+#: (``diagnosis.HealthMonitor.snapshot`` imports THIS — the rollup used
+#: to hand-list keys inline, the drift class psanalyze's
+#: metrics-surface rule now lints against). Must stay a subset of the
+#: canonical schema; checked here so a bad edit fails at import, and
+#: statically by ``tools/psanalyze``.
+HEALTH_FLEET_ROLLUP_KEYS: Tuple[str, ...] = (
+    "grads_received",
+    "stale_drops",
+    "staleness_p50",
+    "staleness_p95",
+    "staleness_p99",
+    "agg_mode",
+    "decodes_per_publish",
+    "agg_fallbacks",
+)
+assert set(HEALTH_FLEET_ROLLUP_KEYS) <= set(PS_SERVER_METRIC_KEYS)
+
 
 def staleness_quantile(seen: Dict[Any, int], q: float) -> float:
     """Exact weighted q-quantile of a ``{staleness_value: count}`` dict

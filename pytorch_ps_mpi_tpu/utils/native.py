@@ -29,6 +29,34 @@ _HDR = struct.Struct("<4sBBQI")
 _lib: Optional[ctypes.CDLL] = None
 _BUILD_FAILURES: set = set()
 
+#: ``PS_NATIVE_SANITIZE`` → extra g++ flags. The sanitized builds land
+#: in ``native/_build/<mode>/`` so they never clobber the normal cache;
+#: ``make native-asan``/``native-ubsan`` (tools/native_sanitize.py) run
+#: the parity suite against them with the runtime LD_PRELOADed (the
+#: Python binary itself is uninstrumented). ``-ffp-contract=off`` stays:
+#: the bit-exact native==numpy fold contract must hold under sanitizers
+#: too, or the parity suite would be testing a different kernel.
+SANITIZE_FLAGS = {
+    "asan": ("-fsanitize=address", "-fno-omit-frame-pointer", "-g", "-O1"),
+    "ubsan": ("-fsanitize=undefined", "-fno-sanitize-recover=all",
+              "-g", "-O1"),
+    "tsan": ("-fsanitize=thread", "-g", "-O1"),
+}
+
+
+def sanitize_mode() -> Optional[str]:
+    """The active ``PS_NATIVE_SANITIZE`` mode, or None. Unknown values
+    raise at the first build rather than silently producing an
+    unsanitized library that a leak-check run would then vouch for."""
+    mode = os.environ.get("PS_NATIVE_SANITIZE", "").strip().lower()
+    if not mode:
+        return None
+    if mode not in SANITIZE_FLAGS:
+        raise ValueError(
+            f"PS_NATIVE_SANITIZE={mode!r}: expected one of "
+            f"{sorted(SANITIZE_FLAGS)}")
+    return mode
+
 
 def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -38,13 +66,20 @@ def build_and_load(src_name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
     """Compile ``native/<src_name>`` with g++ (cached by mtime under
     ``native/_build``) and dlopen it. Returns None — once, latched — if the
     source is missing or the toolchain fails, so callers fall back to pure
-    Python. Shared by every native component (wirecodec, psqueue)."""
-    if src_name in _BUILD_FAILURES:
+    Python. Shared by every native component (wirecodec, psqueue).
+
+    With ``PS_NATIVE_SANITIZE=asan|ubsan|tsan`` the library is built
+    with the matching sanitizer into a mode-specific cache directory."""
+    mode = sanitize_mode()
+    if (src_name, mode) in _BUILD_FAILURES:
         return None
     src = os.path.join(_repo_root(), "native", src_name)
     stem = os.path.splitext(src_name)[0]
-    build_dir = os.path.join(_repo_root(), "native", "_build")
+    build_dir = os.path.join(_repo_root(), "native", "_build",
+                             *([mode] if mode else []))
     so_path = os.path.join(build_dir, f"lib{stem}.so")
+    if mode:
+        extra_flags = (*extra_flags, *SANITIZE_FLAGS[mode])
     try:
         if not os.path.exists(src):
             raise FileNotFoundError(src)
@@ -65,11 +100,20 @@ def build_and_load(src_name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
             # contract (tests/test_native_fold.py) pins that
             cmd = ["g++", "-O3", "-std=c++17", "-ffp-contract=off",
                    "-shared", "-fPIC", *extra_flags, "-o", tmp, src, *libs]
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            # scrubbed env: under `make native-asan` the PYTHON process
+            # runs with the ASan runtime LD_PRELOADed and leak-checking
+            # armed — inherited into g++ that flags the compiler's own
+            # exit-time allocations and fails the build
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("LD_PRELOAD", "ASAN_OPTIONS",
+                                "LSAN_OPTIONS", "UBSAN_OPTIONS",
+                                "TSAN_OPTIONS")}
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120, env=env)
             os.replace(tmp, so_path)
         return ctypes.CDLL(so_path)
     except Exception:
-        _BUILD_FAILURES.add(src_name)
+        _BUILD_FAILURES.add((src_name, mode))
         return None
 
 

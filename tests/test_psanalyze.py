@@ -1,0 +1,323 @@
+"""psanalyze unit tests: engine primitives (pragmas, JSON schema,
+runner), call-graph construction (thread roots, native sites), and each
+rule on synthetic trees — the fast in-process twin of the subprocess
+round-trips ``tools/analyze_smoke.py`` drives (clean-tree silence +
+seeded-defect firing through the real CLI)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tools.psanalyze.callgraph import build_callgraph
+from tools.psanalyze.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    render_json,
+    run_analysis,
+)
+from tools.psanalyze.rules.abi_drift import (
+    AbiDriftRule,
+    c_type_norm,
+    parse_c_enum,
+    parse_c_exports,
+    parse_c_struct,
+)
+from tools.psanalyze.rules.cfg_schema import CfgSchemaRule
+from tools.psanalyze.rules.codec_contract import CodecContractRule
+from tools.psanalyze.rules.thread_affinity import ThreadAffinityRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def repo_ctx():
+    """ONE shared context for every test that reads the real tree — a
+    full-repo parse costs ~1 s and must not be repeated per test."""
+    return AnalysisContext(REPO)
+
+
+def make_tree(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return AnalysisContext(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# engine core
+# ---------------------------------------------------------------------------
+
+def test_pragma_suppresses_on_line_and_line_above(tmp_path):
+    ctx = make_tree(tmp_path, {"pytorch_ps_mpi_tpu/m.py": (
+        "x = 1  # psanalyze: ok some-rule\n"
+        "# psanalyze: ok other-rule, some-rule\n"
+        "y = 2\n"
+        "z = 3\n")})
+    assert ctx.suppressed(Finding("some-rule", "pytorch_ps_mpi_tpu/m.py",
+                                  1, ""))
+    assert ctx.suppressed(Finding("some-rule", "pytorch_ps_mpi_tpu/m.py",
+                                  3, ""))  # line above
+    assert not ctx.suppressed(Finding("some-rule",
+                                      "pytorch_ps_mpi_tpu/m.py", 4, ""))
+    assert not ctx.suppressed(Finding("third-rule",
+                                      "pytorch_ps_mpi_tpu/m.py", 1, ""))
+
+
+def test_json_output_schema():
+    res = run_analysis(REPO, ["cfg-schema"])
+    doc = json.loads(render_json(res))
+    assert set(doc) >= {"root", "rules", "findings", "suppressed",
+                        "finding_count", "suppressed_count"}
+    assert doc["rules"] == ["cfg-schema"]
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "message"}
+
+
+def test_unknown_rule_name_raises():
+    with pytest.raises(KeyError):
+        run_analysis(REPO, ["no-such-rule"])
+
+
+def test_runner_splits_suppressed(tmp_path):
+    class StubRule(Rule):
+        name = "stub"
+
+        def run(self, ctx):
+            return [Finding("stub", "pytorch_ps_mpi_tpu/m.py", 1, "a"),
+                    Finding("stub", "pytorch_ps_mpi_tpu/m.py", 2, "b")]
+
+    ctx = make_tree(tmp_path, {"pytorch_ps_mpi_tpu/m.py": (
+        "x = 1  # psanalyze: ok stub\ny = 2\n")})
+    rule = StubRule()
+    live = [f for f in rule.run(ctx) if not ctx.suppressed(f)]
+    gone = [f for f in rule.run(ctx) if ctx.suppressed(f)]
+    assert [f.line for f in live] == [2]
+    assert [f.line for f in gone] == [1]
+
+
+# ---------------------------------------------------------------------------
+# the committed tree stays clean (the same gate `make analyze` runs) —
+# ONE pass over all five rules; per-rule clean checks would just re-run
+# the same analysis
+# ---------------------------------------------------------------------------
+
+def test_clean_tree_has_no_findings(repo_ctx):
+    from tools.psanalyze.core import all_rules
+
+    findings = [f for rule in all_rules() for f in rule.run(repo_ctx)
+                if not repo_ctx.suppressed(f)]
+    assert len(all_rules()) == 5
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# call graph primitives
+# ---------------------------------------------------------------------------
+
+_THREADED = {
+    "pytorch_ps_mpi_tpu/loop.py": (
+        "import threading\n"
+        "def helper(lib, h):\n"
+        "    lib.psq_pop_grad(h)\n"
+        "def pump():\n"
+        "    helper(None, None)\n"
+        "def start():\n"
+        "    threading.Thread(target=pump, daemon=True).start()\n"),
+    "pytorch_ps_mpi_tpu/web.py": (
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class Handler(BaseHTTPRequestHandler):\n"
+        "    def do_GET(self):\n"
+        "        pass\n"),
+}
+
+
+def test_callgraph_thread_roots_and_native_sites(tmp_path):
+    ctx = make_tree(tmp_path, _THREADED)
+    g = build_callgraph(ctx)
+    roots = {(r.qname.split("::")[-1], r.reason) for r in g.roots}
+    assert ("pump", "thread-target") in roots
+    assert ("Handler.do_GET", "http-handler") in roots
+    helper = "pytorch_ps_mpi_tpu/loop.py::helper"
+    assert g.defs[helper].native_calls == [("psq_pop_grad", 3)]
+    hit = g.reachable_native("pytorch_ps_mpi_tpu/loop.py::pump")
+    assert hit is not None
+    chain, (symbol, _line) = hit
+    assert chain[-1] == helper and symbol == "psq_pop_grad"
+
+
+def test_thread_affinity_rule_fires_and_exempts_serve(tmp_path):
+    files = dict(_THREADED)
+    files["pytorch_ps_mpi_tpu/srv.py"] = (
+        "import threading\n"
+        "def serve(lib, h):\n"
+        "    lib.tps_server_pump(h)\n"
+        "def boot():\n"
+        "    threading.Thread(target=serve).start()\n")
+    ctx = make_tree(tmp_path, files)
+    findings = ThreadAffinityRule().run(ctx)
+    assert len(findings) == 1  # pump->helper fires; serve is exempt
+    f = findings[0]
+    assert f.path == "pytorch_ps_mpi_tpu/loop.py" and f.line == 3
+    assert "psq_pop_grad" in f.message and "pump" in f.message
+
+
+# ---------------------------------------------------------------------------
+# cfg schema
+# ---------------------------------------------------------------------------
+
+def test_cfg_schema_flags_unknown_key(tmp_path):
+    ctx = make_tree(tmp_path, {"pytorch_ps_mpi_tpu/job.py": (
+        "def f(cfg):\n"
+        "    a = cfg.get('frame_check')\n"
+        "    b = cfg['buckt_mb']\n"
+        "    return a, b\n")})
+    findings = CfgSchemaRule().run(ctx)
+    typos = [f for f in findings if "buckt_mb" in f.message]
+    assert len(typos) == 1 and typos[0].line == 3
+    assert not any("frame_check" in f.message and "not declared"
+                   in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# codec contract
+# ---------------------------------------------------------------------------
+
+def test_codec_contract_missing_aggregate(tmp_path):
+    ctx = make_tree(tmp_path, {"pytorch_ps_mpi_tpu/codecs/bad.py": (
+        "from pytorch_ps_mpi_tpu.codecs.base import Codec\n"
+        "class Hollow(Codec):\n"
+        "    supports_aggregate = True\n")})
+    msgs = [f.message for f in CodecContractRule().run(ctx)]
+    assert any("aggregate" in m and "Hollow" in m for m in msgs)
+    assert any("agg_decode" in m for m in msgs)
+
+
+def test_codec_contract_partial_streaming_trio(tmp_path):
+    ctx = make_tree(tmp_path, {"pytorch_ps_mpi_tpu/codecs/bad.py": (
+        "from pytorch_ps_mpi_tpu.codecs.base import Codec\n"
+        "class Half(Codec):\n"
+        "    supports_aggregate = True\n"
+        "    def aggregate(self, p, s, d):\n"
+        "        return p, {}\n"
+        "    def agg_decode(self, p, m, s, d):\n"
+        "        return p\n"
+        "    def agg_fold(self, acc, payload):\n"
+        "        pass\n")})
+    msgs = [f.message for f in CodecContractRule().run(ctx)]
+    assert any("agg_fold" in m and "agg_init" in m for m in msgs)
+
+
+def test_codec_contract_nonfinite_guard(tmp_path):
+    ctx = make_tree(tmp_path, {"pytorch_ps_mpi_tpu/codecs/sign.py": (
+        "from pytorch_ps_mpi_tpu.codecs.base import Codec\n"
+        "class SignCodec(Codec):\n"
+        "    def __init__(self, use_pallas=True):\n"
+        "        self.use_pallas = use_pallas\n")})
+    msgs = [f.message for f in CodecContractRule().run(ctx)]
+    assert any("nonfinite" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# metrics surface
+# ---------------------------------------------------------------------------
+
+def test_metrics_surface_flags_dropped_canonical_key(tmp_path, repo_ctx):
+    from tools.psanalyze.rules.metrics_surface import MetricsSurfaceRule
+
+    reg = repo_ctx.source("pytorch_ps_mpi_tpu/telemetry/registry.py")
+    assert '    "reads_shed",\n' in reg
+    ctx = make_tree(tmp_path, {
+        "pytorch_ps_mpi_tpu/telemetry/registry.py":
+            reg.replace('    "reads_shed",\n', "", 1),
+        "docs/OPERATIONS.md": repo_ctx.source("docs/OPERATIONS.md"),
+    })
+    msgs = [f.message for f in MetricsSurfaceRule().run(ctx)]
+    # the dropped key now surfaces from BOTH directions: the builder
+    # still emits it (non-canonical) and the instrument map still
+    # declares it (no longer canonical)
+    assert any('"reads_shed"' in m and "not in PS_SERVER_METRIC_KEYS"
+               in m for m in msgs)
+    assert any('"reads_shed"' in m and "no longer a canonical key"
+               in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# ABI drift
+# ---------------------------------------------------------------------------
+
+def test_c_parsing_primitives():
+    src = (
+        "extern \"C\" {\n"
+        "void* psq_create(const char* name, uint32_t n, uint64_t a,\n"
+        "                 uint64_t b) { return 0; }\n"
+        "int64_t psq_pop(void* h, uint8_t* buf, size_t cap) { return 0; }\n"
+        "}\n"
+        "enum FrameStatus : uint32_t { FRAME_OK = 0, FRAME_SHORT = 1, };\n"
+        "#pragma pack(push, 1)\n"
+        "struct Meta {\n"
+        "  uint32_t worker;\n"
+        "  double send_wall;\n"
+        "};\n"
+        "#pragma pack(pop)\n")
+    ex = parse_c_exports(src)
+    assert ex["psq_create"][0] == "ptr"
+    assert ex["psq_create"][1] == ["cstr", "u32", "u64", "u64"]
+    assert ex["psq_pop"][:2] == ("i64", ["ptr", "u8p", "usize"])
+    assert parse_c_enum(src, "FrameStatus") == {0: "FRAME_OK",
+                                                1: "FRAME_SHORT"}
+    assert parse_c_struct(src, "Meta") == [("worker", "u32"),
+                                           ("send_wall", "f64")]
+    assert c_type_norm("const uint8_t*") == "u8p"
+    assert c_type_norm("void") == "void"
+
+
+def test_abi_drift_detects_signature_mismatch(tmp_path):
+    ctx = make_tree(tmp_path, {
+        "native/psqueue.cpp": (
+            "extern \"C\" {\n"
+            "int psq_push(void* h, uint32_t w, uint64_t len) { return 0; }\n"
+            "}\n"),
+        "pytorch_ps_mpi_tpu/parallel/dcn.py": (
+            "import ctypes\n"
+            "def get_lib(lib):\n"
+            "    lib.psq_push.argtypes = [ctypes.c_void_p,\n"
+            "                             ctypes.c_uint32]\n"
+            "    return lib\n"),
+    })
+    msgs = [f.message for f in AbiDriftRule().run(ctx)]
+    assert any("psq_push" in m and "2 argument" in m for m in msgs)
+
+
+def test_abi_drift_detects_header_shrink(tmp_path, repo_ctx):
+    cpp = repo_ctx.source("native/tcpps.cpp").replace(
+        "constexpr size_t kPsfHeader = 36;",
+        "constexpr size_t kPsfHeader = 32;")
+    ctx = make_tree(tmp_path, {
+        "native/tcpps.cpp": cpp,
+        "pytorch_ps_mpi_tpu/resilience/frames.py":
+            repo_ctx.source("pytorch_ps_mpi_tpu/resilience/frames.py"),
+    })
+    msgs = [f.message for f in AbiDriftRule().run(ctx)]
+    assert any("36 bytes" in m and "32" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# runtime twin: the tps_abi_* exports agree with frames.py on a live lib
+# ---------------------------------------------------------------------------
+
+def test_native_abi_twin_matches_frames():
+    from pytorch_ps_mpi_tpu.parallel import tcp
+    from pytorch_ps_mpi_tpu.resilience import frames
+
+    lib = tcp.get_lib()
+    if lib is None:
+        pytest.skip("no toolchain for the native transport")
+    assert int(lib.tps_abi_psf_header_bytes()) == frames.HEADER_BYTES
+    assert int(lib.tps_abi_psf_magic()) == frames.FRAME_MAGIC
+    for code, name in frames.BATCH_REASONS.items():
+        assert lib.tps_abi_frame_status_name(code).decode() == name

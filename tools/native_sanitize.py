@@ -1,0 +1,132 @@
+"""Sanitizer-hardened native builds — psanalyze's sixth leg
+(``make native-asan`` / ``native-ubsan`` / ``native-tsan``).
+
+Two stages per mode:
+
+1. **Native drivers** (``native/tests/*_drive.cpp``): each library's
+   full handle lifecycle compiled AS AN EXECUTABLE with the sanitizer —
+   the precise leg. ASan leak-checks with no suppressions (there is no
+   interpreter to suppress around), UBSan runs with
+   ``-fno-sanitize-recover``, TSan instruments the whole program (which
+   is why this is a driver and not an LD_PRELOAD under CPython — an
+   uninstrumented interpreter reports false races).
+
+2. **Pytest leg** (asan/ubsan only): the ``tests/test_native_fold.py``
+   parity suite — every fold kernel bit-exact vs numpy over real
+   CodecWire rounds PLUS the live batched-ingest section — against
+   libraries built with ``PS_NATIVE_SANITIZE=<mode>`` (their own cache
+   dir under ``native/_build/<mode>/``), the sanitizer runtime
+   LD_PRELOADed, and LSan armed with ``tools/lsan.supp`` (interpreter
+   allocations bottom out in libpython frames, which LSan's any-frame
+   matching cannot separate from ctypes call paths — hence stage 1).
+   ``PS_NO_NATIVE`` is force-unset: a sanitized run that silently fell
+   back to numpy would vouch for nothing.
+
+TSan has no pytest leg by design; its driver covers the only native
+state two threads legitimately share (the tcpps socket + profile
+atomics, the psqueue seqlock).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+MODES = {
+    "asan": "address",
+    "ubsan": "undefined",
+    "tsan": "thread",
+}
+DRIVERS = ("wcpsq_drive.cpp", "tcpps_drive.cpp")
+PYTEST_LEG = "tests/test_native_fold.py"
+STEP_TIMEOUT_S = 420  # per-step budget: a wedged sanitizer run must fail
+
+
+def _gxx_lib(name: str) -> str:
+    out = subprocess.run(
+        ["g++", f"-print-file-name={name}"],
+        capture_output=True, text=True, check=True)
+    path = out.stdout.strip()
+    if not os.path.isabs(path):
+        raise RuntimeError(f"{name} not found by g++ — the sanitizer "
+                           "runtime is missing")
+    return path
+
+
+def _preload(mode: str) -> str:
+    # libstdc++ must sit in the INITIAL link map beside the sanitizer
+    # runtime: CPython doesn't link it, so without the preload the
+    # runtime's __cxa_throw interceptor resolves to null and the first
+    # C++ exception out of any dlopen'd extension (jaxlib's MLIR
+    # bindings throw to signal StopIteration) aborts the interpreter
+    # with "AddressSanitizer CHECK failed ... real___cxa_throw".
+    return f"{_gxx_lib('lib' + mode + '.so')} {_gxx_lib('libstdc++.so.6')}"
+
+
+def run_drivers(mode: str) -> None:
+    flag = MODES[mode]
+    with tempfile.TemporaryDirectory(prefix=f"ps_{mode}_") as td:
+        for src in DRIVERS:
+            exe = os.path.join(td, src[:-4])
+            cmd = ["g++", "-O1", "-g", "-std=c++17",
+                   f"-fsanitize={flag}", "-ffp-contract=off"]
+            if mode == "ubsan":
+                cmd.append("-fno-sanitize-recover=all")
+            cmd += ["-o", exe, os.path.join(REPO, "native", "tests", src),
+                    "-lrt", "-lpthread"]
+            subprocess.run(cmd, check=True, timeout=STEP_TIMEOUT_S)
+            env = dict(os.environ)
+            env.pop("PS_NATIVE_SANITIZE", None)
+            if mode == "asan":
+                env["ASAN_OPTIONS"] = "detect_leaks=1"
+            print(f"[native-{mode}] driver {src[:-4]}", flush=True)
+            subprocess.run([exe], check=True, env=env,
+                           timeout=STEP_TIMEOUT_S)
+
+
+def run_pytest_leg(mode: str) -> None:
+    env = dict(os.environ)
+    env["PS_NATIVE_SANITIZE"] = mode
+    env.pop("PS_NO_NATIVE", None)  # the fallback proves nothing here
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LD_PRELOAD"] = _preload(mode)
+    supp = os.path.join(REPO, "tools", "lsan.supp")
+    if mode == "asan":
+        # exitcode: a leak that escapes the suppressions must fail the
+        # gate even though the report prints after pytest's own exit
+        env["ASAN_OPTIONS"] = "detect_leaks=1:exitcode=97"
+        env["LSAN_OPTIONS"] = (f"suppressions={supp}:print_suppressions=0")
+    else:
+        env["UBSAN_OPTIONS"] = "print_stacktrace=1:halt_on_error=1"
+    print(f"[native-{mode}] pytest {PYTEST_LEG} (sanitized libs, "
+          "runtime preloaded)", flush=True)
+    subprocess.run(
+        [sys.executable, "-m", "pytest", PYTEST_LEG, "-q",
+         "-p", "no:cacheprovider"],
+        check=True, env=env, cwd=REPO, timeout=STEP_TIMEOUT_S)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--mode", choices=sorted(MODES),
+                    default=os.environ.get("PS_NATIVE_SANITIZE", "asan"))
+    ap.add_argument("--drivers-only", action="store_true",
+                    help="skip the pytest leg (CI smoke budget)")
+    args = ap.parse_args(argv)
+    t0 = time.monotonic()
+    run_drivers(args.mode)
+    if args.mode != "tsan" and not args.drivers_only:
+        run_pytest_leg(args.mode)
+    print(f"[native-{args.mode}] clean in "
+          f"{time.monotonic() - t0:.1f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,43 @@
+"""psanalyze — repo-native static analysis for the PS stack.
+
+The invariants this codebase's correctness rests on — "no thread but the
+serve loop touches a native handle", "the PSF2 header is 36 bytes on
+both sides of the wire", "the canonical metric keys appear on every
+surface" — lived in comments and reviewer memory until PR 12. This
+package makes them machine-checked: an AST- and source-level analysis
+engine with a pluggable :class:`~tools.psanalyze.core.Rule` framework,
+per-line allowlist pragmas (``# psanalyze: ok <rule>``), JSON and human
+output, and a nonzero exit on findings so ``make analyze`` gates the
+default test path.
+
+Rules shipped (see ``tools/psanalyze/rules/``):
+
+- ``thread-affinity`` — call-graph proof that no non-serve-thread root
+  (selectors read loop, metrics-HTTP handlers, profiler thread, data
+  pump) reaches a native-handle call site (``wc_*``/``tps_*``/``psq_*``);
+- ``cfg-schema`` — the declared job-cfg key registry vs every
+  ``cfg[...]``/``cfg.get`` site (typos, dead keys, unsettable keys);
+- ``metrics-surface`` — ``PS_SERVER_METRIC_KEYS`` vs the canonical dict
+  builder, scrape instruments, ``/health`` rollups, and the
+  ``docs/OPERATIONS.md`` tables;
+- ``codec-contract`` — flag/method coherence for every ``Codec``
+  subclass (aggregate trio, bucketable statelessness, ``nonfinite=``);
+- ``abi-drift`` — ``native/*.cpp`` exported signatures, struct layouts,
+  magics, and reason enums vs the ctypes bindings and
+  ``resilience/frames.py`` constants.
+
+The sixth leg — sanitizer-hardened native builds — is build wiring, not
+a static rule: ``make native-asan`` / ``native-ubsan`` / ``native-tsan``
+(``tools/native_sanitize.py``).
+
+Run: ``python -m tools.psanalyze [--json] [--root DIR] [--rules a,b]``.
+"""
+
+from tools.psanalyze.core import (  # noqa: F401
+    AnalysisContext,
+    Finding,
+    Rule,
+    render_human,
+    render_json,
+    run_analysis,
+)

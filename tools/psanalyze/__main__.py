@@ -1,0 +1,56 @@
+"""CLI: ``python -m tools.psanalyze [--root DIR] [--json] [--rules ...]``.
+
+Exit code 0 when the tree is clean, 1 when any rule fired (pragma-
+suppressed findings do not fail the run but are counted in the output),
+2 on usage errors. ``make analyze`` runs this in the default test path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from tools.psanalyze.core import (
+        all_rules,
+        render_human,
+        render_json,
+        run_analysis,
+    )
+
+    ap = argparse.ArgumentParser(
+        prog="psanalyze",
+        description="repo-native static analysis for the PS stack")
+    ap.add_argument("--root", default=None,
+                    help="tree to analyze (default: this repo)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="list available rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for rule in all_rules():
+            print(f"{rule.name:18s} {rule.description}")
+        return 0
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    names = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    try:
+        res = run_analysis(root, names)
+    except KeyError as e:
+        print(f"psanalyze: {e.args[0]}", file=sys.stderr)
+        return 2
+    print(render_json(res) if args.json else render_human(res))
+    return res.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

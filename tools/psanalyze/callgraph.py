@@ -1,0 +1,305 @@
+"""Static call graph over ``pytorch_ps_mpi_tpu/`` + thread-root and
+native-site discovery — the substrate of the ``thread-affinity`` rule.
+
+Resolution is deliberately conservative (names resolve within the
+defining module/class first, then by project-unique simple name): a
+missed edge costs a missed finding, a spurious edge costs a false
+positive in the default test path, and the second is the expensive one.
+The rule's job is the invariant PRs 3–10 re-asserted by hand — "no
+thread but the serve loop touches a native transport handle" — so the
+graph only needs to be faithful around thread entry points and ctypes
+call sites, both of which are syntactically distinctive:
+
+- **native sites**: any ``X.wc_*`` / ``X.tps_*`` / ``X.psq_*`` call —
+  the ctypes-bound symbol prefixes of the three native libraries;
+- **thread roots**: resolved ``threading.Thread(target=...)`` targets,
+  ``do_*`` methods of ``BaseHTTPRequestHandler`` subclasses, and every
+  callable handed to ``MetricsHTTPServer`` (render + routes — those run
+  on the HTTP server's per-request threads).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.psanalyze.core import AnalysisContext
+
+NATIVE_PREFIXES = ("wc_", "tps_", "psq_")
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method definition and what its body references."""
+
+    qname: str          # "<relpath>::Class.method" / "<relpath>::func"
+    path: str
+    line: int
+    cls: Optional[str]  # enclosing class name, if a method
+    simple: str         # unqualified def name
+    calls: List[Tuple[str, Optional[str], int]] = field(
+        default_factory=list)  # (kind, name, line): kind in
+    # {"name", "self", "attr"}; name is the called simple name
+    native_calls: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class ThreadRoot:
+    qname: str
+    reason: str  # "thread-target" / "http-handler" / "http-route"
+    path: str
+    line: int
+
+
+class CallGraph:
+    """defs, edges, native sites and thread roots for one tree."""
+
+    def __init__(self) -> None:
+        self.defs: Dict[str, FunctionInfo] = {}
+        self.by_simple: Dict[str, List[str]] = {}
+        self.edges: Dict[str, Set[str]] = {}
+        self.roots: List[ThreadRoot] = []
+
+    # -- queries ----------------------------------------------------------
+    def reachable_native(
+        self, start: str
+    ) -> Optional[Tuple[List[str], Tuple[str, int]]]:
+        """BFS from ``start``: the first path reaching a native call
+        site, as ``(qname chain, (native symbol, line))`` — or None."""
+        seen = {start}
+        queue: List[Tuple[str, List[str]]] = [(start, [start])]
+        while queue:
+            cur, chain = queue.pop(0)
+            info = self.defs.get(cur)
+            if info is None:
+                continue
+            if info.native_calls:
+                return chain, info.native_calls[0]
+            for nxt in sorted(self.edges.get(cur, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    queue.append((nxt, chain + [nxt]))
+        return None
+
+
+def _module_name(rel: str) -> str:
+    return rel[:-3].replace("/", ".")
+
+
+class _ModuleVisitor(ast.NodeVisitor):
+    """Collect defs + per-function call references for one module."""
+
+    def __init__(self, graph: CallGraph, rel: str):
+        self.graph = graph
+        self.rel = rel
+        self._class_stack: List[str] = []
+        self._func_stack: List[FunctionInfo] = []
+        # name -> dotted module/import target (for Thread resolution)
+        self.imports: Dict[str, str] = {}
+        self.http_handler_classes: List[str] = []
+        # (expr node, line) callables handed to MetricsHTTPServer
+        self.http_route_callables: List[Tuple[ast.AST, int]] = []
+        self.thread_targets: List[Tuple[ast.AST, int]] = []
+
+    # -- defs -------------------------------------------------------------
+    def _qualify(self, name: str) -> str:
+        cls = ".".join(self._class_stack) if self._class_stack else None
+        if self._func_stack:  # nested def: scope to the outer function
+            return f"{self._func_stack[-1].qname}.<locals>.{name}"
+        if cls:
+            return f"{self.rel}::{cls}.{name}"
+        return f"{self.rel}::{name}"
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        base_names = {b.id if isinstance(b, ast.Name) else b.attr
+                      for b in node.bases
+                      if isinstance(b, (ast.Name, ast.Attribute))}
+        if "BaseHTTPRequestHandler" in base_names:
+            self.http_handler_classes.append(node.name)
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        info = FunctionInfo(
+            qname=self._qualify(node.name), path=self.rel,
+            line=node.lineno,
+            cls=".".join(self._class_stack) or None,
+            simple=node.name)
+        self.graph.defs[info.qname] = info
+        self.graph.by_simple.setdefault(node.name, []).append(info.qname)
+        self._func_stack.append(info)
+        # method bodies inside a class should not inherit the class
+        # qualifier for their OWN nested defs' class attribution
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # lambda bodies contribute their calls to the enclosing function
+        self.generic_visit(node)
+
+    # -- imports ----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            self.imports[alias.asname or alias.name.split(".")[0]] = \
+                alias.name
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for alias in node.names:
+            self.imports[alias.asname or alias.name] = \
+                f"{node.module}.{alias.name}" if node.module else alias.name
+
+    # -- calls ------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        cur = self._func_stack[-1] if self._func_stack else None
+        if isinstance(func, ast.Attribute):
+            if func.attr.startswith(NATIVE_PREFIXES):
+                if cur is not None:
+                    cur.native_calls.append((func.attr, node.lineno))
+            elif cur is not None:
+                if (isinstance(func.value, ast.Name)
+                        and func.value.id == "self"):
+                    cur.calls.append(("self", func.attr, node.lineno))
+                else:
+                    cur.calls.append(("attr", func.attr, node.lineno))
+            callee = func.attr
+        elif isinstance(func, ast.Name):
+            if cur is not None:
+                cur.calls.append(("name", func.id, node.lineno))
+            callee = func.id
+        else:
+            callee = None
+        # thread roots: Thread(target=...), MetricsHTTPServer(...), and
+        # callbacks registered onto the scrape path (collectors run at
+        # render time on the HTTP server's request threads)
+        if callee == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    self.thread_targets.append((kw.value, node.lineno))
+        elif callee in ("MetricsHTTPServer", "add_route",
+                        "add_collector"):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                self.http_route_callables.append((arg, node.lineno))
+        self.generic_visit(node)
+
+
+def _resolve_callable_expr(
+    visitor: _ModuleVisitor, graph: CallGraph, expr: ast.AST,
+    enclosing_cls: Optional[str],
+) -> List[str]:
+    """qnames a callable expression may refer to: a Name (local def /
+    nested def), ``self.X`` (method of the enclosing class), a lambda
+    (its body's calls are attributed to the enclosing function already),
+    or a dict literal of routes (each value resolved)."""
+    rel = visitor.rel
+    out: List[str] = []
+    if isinstance(expr, ast.Dict):
+        for v in expr.values:
+            out.extend(_resolve_callable_expr(visitor, graph, v,
+                                              enclosing_cls))
+        return out
+    if isinstance(expr, ast.Lambda):
+        # a route lambda's body: resolve every call it makes
+        for sub in ast.walk(expr.body):
+            if isinstance(sub, ast.Call):
+                out.extend(_resolve_callable_expr(
+                    visitor, graph, sub.func, enclosing_cls))
+        return out
+    if isinstance(expr, ast.Name):
+        for q in graph.by_simple.get(expr.id, ()):
+            info = graph.defs[q]
+            if info.path == rel:
+                out.append(q)
+        return out
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id == "self" and enclosing_cls:
+            q = f"{rel}::{enclosing_cls}.{expr.attr}"
+            if q in graph.defs:
+                out.append(q)
+                return out
+        # fall back: project-unique method name
+        cands = graph.by_simple.get(expr.attr, [])
+        if len(cands) == 1:
+            out.append(cands[0])
+    return out
+
+
+def build_callgraph(ctx: AnalysisContext,
+                    package: str = "pytorch_ps_mpi_tpu") -> CallGraph:
+    graph = CallGraph()
+    visitors: List[_ModuleVisitor] = []
+    for rel in ctx.py_files(under=(package,)):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        v = _ModuleVisitor(graph, rel)
+        v.visit(tree)
+        visitors.append(v)
+
+    # -- edges (after all defs are known) ---------------------------------
+    for info in graph.defs.values():
+        edges = graph.edges.setdefault(info.qname, set())
+        for kind, name, _line in info.calls:
+            if name is None:
+                continue
+            targets: List[str] = []
+            if kind == "self" and info.cls is not None:
+                q = f"{info.path}::{info.cls}.{name}"
+                if q in graph.defs:
+                    targets = [q]
+            if not targets and kind in ("name",):
+                # local module function (or nested def in this function)
+                nested = f"{info.qname}.<locals>.{name}"
+                if nested in graph.defs:
+                    targets = [nested]
+                else:
+                    local = f"{info.path}::{name}"
+                    if local in graph.defs:
+                        targets = [local]
+            if not targets:
+                # project-unique simple name — the conservative
+                # cross-module fallback
+                cands = graph.by_simple.get(name, [])
+                if len(cands) == 1:
+                    targets = cands
+            edges.update(targets)
+
+    # -- thread roots -----------------------------------------------------
+    for v in visitors:
+        for cls in v.http_handler_classes:
+            for q, info in graph.defs.items():
+                if (info.path == v.rel and info.cls is not None
+                        and info.cls.split(".")[-1] == cls
+                        and info.simple.startswith("do_")):
+                    graph.roots.append(ThreadRoot(
+                        q, "http-handler", info.path, info.line))
+        for expr, line in v.thread_targets:
+            cls = _enclosing_class_of_line(graph, v.rel, line)
+            for q in _resolve_callable_expr(v, graph, expr, cls):
+                graph.roots.append(ThreadRoot(
+                    q, "thread-target", v.rel, line))
+        for expr, line in v.http_route_callables:
+            cls = _enclosing_class_of_line(graph, v.rel, line)
+            for q in _resolve_callable_expr(v, graph, expr, cls):
+                graph.roots.append(ThreadRoot(
+                    q, "http-route", v.rel, line))
+    return graph
+
+
+def _enclosing_class_of_line(graph: CallGraph, rel: str,
+                             line: int) -> Optional[str]:
+    """The class of the method whose def most closely precedes ``line``
+    in ``rel`` — good enough to resolve ``self.X`` route references."""
+    best: Optional[FunctionInfo] = None
+    for info in graph.defs.values():
+        if info.path != rel or info.cls is None or info.line > line:
+            continue
+        if best is None or info.line > best.line:
+            best = info
+    return best.cls if best is not None else None

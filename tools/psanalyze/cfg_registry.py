@@ -1,0 +1,186 @@
+"""Declared schema of the job ``cfg`` dict — the ground truth the
+``cfg-schema`` rule checks every ``cfg[...]`` / ``cfg.get(...)`` site
+against.
+
+One entry per key: value type, how the key becomes set, and what it
+does. ``settable`` is the contract the rule enforces:
+
+- ``"cli"`` — reachable from its canonical operator CLI (``cli=``,
+  default ``examples/train_async.py``; the sharded keys name
+  ``examples/train_sharded.py``): the rule fails if THAT file stops
+  setting it — a write surviving in some other example does not count;
+- ``"caller"`` — a knob for embedding code (benchmarks, smokes, tests,
+  other examples) that the async CLI deliberately does not expose;
+- ``"internal"`` — set programmatically at runtime (supervisor, fault
+  injector), never by an operator.
+
+A key read anywhere in ``pytorch_ps_mpi_tpu/`` or ``examples/`` that is
+missing here is a lint failure (the typo case); a key declared here that
+nothing reads any more is a lint failure too (the dead-knob case) — the
+registry can never drift quietly in either direction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class CfgKey:
+    type: str
+    settable: str  # "cli" | "caller" | "internal"
+    desc: str
+    #: the canonical operator CLI for settable="cli" keys
+    cli: str = "examples/train_async.py"
+
+
+CFG_KEYS = {
+    # -- problem / training ------------------------------------------------
+    "model": CfgKey("str", "cli", "model registry name (mlp/resnet18/...)"),
+    "model_kw": CfgKey("dict", "cli", "model constructor kwargs"),
+    "in_shape": CfgKey("list[int]", "cli", "input sample shape"),
+    "batch": CfgKey("int", "cli", "per-worker batch size"),
+    "seed": CfgKey("int", "cli", "data/init PRNG seed"),
+    "optim": CfgKey("str", "cli", "optimizer name (sgd/adam)"),
+    "hyper": CfgKey("dict", "cli", "optimizer hyperparameters (lr, ...)"),
+    "steps": CfgKey("int", "cli", "gradient pushes per worker"),
+    "worker_steps": CfgKey("dict[str,int]", "caller",
+                           "per-worker step-count override (keyed by "
+                           "worker id string; staleness_bench's ragged "
+                           "fleets)"),
+    "seq_len": CfgKey("int", "caller",
+                      "sequence length for the longcontext/GPT problems"),
+    # -- wire / codec ------------------------------------------------------
+    "codec": CfgKey("str", "cli", "codec registry name for the PS wire"),
+    "codec_kw": CfgKey("dict", "caller", "codec constructor kwargs"),
+    "bucket_mb": CfgKey("float", "cli",
+                        "flat-bucket wire: ~MB per dtype-grouped bucket "
+                        "(0 = per-leaf)"),
+    "agg": CfgKey("str", "caller",
+                  "homomorphic aggregation: 'auto' (default), 'on' "
+                  "(fallbacks counted), 'off' (legacy decode-sum)"),
+    "frame_check": CfgKey("bool", "cli",
+                          "self-verifying PSF2 frames on every push"),
+    "transport": CfgKey("str", "cli", "PS wire: 'shm' or 'tcp'"),
+    "max_staleness": CfgKey("int", "cli",
+                            "server drops gradients staler than this"),
+    # -- timeouts / pacing -------------------------------------------------
+    "open_timeout": CfgKey("float", "cli",
+                           "worker transport-attach timeout (s)"),
+    "push_timeout": CfgKey("float", "cli",
+                           "worker push-acknowledge timeout (s); the "
+                           "supervisor clamps it for failover detection"),
+    "server_timeout": CfgKey("float", "caller",
+                             "sharded server-main overall timeout (s)"),
+    "tick_interval": CfgKey("float", "caller",
+                            "serve-loop tick cadence (s) for health/SLO/"
+                            "timeseries sampling"),
+    "slow_ms": CfgKey("dict[str,float]", "cli",
+                      "injected per-worker straggler delay (ms), keyed "
+                      "by worker id string"),
+    "server_slow_ms": CfgKey("float", "caller",
+                             "injected server-side per-round delay (ms; "
+                             "sharded chaos runs)"),
+    # -- checkpoint / resilience ------------------------------------------
+    "checkpoint_dir": CfgKey("str", "cli",
+                             "PS checkpoint directory (sharded path; the "
+                             "async CLI passes it to the Supervisor "
+                             "directly)",
+                             cli="examples/train_sharded.py"),
+    "checkpoint_every": CfgKey("int", "cli",
+                               "applied-gradient cadence between "
+                               "checkpoints",
+                               cli="examples/train_sharded.py"),
+    "resume": CfgKey("bool", "cli",
+                     "restore the latest checkpoint before serving"),
+    "resilient": CfgKey("bool", "cli",
+                        "workers retry/backoff/reconnect instead of dying"),
+    "resilience_kw": CfgKey("dict", "caller",
+                            "retry/backoff knob overrides for the "
+                            "resilient worker loop"),
+    "degraded_round_after": CfgKey("float", "caller",
+                                   "sync-barrier: proceed degraded after "
+                                   "waiting this long for a dead member"),
+    "n_workers": CfgKey("int", "caller",
+                        "worker count for sharded server_main (the async "
+                        "path passes it as an argument)"),
+    # -- fault injection ---------------------------------------------------
+    "fault_plan": CfgKey("list[dict]", "cli",
+                         "deterministic chaos plan entries "
+                         "{at_step, worker, kind}"),
+    "fault_seed": CfgKey("int", "cli",
+                         "seed for fault randomness (replayable chaos)"),
+    "fault_log_dir": CfgKey("str", "cli",
+                            "per-process injected-fault JSONL directory"),
+    "fault_fired": CfgKey("dict", "internal",
+                          "supervisor-maintained map of already-fired "
+                          "one-shot faults (survives respawns)"),
+    # -- telemetry / observability ----------------------------------------
+    "telemetry_dir": CfgKey("str", "cli",
+                            "FlightRecorder JSONL + trace/report output "
+                            "directory (implies metrics_port=0)"),
+    "telemetry_capacity": CfgKey("int", "caller",
+                                 "FlightRecorder ring capacity override "
+                                 "(events per process)"),
+    "metrics_port": CfgKey("int", "cli",
+                           "/metrics + /health HTTP port (0 = auto)"),
+    "health_port": CfgKey("int", "cli",
+                          "arm the HealthMonitor and serve /health on "
+                          "this port (0 = auto)"),
+    "health": CfgKey("bool", "caller",
+                     "arm the HealthMonitor without binding a port "
+                     "(sharded / serving-core paths)"),
+    "health_dir": CfgKey("str", "cli",
+                         "worker beacon-file directory the monitor tails"),
+    "health_kw": CfgKey("dict", "caller", "HealthMonitor knob overrides"),
+    "numerics": CfgKey("bool", "cli",
+                       "arm the NumericsMonitor (NaN quarantine, "
+                       "grad-norm stats, fidelity probes)"),
+    "numerics_dir": CfgKey("str", "cli",
+                           "probe/trajectory JSONL + postmortem directory"),
+    "numerics_kw": CfgKey("dict", "cli",
+                          "NumericsMonitor knobs (policy, probe_every, "
+                          "...)"),
+    "lineage": CfgKey("bool", "cli",
+                      "arm gradient-lineage tracking (trace IDs on the "
+                      "v2 frames)"),
+    "lineage_dir": CfgKey("str", "cli",
+                          "lineage-server.jsonl output directory"),
+    "lineage_kw": CfgKey("dict", "caller", "LineageTracker knob overrides"),
+    "timeseries": CfgKey("bool", "cli",
+                         "arm the in-process metrics TSDB (/history)"),
+    "timeseries_dir": CfgKey("str", "caller",
+                             "TSDB persistence directory (falls back to "
+                             "telemetry_dir)"),
+    "timeseries_kw": CfgKey("dict", "caller", "MetricsHistory knobs"),
+    "slo": CfgKey("bool", "cli",
+                  "arm the SLO burn-rate watchdog (implies timeseries)"),
+    "slo_kw": CfgKey("dict", "cli",
+                     "SLO targets/knob overrides ({'targets': {...}})"),
+    "profile": CfgKey("bool", "cli",
+                      "arm the continuous sampling profiler"),
+    "profile_dir": CfgKey("str", "caller",
+                          "profiler output directory (falls back to "
+                          "telemetry_dir)"),
+    "profile_kw": CfgKey("dict", "caller", "SamplingProfiler knobs"),
+    "fleet": CfgKey("bool", "caller",
+                    "arm the fleet poller without a registration dir"),
+    "fleet_dir": CfgKey("str", "cli",
+                        "fleet registration directory (/fleet pane)"),
+    "fleet_endpoints": CfgKey("list[str]", "caller",
+                              "static fleet member endpoints (no "
+                              "registration dir)"),
+    "fleet_kw": CfgKey("dict", "caller", "FleetMonitor knobs"),
+    "fleet_name": CfgKey("str", "caller",
+                         "registration name override (default: role name)"),
+    "fleet_role": CfgKey("str", "caller",
+                         "registration role tag (default 'server')"),
+    # -- parameter-serving read tier --------------------------------------
+    "serving": CfgKey("bool", "caller",
+                      "arm the snapshot ring/read tier without binding "
+                      "a port"),
+    "serving_kw": CfgKey("dict", "cli",
+                         "ServingCore knobs (ring, admission_depth, ...)"),
+    "read_port": CfgKey("int", "cli",
+                        "read-tier listener port (0 = auto)"),
+}

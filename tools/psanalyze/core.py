@@ -1,0 +1,235 @@
+"""psanalyze engine: Finding/Rule model, file+AST cache, pragmas, runner.
+
+Everything here is analysis-time only — the tool imports nothing from
+``pytorch_ps_mpi_tpu`` (it must run, and fail loudly, even when the
+package itself is broken enough not to import). Rules read source
+through :class:`AnalysisContext`, which walks a *root* directory —
+normally the repo, a seeded-defect temp copy in ``tools/analyze_smoke``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: directories (relative to root) a rule may ask the context to walk
+PY_DIRS = ("pytorch_ps_mpi_tpu", "examples", "benchmarks", "tools")
+
+#: ``# psanalyze: ok <rule>[, <rule>...]`` on the flagged line or the
+#: line directly above it suppresses the named rules' findings there
+_PRAGMA = re.compile(r"#\s*psanalyze:\s*ok\s+([a-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a file:line under the root."""
+
+    rule: str
+    path: str  # root-relative, forward slashes
+    line: int
+    message: str
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """Base rule: subclasses set ``name``/``description`` and implement
+    :meth:`run` returning findings (pragma filtering is the runner's
+    job, not the rule's)."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, ctx: "AnalysisContext") -> List[Finding]:
+        raise NotImplementedError
+
+
+class AnalysisContext:
+    """Cached source/AST access for one analysis root.
+
+    Files are read lazily and parsed at most once; a rule asking for a
+    missing file gets ``None`` (rules degrade to "surface absent"
+    findings or silence, never crashes — the smoke seeds partial trees).
+    """
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._source: Dict[str, Optional[str]] = {}
+        self._tree: Dict[str, Optional[ast.Module]] = {}
+        self._py_files: Optional[List[str]] = None
+
+    # -- files ------------------------------------------------------------
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def exists(self, rel: str) -> bool:
+        return os.path.isfile(self.abspath(rel))
+
+    def source(self, rel: str) -> Optional[str]:
+        if rel not in self._source:
+            try:
+                with open(self.abspath(rel), encoding="utf-8",
+                          errors="replace") as f:
+                    self._source[rel] = f.read()
+            except OSError:
+                self._source[rel] = None
+        return self._source[rel]
+
+    def lines(self, rel: str) -> List[str]:
+        src = self.source(rel)
+        return src.splitlines() if src is not None else []
+
+    def tree(self, rel: str) -> Optional[ast.Module]:
+        if rel not in self._tree:
+            src = self.source(rel)
+            try:
+                self._tree[rel] = ast.parse(src) if src is not None else None
+            except SyntaxError:
+                self._tree[rel] = None
+        return self._tree[rel]
+
+    def py_files(self, under: Sequence[str] = PY_DIRS) -> List[str]:
+        """Root-relative paths of every ``.py`` file under the given
+        top-level directories (sorted, ``__pycache__`` skipped)."""
+        out = []
+        for top in under:
+            base = self.abspath(top)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, fn),
+                                              self.root)
+                        out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    # -- pragmas ----------------------------------------------------------
+    def suppressed(self, f: Finding) -> bool:
+        """True when the flagged line carries a ``# psanalyze: ok
+        <rule>`` pragma naming ``f.rule``, or the line directly above
+        is a comment-only pragma line (a trailing pragma on code never
+        spills onto the next line)."""
+        lines = self.lines(f.path)
+
+        def match(text: str) -> bool:
+            m = _PRAGMA.search(text)
+            return bool(m and f.rule in
+                        {r.strip() for r in m.group(1).split(",")})
+
+        if 1 <= f.line <= len(lines) and match(lines[f.line - 1]):
+            return True
+        above = lines[f.line - 2] if 2 <= f.line <= len(lines) + 1 else ""
+        return above.strip().startswith("#") and match(above)
+
+
+@dataclass
+class AnalysisResult:
+    root: str
+    rules: List[str]
+    findings: List[Finding]
+    suppressed: List[Finding] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "root": self.root,
+            "rules": self.rules,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "finding_count": len(self.findings),
+            "suppressed_count": len(self.suppressed),
+        }
+
+
+def all_rules() -> List[Rule]:
+    from tools.psanalyze.rules import ALL_RULES
+
+    return [cls() for cls in ALL_RULES]
+
+
+def run_analysis(root: str,
+                 rule_names: Optional[Iterable[str]] = None
+                 ) -> AnalysisResult:
+    """Run the selected rules (default: all) against ``root`` and split
+    findings into live vs pragma-suppressed."""
+    ctx = AnalysisContext(root)
+    rules = all_rules()
+    if rule_names is not None:
+        wanted = set(rule_names)
+        unknown = wanted - {r.name for r in rules}
+        if unknown:
+            raise KeyError(
+                f"unknown rule(s) {sorted(unknown)}; "
+                f"have {sorted(r.name for r in rules)}")
+        rules = [r for r in rules if r.name in wanted]
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule in rules:
+        for f in rule.run(ctx):
+            (suppressed if ctx.suppressed(f) else findings).append(f)
+    findings.sort(key=lambda f: (f.rule, f.path, f.line))
+    suppressed.sort(key=lambda f: (f.rule, f.path, f.line))
+    return AnalysisResult(root=ctx.root,
+                          rules=[r.name for r in rules],
+                          findings=findings, suppressed=suppressed)
+
+
+def render_human(res: AnalysisResult) -> str:
+    lines = []
+    for f in res.findings:
+        lines.append(f.render())
+    lines.append(
+        f"psanalyze: {len(res.findings)} finding(s), "
+        f"{len(res.suppressed)} suppressed, "
+        f"rules: {', '.join(res.rules)}")
+    return "\n".join(lines)
+
+
+def render_json(res: AnalysisResult) -> str:
+    return json.dumps(res.to_dict(), indent=2, sort_keys=True)
+
+
+# -- shared AST helpers (used by several rules) -----------------------------
+
+def const_str(node: ast.AST) -> Optional[str]:
+    """The literal string a node holds, or None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """A tuple/list literal of string constants, or None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            s = const_str(el)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for nested Name/Attribute chains, or None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,15 @@
+"""Rule registry: importing this module registers every shipped rule."""
+
+from tools.psanalyze.rules.abi_drift import AbiDriftRule
+from tools.psanalyze.rules.cfg_schema import CfgSchemaRule
+from tools.psanalyze.rules.codec_contract import CodecContractRule
+from tools.psanalyze.rules.metrics_surface import MetricsSurfaceRule
+from tools.psanalyze.rules.thread_affinity import ThreadAffinityRule
+
+ALL_RULES = (
+    ThreadAffinityRule,
+    CfgSchemaRule,
+    MetricsSurfaceRule,
+    CodecContractRule,
+    AbiDriftRule,
+)

@@ -1,0 +1,446 @@
+"""Rule 5: C++/Python ABI drift.
+
+The three native libraries are reached through hand-maintained ctypes
+tables, and the PSF2 wire header plus the batched-ingest structs are
+defined twice — once in C++, once in Python. Nothing at runtime checks
+that the two sides still agree (a same-byte-count layout mismatch is
+the documented-undetectable failure class from the PR 2 postmortem), so
+this rule diffs them at analysis time:
+
+- every ``lib.X.argtypes``/``restype`` binding (and every bare
+  ``lib.X(...)`` call) in ``parallel/tcp.py``, ``parallel/dcn.py`` and
+  ``utils/native.py`` against the exported signature parsed from
+  ``native/*.cpp`` — arity, per-argument type, return width;
+- ``resilience/frames.py``'s header constants (``FRAME_MAGIC``/``_V1``,
+  the ``_HEADER`` struct format, the ``HEADER_BYTES == 36`` assert)
+  against ``tcpps.cpp``'s ``kPsfMagicV2``/``V1``/``kPsfHeader`` and the
+  ``PsfHeader`` field list;
+- the ``FrameStatus`` reason enum against ``frames.BATCH_REASONS``;
+- the ``BatchMeta`` struct (fields, packed size, the 48-byte asserts)
+  against ``tcp.py``'s ``_BatchMeta`` mirror.
+
+The runtime twin: ``tcp.py`` re-asserts header size / magic / reason
+names through the ``tps_abi_*`` exports at library load.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import struct as pystruct
+from typing import Dict, List, Optional, Tuple
+
+from tools.psanalyze.core import AnalysisContext, Finding, Rule
+
+BINDING_MODULES = {
+    "pytorch_ps_mpi_tpu/parallel/tcp.py": "native/tcpps.cpp",
+    "pytorch_ps_mpi_tpu/parallel/dcn.py": "native/psqueue.cpp",
+    "pytorch_ps_mpi_tpu/utils/native.py": "native/wirecodec.cpp",
+}
+FRAMES_PY = "pytorch_ps_mpi_tpu/resilience/frames.py"
+TCPPS_CPP = "native/tcpps.cpp"
+TCP_PY = "pytorch_ps_mpi_tpu/parallel/tcp.py"
+
+_NATIVE_RE = re.compile(r"\b(?:wc|tps|psq)_[A-Za-z0-9_]+")
+
+# -- C side -----------------------------------------------------------------
+
+_C_SCALARS = {
+    "void": "void", "int": "int", "float": "f32", "double": "f64",
+    "size_t": "usize", "int8_t": "i8", "uint8_t": "u8",
+    "int32_t": "i32", "uint16_t": "u16", "uint32_t": "u32",
+    "uint64_t": "u64", "int64_t": "i64", "char": "char",
+}
+
+
+def c_type_norm(raw: str) -> str:
+    s = raw.replace("const", " ").strip()
+    ptr = s.count("*")
+    s = s.replace("*", " ").split()
+    base = s[0] if s else ""
+    tok = _C_SCALARS.get(base, base)
+    if ptr:
+        if tok == "void":
+            return "ptr"
+        if tok == "char":
+            return "cstr"
+        return tok + "p" * ptr
+    return tok
+
+
+_C_FUNC = re.compile(
+    r"^[ \t]*((?:const[ \t]+)?[A-Za-z_][A-Za-z0-9_]*[ \t*]*?)[ \t]+"
+    r"((?:wc|tps|psq)_[A-Za-z0-9_]*)[ \t]*\(", re.M)
+
+
+def parse_c_exports(src: str) -> Dict[str, Tuple[str, List[str], int]]:
+    """name -> (return token, [arg tokens], line)."""
+    out: Dict[str, Tuple[str, List[str], int]] = {}
+    for m in _C_FUNC.finditer(src):
+        ret = c_type_norm(m.group(1))
+        name = m.group(2)
+        i = m.end()
+        depth = 1
+        while i < len(src) and depth:
+            if src[i] == "(":
+                depth += 1
+            elif src[i] == ")":
+                depth -= 1
+            i += 1
+        params = src[m.end():i - 1].strip()
+        args: List[str] = []
+        if params and params != "void":
+            for p in params.split(","):
+                p = p.strip()
+                # drop the parameter name (last identifier not part of
+                # the type) unless the param is a bare type
+                pm = re.match(r"(.*?)([A-Za-z_][A-Za-z0-9_]*)?$", p)
+                args.append(c_type_norm(pm.group(1) or p))
+        line = src[:m.start()].count("\n") + 1
+        out[name] = (ret, args, line)
+    return out
+
+
+def parse_c_const(src: str, name: str) -> Optional[int]:
+    m = re.search(
+        r"\b%s\s*=\s*(0[xX][0-9a-fA-F]+|\d+)" % re.escape(name), src)
+    return int(m.group(1), 0) if m else None
+
+
+def parse_c_struct(src: str, name: str) -> Optional[List[Tuple[str, str]]]:
+    m = re.search(r"struct\s+%s\s*\{(.*?)\};" % re.escape(name), src,
+                  re.S)
+    if m is None:
+        return None
+    fields: List[Tuple[str, str]] = []
+    for line in m.group(1).splitlines():
+        line = line.split("//")[0].strip()
+        fm = re.match(r"([A-Za-z_][A-Za-z0-9_ ]*\**)\s+"
+                      r"([A-Za-z_][A-Za-z0-9_]*)\s*;", line)
+        if fm:
+            fields.append((fm.group(2), c_type_norm(fm.group(1))))
+    return fields
+
+
+def parse_c_enum(src: str, name: str) -> Optional[Dict[int, str]]:
+    m = re.search(r"enum\s+%s[^{]*\{(.*?)\};" % re.escape(name), src, re.S)
+    if m is None:
+        return None
+    out: Dict[int, str] = {}
+    for em in re.finditer(r"([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(\d+)",
+                          m.group(1)):
+        out[int(em.group(2))] = em.group(1)
+    return out
+
+
+# -- Python side ------------------------------------------------------------
+
+_PY_CTYPES = {
+    "c_void_p": "ptr", "c_char_p": "cstr", "c_int": "int",
+    "c_float": "f32", "c_double": "f64", "c_size_t": "usize",
+    "c_int8": "i8", "c_uint8": "u8", "c_int32": "i32",
+    "c_uint16": "u16", "c_uint32": "u32", "c_uint64": "u64",
+    "c_int64": "i64", "c_bool": "bool",
+}
+
+_SIZES = {"u8": 1, "i8": 1, "u16": 2, "i16": 2, "u32": 4, "i32": 4,
+          "u64": 8, "i64": 8, "f32": 4, "f64": 8, "int": 4}
+
+_FMT_CHARS = {"B": "u8", "b": "i8", "H": "u16", "h": "i16", "I": "u32",
+              "i": "i32", "Q": "u64", "q": "i64", "f": "f32", "d": "f64"}
+
+
+def _py_type_token(node: ast.AST, aliases: Dict[str, str]) -> str:
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, _PY_CTYPES.get(node.id, node.id))
+    if isinstance(node, ast.Attribute):  # ctypes.c_x
+        return _PY_CTYPES.get(node.attr, node.attr)
+    if isinstance(node, ast.Call):
+        fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                 else node.func.id if isinstance(node.func, ast.Name)
+                 else "")
+        if fname == "POINTER" and node.args:
+            inner = _py_type_token(node.args[0], aliases)
+            # the ctypes mirror class is _BatchMeta; the C struct is
+            # BatchMeta — same type, normalize to one token
+            return inner.lstrip("_") + "p"
+    return "?"
+
+
+def parse_py_bindings(tree: ast.Module
+                      ) -> Dict[str, Dict[str, object]]:
+    """name -> {"argtypes": [tokens], "restype": token, "line": int}
+    from ``lib.X.argtypes = [...]`` / ``lib.X.restype = T`` assigns."""
+    aliases: Dict[str, str] = {}
+    out: Dict[str, Dict[str, object]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if isinstance(t, ast.Name):
+            tok = _py_type_token(node.value, aliases)
+            if tok.endswith("p") and tok != "?":
+                aliases[t.id] = tok
+            continue
+        if not (isinstance(t, ast.Attribute)
+                and t.attr in ("argtypes", "restype")
+                and isinstance(t.value, ast.Attribute)):
+            continue
+        fn = t.value.attr
+        if not _NATIVE_RE.fullmatch(fn):
+            continue
+        entry = out.setdefault(fn, {"line": node.lineno})
+        if t.attr == "argtypes":
+            elts = (node.value.elts
+                    if isinstance(node.value, (ast.List, ast.Tuple))
+                    else [])
+            entry["argtypes"] = [_py_type_token(e, aliases) for e in elts]
+        else:
+            entry["restype"] = _py_type_token(node.value, aliases)
+    return out
+
+
+def parse_py_calls(tree: ast.Module) -> Dict[str, int]:
+    """name -> first line of an attribute call on a native symbol
+    (AST-walked, so symbol mentions in comments/docstrings — which this
+    codebase's prose is full of — never count as calls)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _NATIVE_RE.fullmatch(node.func.attr)):
+            out.setdefault(node.func.attr, node.lineno)
+    return out
+
+
+def _module_const(tree: ast.Module, name: str) -> Optional[int]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Name) and t.id == name
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, int)):
+                    return node.value.value
+    return None
+
+
+def _struct_fmt(tree: ast.Module, name: str) -> Optional[str]:
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)
+                and isinstance(node.value, ast.Call)
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Constant)
+                and isinstance(node.value.args[0].value, str)):
+            return node.value.args[0].value
+    return None
+
+
+def _dict_literal(tree: ast.Module, name: str
+                  ) -> Optional[Dict[int, str]]:
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            out: Dict[int, str] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, int)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    out[k.value] = v.value
+            return out
+    return None
+
+
+def _ctypes_fields(tree: ast.Module, cls_name: str
+                   ) -> Optional[List[Tuple[str, str]]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for item in node.body:
+                if (isinstance(item, ast.Assign)
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "_fields_"
+                                for t in item.targets)
+                        and isinstance(item.value, ast.List)):
+                    fields = []
+                    for el in item.value.elts:
+                        if (isinstance(el, ast.Tuple)
+                                and len(el.elts) == 2
+                                and isinstance(el.elts[0], ast.Constant)):
+                            fields.append((
+                                el.elts[0].value,
+                                _py_type_token(el.elts[1], {})))
+                    return fields
+    return None
+
+
+class AbiDriftRule(Rule):
+    name = "abi-drift"
+    description = ("native/*.cpp exported signatures, header constants, "
+                   "structs and reason enums must match the ctypes "
+                   "bindings and frames.py")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_bindings(ctx))
+        findings.extend(self._check_frame_constants(ctx))
+        findings.extend(self._check_batch_meta(ctx))
+        findings.extend(self._check_reason_enum(ctx))
+        return findings
+
+    # -- function signatures ----------------------------------------------
+    def _check_bindings(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for py_rel, cpp_rel in BINDING_MODULES.items():
+            tree = ctx.tree(py_rel)
+            cpp = ctx.source(cpp_rel)
+            if tree is None or cpp is None:
+                continue
+            exports = parse_c_exports(cpp)
+            bindings = parse_py_bindings(tree)
+            calls = parse_py_calls(tree)
+            for fn in sorted(set(bindings) | set(calls)):
+                line = int(bindings.get(fn, {}).get(
+                    "line", calls.get(fn, 1)))
+                if fn not in exports:
+                    findings.append(Finding(
+                        self.name, py_rel, line,
+                        f"{fn} bound/called from Python but not "
+                        f"exported by {cpp_rel}"))
+                    continue
+                ret, cargs, _cline = exports[fn]
+                b = bindings.get(fn)
+                if b is None:
+                    # bare call without declared types: only safe for
+                    # void/int returns and pointer-free out-params
+                    if ret not in ("void", "int", "u32", "u16"):
+                        findings.append(Finding(
+                            self.name, py_rel, line,
+                            f"{fn} returns {ret} in C but is called "
+                            "without a declared restype (ctypes "
+                            "defaults to int — truncation)"))
+                    continue
+                pargs = b.get("argtypes")
+                if pargs is not None:
+                    if len(pargs) != len(cargs):
+                        findings.append(Finding(
+                            self.name, py_rel, line,
+                            f"{fn}: argtypes declares {len(pargs)} "
+                            f"argument(s), {cpp_rel} exports "
+                            f"{len(cargs)}"))
+                    else:
+                        for i, (p, c) in enumerate(zip(pargs, cargs)):
+                            if p != c:
+                                findings.append(Finding(
+                                    self.name, py_rel, line,
+                                    f"{fn}: argument {i} is {p} in "
+                                    f"ctypes but {c} in {cpp_rel}"))
+                restype = b.get("restype")
+                if restype is not None and restype != ret:
+                    findings.append(Finding(
+                        self.name, py_rel, line,
+                        f"{fn}: restype is {restype} in ctypes but "
+                        f"the C export returns {ret}"))
+                if restype is None and ret not in ("void", "int"):
+                    findings.append(Finding(
+                        self.name, py_rel, line,
+                        f"{fn}: C returns {ret} but no restype is "
+                        "declared (ctypes defaults to int)"))
+        return findings
+
+    # -- PSF2 header constants --------------------------------------------
+    def _check_frame_constants(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        tree = ctx.tree(FRAMES_PY)
+        cpp = ctx.source(TCPPS_CPP)
+        if tree is None or cpp is None:
+            return findings
+        fmt = _struct_fmt(tree, "_HEADER")
+        k_hdr = parse_c_const(cpp, "kPsfHeader")
+        if fmt is not None and k_hdr is not None:
+            py_size = pystruct.calcsize(fmt)
+            if py_size != k_hdr:
+                findings.append(Finding(
+                    self.name, FRAMES_PY, 1,
+                    f"PSF2 header is {py_size} bytes in frames.py "
+                    f"(_HEADER {fmt!r}) but kPsfHeader is {k_hdr} in "
+                    f"{TCPPS_CPP}"))
+            c_fields = parse_c_struct(cpp, "PsfHeader")
+            if c_fields is not None:
+                py_seq = [_FMT_CHARS.get(ch, "?") for ch in fmt
+                          if ch in _FMT_CHARS]
+                c_seq = [t for _n, t in c_fields]
+                if py_seq != c_seq:
+                    findings.append(Finding(
+                        self.name, FRAMES_PY, 1,
+                        f"PSF2 header field layout drifted: frames.py "
+                        f"packs {py_seq} but PsfHeader holds {c_seq}"))
+        for py_name, c_name in (("FRAME_MAGIC", "kPsfMagicV2"),
+                                ("FRAME_MAGIC_V1", "kPsfMagicV1")):
+            py_v = _module_const(tree, py_name)
+            c_v = parse_c_const(cpp, c_name)
+            if py_v is not None and c_v is not None and py_v != c_v:
+                findings.append(Finding(
+                    self.name, FRAMES_PY, 1,
+                    f"{py_name} is 0x{py_v:08x} in frames.py but "
+                    f"{c_name} is 0x{c_v:08x} in {TCPPS_CPP}"))
+        return findings
+
+    # -- BatchMeta struct --------------------------------------------------
+    def _check_batch_meta(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        tree = ctx.tree(TCP_PY)
+        cpp = ctx.source(TCPPS_CPP)
+        if tree is None or cpp is None:
+            return findings
+        c_fields = parse_c_struct(cpp, "BatchMeta")
+        py_fields = _ctypes_fields(tree, "_BatchMeta")
+        if c_fields is None or py_fields is None:
+            findings.append(Finding(
+                self.name, TCP_PY, 1,
+                "BatchMeta (C) or _BatchMeta (ctypes) struct not found "
+                "— the batched-ingest meta mirror is gone"))
+            return findings
+        if [(n, t) for n, t in c_fields] != [(n, t) for n, t in py_fields]:
+            findings.append(Finding(
+                self.name, TCP_PY, 1,
+                f"BatchMeta layout drifted: C has {c_fields}, ctypes "
+                f"mirror has {py_fields}"))
+        size = sum(_SIZES.get(t, 0) for _n, t in c_fields)
+        m = re.search(r"sizeof\(BatchMeta\)\s*==\s*(\d+)", cpp)
+        asserted = int(m.group(1)) if m else None
+        if asserted is not None and size != asserted:
+            findings.append(Finding(
+                self.name, TCP_PY, 1,
+                f"BatchMeta packs to {size} bytes but {TCPPS_CPP} "
+                f"asserts {asserted}"))
+        return findings
+
+    # -- FrameStatus reason enum ------------------------------------------
+    def _check_reason_enum(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        tree = ctx.tree(FRAMES_PY)
+        cpp = ctx.source(TCPPS_CPP)
+        if tree is None or cpp is None:
+            return findings
+        enum = parse_c_enum(cpp, "FrameStatus")
+        reasons = _dict_literal(tree, "BATCH_REASONS")
+        if enum is None or reasons is None:
+            findings.append(Finding(
+                self.name, FRAMES_PY, 1,
+                "FrameStatus enum or BATCH_REASONS map not found — "
+                "the reason-code bridge is gone"))
+            return findings
+        c_map = {code: name[len("FRAME_"):].lower()
+                 for code, name in enum.items() if code != 0}
+        for code in sorted(set(c_map) | set(reasons)):
+            c_name = c_map.get(code)
+            py_name = reasons.get(code)
+            if c_name != py_name:
+                findings.append(Finding(
+                    self.name, FRAMES_PY, 1,
+                    f"frame-rejection reason {code}: C says "
+                    f"{c_name!r}, BATCH_REASONS says {py_name!r}"))
+        return findings

@@ -1,0 +1,126 @@
+"""Rule 2: the job-cfg key schema.
+
+Every ``cfg["..."]`` / ``cfg.get("...")`` site in the package and the
+examples is checked against the declared registry
+(:mod:`tools.psanalyze.cfg_registry`):
+
+- a key read or written that the registry does not declare is a finding
+  (the typo case — ``cfg.get("buckt_mb")`` silently returns the default
+  forever);
+- a registry key declared ``settable="cli"`` that its canonical
+  operator CLI (the entry's ``cli=`` file) no longer sets is a finding
+  (the operator surface silently shrank — a write surviving in some
+  other example does not cover it);
+- a registry key nothing reads any more is a finding (the dead-knob
+  case — setting it does nothing and nobody notices).
+
+Write scope includes benchmarks/tools (legitimate cfg authors); read
+scope is the package + examples, where the job cfg is consumed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from tools.psanalyze.cfg_registry import CFG_KEYS
+from tools.psanalyze.core import AnalysisContext, Finding, Rule
+
+READ_DIRS = ("pytorch_ps_mpi_tpu", "examples")
+WRITE_DIRS = ("pytorch_ps_mpi_tpu", "examples", "benchmarks", "tools")
+
+
+def _is_cfg(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Name) and node.id == "cfg") or (
+        isinstance(node, ast.Attribute) and node.attr == "cfg")
+
+
+def collect_cfg_sites(
+    ctx: AnalysisContext,
+) -> Tuple[Dict[str, List[Tuple[str, int]]],
+           Dict[str, List[Tuple[str, int]]]]:
+    """``(reads, writes)``: cfg key → ``[(path, line), ...]``."""
+    reads: Dict[str, List[Tuple[str, int]]] = {}
+    writes: Dict[str, List[Tuple[str, int]]] = {}
+
+    def note(d, key, rel, line):
+        d.setdefault(key, []).append((rel, line))
+
+    for rel in ctx.py_files(under=WRITE_DIRS):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        in_read_scope = rel.split("/")[0] in READ_DIRS
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Subscript) and _is_cfg(node.value)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                key = node.slice.value
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    note(writes, key, rel, node.lineno)
+                elif in_read_scope:
+                    note(reads, key, rel, node.lineno)
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and _is_cfg(node.func.value)
+                    and node.func.attr in ("get", "setdefault", "pop")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                key = node.args[0].value
+                if in_read_scope:
+                    note(reads, key, rel, node.lineno)
+                if node.func.attr == "setdefault":
+                    note(writes, key, rel, node.lineno)
+            elif (isinstance(node, ast.Assign)
+                    and any(_is_cfg(t) for t in node.targets)
+                    and isinstance(node.value, ast.Dict)):
+                for k in node.value.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        note(writes, k.value, rel, node.lineno)
+    return reads, writes
+
+
+class CfgSchemaRule(Rule):
+    name = "cfg-schema"
+    description = ("every cfg key site must match the declared registry "
+                   "(no typos, no dead knobs, CLI keys stay settable)")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        reads, writes = collect_cfg_sites(ctx)
+        # 1) unknown keys (typos) — first site of each
+        for key in sorted(set(reads) | set(writes)):
+            if key in CFG_KEYS:
+                continue
+            sites = reads.get(key, []) + writes.get(key, [])
+            path, line = sites[0]
+            kind = "read" if key in reads else "written"
+            findings.append(Finding(
+                rule=self.name, path=path, line=line,
+                message=(f'cfg key "{key}" {kind} but not declared in '
+                         "tools/psanalyze/cfg_registry.py (typo, or a "
+                         "new knob missing its registry entry)")))
+        # 2) CLI keys must stay settable from THEIR canonical CLI
+        for key, info in sorted(CFG_KEYS.items()):
+            if info.settable != "cli":
+                continue
+            if not any(p == info.cli for p, _ in writes.get(key, [])):
+                findings.append(Finding(
+                    rule=self.name, path=info.cli, line=1,
+                    message=(f'cfg key "{key}" is declared settable="cli" '
+                             f"but {info.cli} never sets it")))
+        # 3) dead knobs: declared but read nowhere
+        for key, info in sorted(CFG_KEYS.items()):
+            if key not in reads:
+                sites = writes.get(key)
+                path, line = sites[0] if sites else (
+                    "tools/psanalyze/cfg_registry.py", 1)
+                findings.append(Finding(
+                    rule=self.name, path=path, line=line,
+                    message=(f'cfg key "{key}" is declared in the '
+                             "registry but nothing reads it any more "
+                             "(dead knob — delete the entry or the "
+                             "writes)")))
+        return findings

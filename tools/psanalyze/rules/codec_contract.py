@@ -1,0 +1,253 @@
+"""Rule 4: Codec subclass flag/method coherence.
+
+The codec contract lives in class-level flags whose promises are
+checked nowhere at definition time: a codec can claim
+``supports_aggregate = True`` and ship without ``agg_decode``, and the
+failure surfaces as a serve-loop ``NotImplementedError`` mid-training.
+This rule reads every class in ``pytorch_ps_mpi_tpu/codecs/`` and
+enforces, statically over the (single-inheritance) class chain:
+
+- ``supports_aggregate`` ⇒ ``aggregate`` + ``agg_decode`` overridden;
+- a partial streaming trio (some of ``agg_init``/``agg_fold``/
+  ``agg_finalize`` overridden but not all) is incoherent — the base
+  default accumulator shape and a partial override cannot compose;
+- ``bucketable`` ⇒ stateless: no non-trivial ``init_state`` override
+  (per-bucket state has no home — ``codecs/base.py``'s contract);
+- ``agg_exact`` set explicitly on a codec that does not claim
+  ``supports_aggregate`` is a dead flag (honesty check: the flag only
+  means something for an existing algebra);
+- ``supports_fused_allreduce`` ⇒ ``fused_allreduce`` +
+  ``fused_wire_bits``;
+- the hardened lossy four (:data:`HARDENED_NONFINITE`) must accept a
+  ``nonfinite=`` constructor kwarg and validate it via
+  ``check_nonfinite_mode``.
+
+Flags defined as ``@property`` (ErrorFeedback's delegation) are
+dynamic — those classes are skipped for flag checks but still checked
+for method-trio coherence.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from tools.psanalyze.core import AnalysisContext, Finding, Rule
+
+CODECS_DIR = "pytorch_ps_mpi_tpu/codecs"
+
+#: codecs whose payload statistics a single NaN poisons wholesale — the
+#: PR 5 hardening gave them the ``nonfinite=`` guard; dropping it in a
+#: refactor would silently reopen the hole
+HARDENED_NONFINITE = ("Int8Codec", "QSGDCodec", "SignCodec",
+                      "TernGradCodec")
+
+STREAM_TRIO = ("agg_init", "agg_fold", "agg_finalize")
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    path: str
+    line: int
+    bases: List[str]
+    methods: Set[str] = field(default_factory=set)
+    #: flag name -> literal bool value (class-level Assign only)
+    flags: Dict[str, bool] = field(default_factory=dict)
+    #: flags shadowed by @property (dynamic — skip value checks)
+    dynamic_flags: Set[str] = field(default_factory=set)
+    #: method name -> its FunctionDef (own defs only)
+    defs: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+
+def collect_codec_classes(ctx: AnalysisContext) -> Dict[str, ClassInfo]:
+    classes: Dict[str, ClassInfo] = {}
+    for rel in ctx.py_files(under=(CODECS_DIR,)):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = ClassInfo(
+                name=node.name, path=rel, line=node.lineno,
+                bases=[b.id if isinstance(b, ast.Name) else b.attr
+                       for b in node.bases
+                       if isinstance(b, (ast.Name, ast.Attribute))])
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    deco = {d.id if isinstance(d, ast.Name) else d.attr
+                            for d in item.decorator_list
+                            if isinstance(d, (ast.Name, ast.Attribute))}
+                    if "property" in deco:
+                        info.dynamic_flags.add(item.name)
+                    else:
+                        info.methods.add(item.name)
+                        info.defs[item.name] = item
+                elif isinstance(item, (ast.Assign, ast.AnnAssign)):
+                    targets = (item.targets if isinstance(item, ast.Assign)
+                               else [item.target])
+                    value = item.value
+                    for t in targets:
+                        if not isinstance(t, ast.Name):
+                            continue
+                        if (isinstance(value, ast.Constant)
+                                and isinstance(value.value, bool)):
+                            info.flags[t.id] = value.value
+                        else:
+                            # `agg_init = staticmethod(sparse_agg_init)`
+                            # style wiring counts as providing the method
+                            info.methods.add(t.id)
+            classes[node.name] = info
+    return classes
+
+
+def _chain(classes: Dict[str, ClassInfo], name: str) -> List[ClassInfo]:
+    """The class and its in-package ancestors (Codec base excluded —
+    its generic defaults are what the coherence checks are about)."""
+    out: List[ClassInfo] = []
+    seen: Set[str] = set()
+    todo = [name]
+    while todo:
+        n = todo.pop(0)
+        if n in seen or n == "Codec":
+            continue
+        seen.add(n)
+        info = classes.get(n)
+        if info is None:
+            continue
+        out.append(info)
+        todo.extend(info.bases)
+    return out
+
+
+def _is_codec(classes: Dict[str, ClassInfo], name: str) -> bool:
+    seen: Set[str] = set()
+    todo = [name]
+    while todo:
+        n = todo.pop(0)
+        if n in seen:
+            continue
+        seen.add(n)
+        if n == "Codec":
+            return True
+        info = classes.get(n)
+        if info is not None:
+            todo.extend(info.bases)
+    return False
+
+
+class CodecContractRule(Rule):
+    name = "codec-contract"
+    description = ("Codec subclasses: flags must match the methods they "
+                   "promise (aggregate trio, bucketable statelessness, "
+                   "nonfinite= hardening)")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        classes = collect_codec_classes(ctx)
+        codecs = {n: c for n, c in classes.items()
+                  if n != "Codec" and _is_codec(classes, n)}
+        for name, info in sorted(codecs.items()):
+            chain = _chain(classes, name)
+            methods: Set[str] = set()
+            flags: Dict[str, bool] = {}
+            dynamic: Set[str] = set()
+            own_flags: Set[str] = set(info.flags)
+            for c in chain:
+                methods |= c.methods
+                dynamic |= c.dynamic_flags
+                for k, v in c.flags.items():
+                    flags.setdefault(k, v)  # nearest definition wins
+
+            def flag(k: str) -> Optional[bool]:
+                if k in dynamic:
+                    return None  # property: dynamic, skip value checks
+                return flags.get(k, False)
+
+            if flag("supports_aggregate"):
+                for m in ("aggregate", "agg_decode"):
+                    if m not in methods:
+                        findings.append(Finding(
+                            self.name, info.path, info.line,
+                            f"{name} claims supports_aggregate but "
+                            f"never defines {m}()"))
+            claimed = [m for m in STREAM_TRIO if m in methods]
+            if claimed and len(claimed) != len(STREAM_TRIO):
+                missing = sorted(set(STREAM_TRIO) - set(claimed))
+                findings.append(Finding(
+                    self.name, info.path, info.line,
+                    f"{name} overrides {'/'.join(sorted(claimed))} but "
+                    f"not {'/'.join(missing)} — a partial streaming "
+                    "trio cannot share an accumulator with the base "
+                    "defaults"))
+            if flag("bucketable"):
+                own_init = next((c.defs.get("init_state") for c in chain
+                                 if "init_state" in c.defs), None)
+                if own_init is not None and not _returns_empty_tuple(
+                        own_init):
+                    findings.append(Finding(
+                        self.name, info.path, info.line,
+                        f"{name} is bucketable but overrides "
+                        "init_state() with per-tensor state — bucket "
+                        "boundaries cannot carry codec state "
+                        "(codecs/base.py contract)"))
+            if ("agg_exact" in own_flags
+                    and flag("supports_aggregate") is False):
+                findings.append(Finding(
+                    self.name, info.path, info.line,
+                    f"{name} sets agg_exact without "
+                    "supports_aggregate — the honesty flag only "
+                    "qualifies an existing aggregation algebra"))
+            if flag("supports_fused_allreduce"):
+                for m in ("fused_allreduce", "fused_wire_bits"):
+                    if m not in methods:
+                        findings.append(Finding(
+                            self.name, info.path, info.line,
+                            f"{name} claims supports_fused_allreduce "
+                            f"but never defines {m}()"))
+            if name in HARDENED_NONFINITE:
+                findings.extend(self._check_nonfinite(name, chain))
+        return findings
+
+    def _check_nonfinite(self, name: str,
+                         chain: List[ClassInfo]) -> List[Finding]:
+        init = next((c.defs.get("__init__") for c in chain
+                     if "__init__" in c.defs), None)
+        info = chain[0]
+        if init is None:
+            return [Finding(
+                self.name, info.path, info.line,
+                f"{name} is a hardened lossy codec but has no "
+                "__init__ taking the nonfinite= kwarg")]
+        args = init.args
+        params = {a.arg for a in
+                  args.args + args.kwonlyargs + args.posonlyargs}
+        if "nonfinite" not in params:
+            return [Finding(
+                self.name, info.path, init.lineno,
+                f"{name}.__init__ lost the nonfinite= kwarg — the "
+                "PR 5 NaN-poisoning guard is gone")]
+        validated = any(
+            isinstance(n, ast.Call) and (
+                (isinstance(n.func, ast.Name)
+                 and n.func.id == "check_nonfinite_mode")
+                or (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "check_nonfinite_mode"))
+            for n in ast.walk(init))
+        if not validated:
+            return [Finding(
+                self.name, info.path, init.lineno,
+                f"{name}.__init__ takes nonfinite= but never calls "
+                "check_nonfinite_mode() — a typo'd mode would surface "
+                "mid-training instead of at construction")]
+        return []
+
+
+def _returns_empty_tuple(fn: ast.FunctionDef) -> bool:
+    returns = [n for n in ast.walk(fn) if isinstance(n, ast.Return)]
+    return bool(returns) and all(
+        isinstance(r.value, ast.Tuple) and not r.value.elts
+        for r in returns)

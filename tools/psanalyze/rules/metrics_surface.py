@@ -1,0 +1,289 @@
+"""Rule 3: metrics-surface consistency.
+
+``PS_SERVER_METRIC_KEYS`` is the canonical schema every PS server emits.
+A key added to one surface but not the others used to be doc rot; this
+rule makes it a lint failure. Checked surfaces:
+
+1. the canonical tuple vs the one dict builder
+   (``ps_server_metrics``'s return literal) — exact set equality;
+2. the scrape registry: every canonical key maps (via
+   :data:`INSTRUMENT_MAP`) to a ``ps_*`` instrument name that must
+   appear in package source, and the map itself must cover exactly the
+   canonical keys — adding a canonical key forces a conscious decision
+   about its scrape twin;
+3. the ``/health`` builders: the fleet rollup subset
+   (``HEALTH_FLEET_ROLLUP_KEYS``) must be importable from the registry
+   module and a subset of the canonical keys, and every ``m["..."]``
+   subscript on a ``ps_server_metrics(...)`` result must name a
+   canonical key;
+4. ``docs/OPERATIONS.md``: every canonical key appears (backticked)
+   somewhere in the operations doc;
+5. no transport forks the schema: a class mixing in
+   ``PSServerTelemetry`` must not define its own ``metrics``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.psanalyze.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    str_tuple,
+)
+
+REGISTRY_PY = "pytorch_ps_mpi_tpu/telemetry/registry.py"
+OPERATIONS_MD = "docs/OPERATIONS.md"
+
+#: canonical metrics() key -> scrape instrument name (None = deliberately
+#: not instrumented; the canonical dict / TSDB is its only scrape form)
+INSTRUMENT_MAP: Dict[str, Optional[str]] = {
+    "ts": "ps_scrape_ts_seconds",
+    "uptime_s": "ps_uptime_seconds",
+    "grads_received": "ps_grads_received_total",
+    "bytes_received": "ps_wire_bytes_received_total",
+    "raw_bytes_per_grad": "ps_raw_bytes_per_grad",
+    "wire_bytes_per_grad": "ps_wire_bytes_per_grad",
+    "compression_ratio": "ps_compression_ratio",
+    "stale_drops": "ps_stale_drops_total",
+    "bucket_count": "ps_bucket_count",
+    "wire_units_per_push": "ps_wire_units_per_push",
+    "frames_rejected": "ps_frames_rejected_total",
+    "staleness_p50": "ps_staleness_p50",
+    "staleness_p95": "ps_staleness_p95",
+    "staleness_p99": "ps_staleness_p99",
+    "nonfinite_total": "ps_nonfinite_total",
+    "grad_norm": "ps_grad_norm",
+    "update_ratio": "ps_update_ratio",
+    "codec_rel_error": "ps_codec_rel_error",
+    "ef_residual_norm": "ps_ef_residual_norm",
+    "agg_mode": "ps_agg_mode",
+    "decodes_per_publish": "ps_decodes_per_publish",
+    "agg_fallbacks": "ps_agg_fallbacks_total",
+    "lineage_pushes": "ps_lineage_pushes_total",
+    "push_e2e_p50_ms": "ps_push_e2e_p50_ms",
+    "push_e2e_p95_ms": "ps_push_e2e_p95_ms",
+    "reads_total": "ps_reads_total",
+    "read_p50_ms": "ps_read_p50_ms",
+    "read_p95_ms": "ps_read_p95_ms",
+    "delta_bytes_saved": "ps_delta_bytes_saved_total",
+    "reads_shed": "ps_reads_shed_total",
+    "coalesce_hits": "ps_coalesce_hits_total",
+    "reads_not_modified": "ps_reads_not_modified_total",
+}
+
+
+def _find_assign_tuple(tree: ast.Module, name: str
+                       ) -> Tuple[Optional[Tuple[str, ...]], int]:
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == name:
+                return str_tuple(value), node.lineno
+    return None, 1
+
+
+def _return_dict_keys(fn: ast.FunctionDef) -> Set[str]:
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.add(k.value)
+    return keys
+
+
+def _ps_string_literals(ctx: AnalysisContext) -> Set[str]:
+    """Every ``ps_*`` string constant in the package — the existence
+    ground for instrument names (robust to names built in loops)."""
+    out: Set[str] = set()
+    pat = re.compile(r"^ps_[a-z0-9_]+$")
+    for rel in ctx.py_files(under=("pytorch_ps_mpi_tpu",)):
+        tree = ctx.tree(rel)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and pat.match(node.value)):
+                out.add(node.value)
+    return out
+
+
+class MetricsSurfaceRule(Rule):
+    name = "metrics-surface"
+    description = ("PS_SERVER_METRIC_KEYS, the metrics() builder, scrape "
+                   "instruments, /health rollups and docs/OPERATIONS.md "
+                   "must agree key-for-key")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        tree = ctx.tree(REGISTRY_PY)
+        if tree is None:
+            return [Finding(self.name, REGISTRY_PY, 1,
+                            "cannot parse the canonical metrics module")]
+        canon, canon_line = _find_assign_tuple(tree, "PS_SERVER_METRIC_KEYS")
+        if canon is None:
+            return [Finding(self.name, REGISTRY_PY, 1,
+                            "PS_SERVER_METRIC_KEYS tuple literal not found")]
+        canon_set = set(canon)
+
+        # 1) the one dict builder
+        builder = next((n for n in ast.walk(tree)
+                        if isinstance(n, ast.FunctionDef)
+                        and n.name == "ps_server_metrics"), None)
+        if builder is None:
+            findings.append(Finding(
+                self.name, REGISTRY_PY, 1,
+                "ps_server_metrics() not found beside "
+                "PS_SERVER_METRIC_KEYS"))
+        else:
+            built = _return_dict_keys(builder)
+            for k in sorted(canon_set - built):
+                findings.append(Finding(
+                    self.name, REGISTRY_PY, builder.lineno,
+                    f'canonical key "{k}" missing from the '
+                    "ps_server_metrics() return dict"))
+            for k in sorted(built - canon_set):
+                findings.append(Finding(
+                    self.name, REGISTRY_PY, builder.lineno,
+                    f'ps_server_metrics() emits "{k}" which is not in '
+                    "PS_SERVER_METRIC_KEYS"))
+
+        # 2) scrape instruments via the declared map
+        for k in sorted(canon_set - set(INSTRUMENT_MAP)):
+            findings.append(Finding(
+                self.name, REGISTRY_PY, canon_line,
+                f'canonical key "{k}" has no INSTRUMENT_MAP entry '
+                "(tools/psanalyze/rules/metrics_surface.py) — declare "
+                "its scrape instrument, or map it to None deliberately"))
+        for k in sorted(set(INSTRUMENT_MAP) - canon_set):
+            findings.append(Finding(
+                self.name, REGISTRY_PY, canon_line,
+                f'INSTRUMENT_MAP names "{k}" which is no longer a '
+                "canonical key"))
+        literals = _ps_string_literals(ctx)
+        for k, inst in sorted(INSTRUMENT_MAP.items()):
+            if k in canon_set and inst is not None and inst not in literals:
+                findings.append(Finding(
+                    self.name, REGISTRY_PY, canon_line,
+                    f'scrape instrument "{inst}" (canonical key "{k}") '
+                    "not emitted anywhere in the package"))
+
+        # 3) /health builders
+        rollup, rollup_line = _find_assign_tuple(
+            tree, "HEALTH_FLEET_ROLLUP_KEYS")
+        if rollup is None:
+            findings.append(Finding(
+                self.name, REGISTRY_PY, 1,
+                "HEALTH_FLEET_ROLLUP_KEYS not found in the registry "
+                "module (the /health fleet rollup must import its key "
+                "subset from the canonical schema's home)"))
+        else:
+            for k in sorted(set(rollup) - canon_set):
+                findings.append(Finding(
+                    self.name, REGISTRY_PY, rollup_line,
+                    f'HEALTH_FLEET_ROLLUP_KEYS names "{k}" which is not '
+                    "a canonical key"))
+        findings.extend(self._check_metric_subscripts(ctx, canon_set))
+
+        # 4) the operations doc
+        md = ctx.source(OPERATIONS_MD)
+        if md is None:
+            findings.append(Finding(
+                self.name, OPERATIONS_MD, 1,
+                "docs/OPERATIONS.md missing — the canonical metric keys "
+                "must stay documented"))
+        else:
+            # keys count only INSIDE code context: a fenced ``` block,
+            # or a single-line inline `span` (fences are pulled out
+            # FIRST — their odd backtick counts desync naive pairing —
+            # and inline spans pair per line, so a raw `...key...`
+            # regex can never bridge two adjacent spans and accept
+            # un-ticked prose). Match is word-bounded within the span
+            # ("`staleness_p50/p95/p99`", "`reads_total` +").
+            spans = re.findall(r"```.*?```", md, re.S)
+            fenceless = re.sub(r"```.*?```", "", md, flags=re.S)
+            for line in fenceless.splitlines():
+                spans.extend(re.findall(r"`([^`]+)`", line))
+            for k in sorted(canon_set):
+                pat = re.compile(r"\b%s\b" % re.escape(k))
+                if any(pat.search(s) for s in spans):
+                    continue
+                findings.append(Finding(
+                    self.name, OPERATIONS_MD, 1,
+                    f'canonical metric key "{k}" is not documented in '
+                    "docs/OPERATIONS.md"))
+
+        # 5) no transport forks metrics()
+        for rel in ctx.py_files(under=("pytorch_ps_mpi_tpu",)):
+            t = ctx.tree(rel)
+            if t is None or rel == REGISTRY_PY:
+                continue
+            for node in ast.walk(t):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = {b.id if isinstance(b, ast.Name) else b.attr
+                         for b in node.bases
+                         if isinstance(b, (ast.Name, ast.Attribute))}
+                if "PSServerTelemetry" not in bases:
+                    continue
+                for item in node.body:
+                    if (isinstance(item, ast.FunctionDef)
+                            and item.name == "metrics"):
+                        findings.append(Finding(
+                            self.name, rel, item.lineno,
+                            f"{node.name} overrides metrics() — the "
+                            "canonical schema must not fork per "
+                            "transport (extend ps_server_metrics "
+                            "instead)"))
+        return findings
+
+    def _check_metric_subscripts(self, ctx: AnalysisContext,
+                                 canon: Set[str]) -> List[Finding]:
+        """In the telemetry package: every string subscript on a name
+        bound from ``ps_server_metrics(...)`` / ``.metrics()`` must be a
+        canonical key."""
+        findings: List[Finding] = []
+        for rel in ctx.py_files(under=("pytorch_ps_mpi_tpu",)):
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            for fn in ast.walk(tree):
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                bound: Set[str] = set()
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        f = node.value.func
+                        callee = (f.id if isinstance(f, ast.Name)
+                                  else f.attr if isinstance(f, ast.Attribute)
+                                  else None)
+                        if callee in ("ps_server_metrics", "metrics"):
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    bound.add(t.id)
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Subscript)
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id in bound
+                            and isinstance(node.slice, ast.Constant)
+                            and isinstance(node.slice.value, str)
+                            and node.slice.value not in canon):
+                        findings.append(Finding(
+                            self.name, rel, node.lineno,
+                            f'"{node.slice.value}" read from a canonical '
+                            "metrics dict but not in "
+                            "PS_SERVER_METRIC_KEYS"))
+        return findings

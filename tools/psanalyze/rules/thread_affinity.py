@@ -1,0 +1,70 @@
+"""Rule 1: thread affinity of native transport handles.
+
+The discipline every PR since 3 re-asserted by hand: the shm/TCP pumps
+and every other ctypes entry point are driven from the serve loop (or a
+worker main) only — the metrics-HTTP scrape threads, the selectors read
+loop, the profiler thread, and the data-prefetch pump touch pure-Python
+state exclusively. A native handle crossing onto one of those threads
+is a use-after-close or a torn pump away from a crash no test catches
+deterministically.
+
+Mechanically: build the package call graph, root it at every discovered
+non-serve-thread entry point (``threading.Thread`` targets, HTTP
+``do_*`` handlers, the callables registered on ``MetricsHTTPServer``),
+and flag any root from which a ``wc_*``/``tps_*``/``psq_*`` call site is
+reachable. Sanctioned exceptions — the atomic-counter profile-stats
+reads that never hold a handle — carry ``# psanalyze: ok
+thread-affinity`` pragmas at the call site.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.psanalyze.callgraph import build_callgraph
+from tools.psanalyze.core import AnalysisContext, Finding, Rule
+
+#: def names (EXACT match on the function's own name) of thread targets
+#: that ARE the serve loop — the native handles' home thread — and so
+#: are sanctioned roots, not violations. A renamed/wrapped serve entry
+#: that trips the rule takes a `# psanalyze: ok thread-affinity` pragma
+#: at the call site (or a new entry here) — the explicit audit trail is
+#: the point.
+SERVE_THREAD_NAMES = (
+    "serve", "worker_main", "server_main", "_serve_loop", "run_steps",
+)
+
+
+class ThreadAffinityRule(Rule):
+    name = "thread-affinity"
+    description = (
+        "no non-serve-thread root (HTTP handlers, selectors loop, "
+        "profiler, data pump) may reach a native wc_*/tps_*/psq_* "
+        "call site")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        graph = build_callgraph(ctx)
+        findings: List[Finding] = []
+        seen = set()
+        for root in graph.roots:
+            simple = root.qname.split("::")[-1].rsplit(".", 1)[-1]
+            if simple in SERVE_THREAD_NAMES:
+                continue
+            hit = graph.reachable_native(root.qname)
+            if hit is None:
+                continue
+            chain, (symbol, line) = hit
+            site = graph.defs[chain[-1]]
+            key = (site.path, line, symbol, root.qname)
+            if key in seen:
+                continue
+            seen.add(key)
+            pretty = " -> ".join(q.split("::")[-1] for q in chain)
+            findings.append(Finding(
+                rule=self.name, path=site.path, line=line,
+                message=(
+                    f"native call {symbol}() reachable from "
+                    f"{root.reason} root {root.qname.split('::')[-1]} "
+                    f"({root.path}:{root.line}) via {pretty}"),
+            ))
+        return findings
