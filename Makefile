@@ -15,6 +15,7 @@ test:
 	$(MAKE) native-smoke
 	$(MAKE) native-asan
 	$(MAKE) obs-smoke
+	$(MAKE) tree-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -104,6 +105,35 @@ read-smoke:
 # bench_gate trajectory row to benchmarks/results/agg_smoke.jsonl.
 agg-smoke:
 	JAX_PLATFORMS=cpu python tools/agg_smoke.py
+
+# Hierarchical-aggregation gate (in the default `make test` path): a
+# real 2-group/6-worker tree with a leader crash injected mid-fold must
+# account EVERY worker push through every hop (composed at the root —
+# trace IDs surviving the leader re-encode — or positively logged lost
+# with the dead leader), fold with one decode per published version at
+# the root and zero per-push decodes at leaders, recover via
+# direct-to-root fallback + pinned-port respawn + rejoin, and pass
+# tree_bench --quick's root-ingest flatness gates (8->64 workers at
+# nonzero TPS_WAN_RTT_MS: tree <=1.3x vs star >=6x bytes/publish).
+# Appends a bench_gate trajectory row to
+# benchmarks/results/tree_smoke.jsonl.
+tree-smoke:
+	JAX_PLATFORMS=cpu python tools/tree_smoke.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/tree_smoke.jsonl \
+		--metric 'tree_smoke.wall_total_s:lower:1.5' \
+		--metric 'tree_smoke.decodes_per_publish:lower:0.01'
+
+# Full-scale star-vs-tree root-ingest bench (the tree-smoke quick gates
+# at measurement scale); rows + a bench_gate-gated trajectory in
+# benchmarks/results/tree_bench.jsonl.
+tree-bench:
+	JAX_PLATFORMS=cpu python benchmarks/tree_bench.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/tree_bench.jsonl \
+		--metric 'tree_bench.tree_growth_x:lower:0.3' \
+		--metric 'tree_bench.star_growth_x:higher:0.3' \
+		--metric 'tree_bench.tree_root_cpu_ms_per_publish_64w:lower:1.0'
 
 # Full per-push server-cost bench over 1x/8x models (the agg-smoke
 # quick gates at measurement scale); rows + a bench_gate-gated
@@ -213,4 +243,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke analyze native-asan native-ubsan native-tsan
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan

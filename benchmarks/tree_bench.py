@@ -1,0 +1,391 @@
+"""Root-ingest scaling: star vs hierarchical tree, 8 → 64 workers.
+
+The claim under test (ISSUE 13 / DynamiQ, PAPERS.md): with a fixed pod
+count, growing workers-per-pod grows each LEADER's fan-in but not the
+root's — root ingest bytes per published version stay near-flat, while
+the star grows linearly with worker count. Both legs run at a nonzero
+emulated DCN RTT (``TPS_WAN_RTT_MS`` on every root-facing pusher) so
+the topology pays the tax it would in a real cross-pod deployment.
+
+Mechanics: the ROOT and the LEADERS are the real system under test —
+an in-process ``serve()`` (tree mode where applicable) and real
+``leader_main`` subprocesses folding compressed payloads with zero
+per-push decodes. The leaf WORKERS are synthetic: each "pod" is one
+subprocess running its workers as threads that seal and push a
+pre-encoded payload through the real framed TCP wire (ctypes-level —
+no per-worker jit, which is what makes 64 workers tractable on a
+2-core CI box). Payload bytes, frame validation, trailers, staleness
+accounting and the WAN shim are all the production path.
+
+Gates (hard asserts, also written to the JSONL row):
+
+- star root bytes/publish grow >= 6x from 8 to 64 workers (expect 8x);
+- tree root bytes/publish grow <= 1.3x (expect ~1.0x — the trailer
+  capacity is fixed at the deployment's max pod size on both legs);
+- ``decodes_per_publish == 1.0`` at the root on the tree legs;
+- zero per-push ingest decodes at every leader (scraped live from the
+  leaders' /metrics before they exit).
+
+Usage: ``python benchmarks/tree_bench.py [--quick] [--rtt-ms 4]``.
+Appends a row to ``benchmarks/results/tree_bench.jsonl`` (gated by
+``make tree-bench`` via bench_gate --trajectory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS = os.path.join(REPO, "benchmarks", "results", "tree_bench.jsonl")
+
+#: fixed pod count — workers grow per pod, the root's fan-in must not
+PODS = 2
+#: trailer capacity: the deployment's MAX pod size, constant across
+#: legs so the tree's bytes/publish comparison is capacity-honest
+SLOTS = 32
+
+BASE_CFG = {
+    "model": "mlp", "model_kw": {"features": (128, 16)},
+    "in_shape": (32,), "batch": 8, "seed": 7,
+    "codec": "topk", "codec_kw": {"fraction": 0.25},
+    "optim": "sgd", "hyper": {"lr": 0.05},
+    "frame_check": True, "transport": "tcp",
+    "max_staleness": 10 ** 9,
+    "leader_kw": {"group_codec": "identity", "idle_exit_s": 10.0,
+                  "read_poll_s": 0.05},
+}
+
+
+def pusher_pod(argv=None) -> int:
+    """One pod process: its workers as threads, each sealing + pushing
+    a pre-encoded payload through the real framed wire. ``codec_kind``
+    picks the wire: "upstream" (star → root, cfg codec) or "group"
+    (tree → leader, the leaf hop's identity codec)."""
+    import threading
+
+    spec = json.loads(sys.argv[1] if argv is None else argv)
+    cfg = spec["cfg"]
+    wids = spec["wids"]
+    host, port = spec["addr"].rsplit(":", 1)
+    pushes = int(spec["pushes"])
+
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.parallel import tcp
+    from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+    from pytorch_ps_mpi_tpu.parallel.dcn import CodecWire
+    from pytorch_ps_mpi_tpu.resilience import frames
+
+    _, params0, _, _ = make_problem(cfg)
+    if spec["codec_kind"] == "group":
+        code = get_codec(cfg["leader_kw"]["group_codec"])
+    else:
+        code = get_codec(cfg["codec"], **cfg.get("codec_kw", {}))
+    wire = CodecWire(code, params0)
+    rng = np.random.RandomState(int(cfg.get("seed", 0)))
+    import jax
+
+    grad = jax.tree.map(
+        lambda x: rng.randn(*np.shape(x)).astype(np.float32), params0)
+    payload = np.array(wire.encode_to_bytes(grad), copy=True)
+    fp = frames.wire_fingerprint(wire, params0)
+    lib = tcp.get_lib()
+
+    import ctypes
+
+    def one_worker(wid: int):
+        import socket
+
+        addr = socket.gethostbyname(host)
+        h = lib.tps_worker_connect(addr.encode(), int(port), wid, 60000)
+        assert h, f"pusher {wid} connect failed"
+        buf = np.empty(frames.HEADER_BYTES + payload.nbytes, np.uint8)
+        try:
+            for s in range(pushes):
+                sealed = frames.seal_frame(buf, payload, fp, step=s, seq=s)
+                rc = lib.tps_worker_push_grad(
+                    h, sealed.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_uint8)),
+                    sealed.nbytes, 1, 60000)
+                assert rc == 1, f"pusher {wid} push -> {rc}"
+        finally:
+            lib.tps_worker_close(h)
+
+    threads = [threading.Thread(target=one_worker, args=(w,))
+               for w in wids]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return 0
+
+
+def _spawn_pod(cfg, wids, addr, codec_kind, pushes, rtt_ms):
+    spec = {"cfg": cfg, "wids": wids, "addr": addr,
+            "codec_kind": codec_kind, "pushes": pushes}
+    src = (
+        f"import sys; sys.path.insert(0, {REPO!r})\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from benchmarks.tree_bench import pusher_pod\n"
+        "sys.exit(pusher_pod())\n"
+    )
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "TPS_WAN_RTT_MS": str(rtt_ms)})
+    return subprocess.Popen([sys.executable, "-c", src, json.dumps(spec)],
+                            env=env)
+
+
+def _scrape(port: int) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=3.0) as r:
+        text = r.read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" in line:
+            continue
+        parts = line.split()
+        if len(parts) == 2:
+            try:
+                out[parts[0]] = float(parts[1])
+            except ValueError:
+                pass
+    return out
+
+
+def run_star(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
+    """Star baseline: every pusher ships compressed frames straight to
+    the root, paying the DCN RTT."""
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.parallel.async_train import (
+        join_workers,
+        make_problem,
+        serve,
+    )
+    from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSServer
+
+    cfg = dict(BASE_CFG)
+    cfg["n_workers"] = n_workers
+    _, params0, _, _ = make_problem(cfg)
+    root = TcpPSServer(0, num_workers=n_workers, template=params0,
+                       max_staleness=10 ** 9,
+                       code=get_codec(cfg["codec"], **cfg["codec_kw"]),
+                       frame=True)
+    addr = f"127.0.0.1:{root.port}"
+    plan = np.array_split(np.arange(n_workers), PODS)
+    pods = [_spawn_pod(cfg, [int(w) for w in wids], addr, "upstream",
+                       pushes, rtt_ms) for wids in plan]
+    t0, c0 = time.perf_counter(), time.process_time()
+    try:
+        # stop via stop_when + drain (NOT total_received): the batched
+        # ingest counts frames the moment a batch pops, so a bare count
+        # condition would exit with frames stranded in the inbox
+        _, m = serve(root, cfg, total_grads=10 ** 9,
+                     sync_barrier=True, timeout=timeout,
+                     stop_when=lambda: (root.grads_received
+                                        >= n_workers * pushes))
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        codes = join_workers(pods, timeout=60.0)
+    finally:
+        for p in pods:
+            if p.poll() is None:
+                p.terminate()
+        root.close()
+    assert codes == [0] * PODS, codes
+    publishes = max(1.0, m["publish_version"] - 1)
+    return {
+        "workers": n_workers,
+        "bytes_per_publish": m["bytes_received"] / publishes,
+        "ingest_bytes_per_s": m["bytes_received"] / wall,
+        "root_cpu_ms_per_publish": 1e3 * cpu / publishes,
+        "frames_per_publish": m["grads_received"] / publishes,
+        "decodes_per_publish": m["decodes_per_publish"],
+        "agg_mode": m["agg_mode"],
+        "wall_s": wall,
+    }
+
+
+def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
+    """Tree leg: real leaders (one per pod) fold the pods' pushes and
+    ship ONE compressed frame per round to the root over the emulated
+    DCN; pod pushers ride the cheap intra-pod link (no RTT)."""
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.parallel.async_train import (
+        join_workers,
+        make_problem,
+        serve,
+    )
+    from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSServer
+    from pytorch_ps_mpi_tpu.parallel.tree import (
+        group_plan,
+        leader_wid,
+        read_leader_hello,
+        spawn_leader,
+    )
+
+    group_size = n_workers // PODS
+    cfg = dict(BASE_CFG)
+    cfg.update(n_workers=n_workers, group_size=group_size,
+               tree=True, tree_slots=SLOTS, metrics_port=0,
+               tree_members=[leader_wid(n_workers, g)
+                             for g in range(PODS)])
+    groups = group_plan(n_workers, group_size)
+    assert len(groups) == PODS
+    _, params0, _, _ = make_problem(cfg)
+    root = TcpPSServer(0, num_workers=n_workers + PODS, template=params0,
+                       max_staleness=10 ** 9,
+                       code=get_codec(cfg["codec"], **cfg["codec_kw"]),
+                       frame=True, tree_slots=SLOTS)
+    addr = f"127.0.0.1:{root.port}"
+    leaders, leader_metric_ports, pods = [], [], []
+    leader_stats = []
+    t0 = c0 = None
+    try:
+        for g, grp in enumerate(groups):
+            # the leader IS on the DCN: its upstream pushes + snapshot
+            # reads pay the RTT (the pod-side server costs nothing)
+            p = spawn_leader([addr], g, grp, cfg,
+                             env={"TPS_WAN_RTT_MS": str(rtt_ms)})
+            hello = read_leader_hello(p)
+            leaders.append(p)
+            leader_metric_ports.append(hello.get("health_port"))
+            pods.append(_spawn_pod(cfg, grp, hello["addr"], "group",
+                                   pushes, 0.0))
+
+        scraped = {"done": False}
+
+        def stop_when():
+            if root.tree_composed >= n_workers * pushes:
+                if not scraped["done"]:
+                    # scrape the leaders' invariants while they live
+                    scraped["done"] = True
+                    for port in leader_metric_ports:
+                        if port:
+                            try:
+                                leader_stats.append(_scrape(port))
+                            except Exception:
+                                leader_stats.append({})
+                return True
+            return all(p.poll() is not None for p in pods + leaders)
+
+        t0, c0 = time.perf_counter(), time.process_time()
+        _, m = serve(root, cfg, total_grads=10 ** 9, sync_barrier=True,
+                     timeout=timeout, stop_when=stop_when)
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        pod_codes = join_workers(pods, timeout=60.0)
+        leader_codes = join_workers(leaders, timeout=60.0)
+    finally:
+        for p in pods + leaders:
+            if p.poll() is None:
+                p.terminate()
+        root.close()
+    assert pod_codes == [0] * PODS, pod_codes
+    assert leader_codes == [0] * PODS, leader_codes
+    publishes = max(1.0, m["publish_version"] - 1)
+    return {
+        "workers": n_workers,
+        "bytes_per_publish": m["bytes_received"] / publishes,
+        "ingest_bytes_per_s": m["bytes_received"] / wall,
+        "root_cpu_ms_per_publish": 1e3 * cpu / publishes,
+        "frames_per_publish": m["grads_received"] / publishes,
+        "decodes_per_publish": m["decodes_per_publish"],
+        "agg_mode": m["agg_mode"],
+        "tree_composed": m["tree_composed"],
+        "leader_decodes": [s.get("ps_tree_leader_decodes")
+                           for s in leader_stats],
+        "leader_upstream_pushes": [
+            s.get("ps_tree_upstream_pushes_total") for s in leader_stats],
+        "wall_s": wall,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CI scale: fewer pushes per worker")
+    ap.add_argument("--rtt-ms", type=float, default=4.0,
+                    help="emulated DCN round trip (must be > 0: the "
+                    "gate is only honest with a real DCN tax)")
+    ap.add_argument("--out", default=RESULTS)
+    args = ap.parse_args(argv)
+    assert args.rtt_ms > 0, "tree_bench requires a nonzero emulated RTT"
+    pushes = 3 if args.quick else 8
+    timeout = 240.0 if args.quick else 480.0
+
+    results = {"star": {}, "tree": {}}
+    for n in (8, 64):
+        print(f"== star  {n:3d} workers x {pushes} pushes "
+              f"@ rtt {args.rtt_ms} ms", flush=True)
+        results["star"][n] = run_star(n, pushes, args.rtt_ms, timeout)
+        print("   ", {k: round(v, 3) if isinstance(v, float) else v
+                      for k, v in results["star"][n].items()}, flush=True)
+        print(f"== tree  {n:3d} workers ({PODS} pods)", flush=True)
+        results["tree"][n] = run_tree(n, pushes, args.rtt_ms, timeout)
+        print("   ", {k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in results["tree"][n].items()}, flush=True)
+
+    star_growth = (results["star"][64]["bytes_per_publish"]
+                   / results["star"][8]["bytes_per_publish"])
+    tree_growth = (results["tree"][64]["bytes_per_publish"]
+                   / results["tree"][8]["bytes_per_publish"])
+    tree_dpp = results["tree"][64]["decodes_per_publish"]
+    leader_decodes = [d for leg in results["tree"].values()
+                     for d in leg["leader_decodes"] if d is not None]
+    print(f"\nroot bytes/publish growth 8->64: star {star_growth:.2f}x, "
+          f"tree {tree_growth:.2f}x")
+    print(f"tree decodes/publish {tree_dpp}, leader ingest decodes "
+          f"{leader_decodes}")
+
+    # -- the gates ---------------------------------------------------------
+    assert star_growth >= 6.0, (
+        f"star baseline grew only {star_growth:.2f}x — the comparison "
+        "is broken, not the tree")
+    assert tree_growth <= 1.3, (
+        f"tree root ingest grew {tree_growth:.2f}x from 8 to 64 workers "
+        "(gate 1.3x) — the tree is no longer flat")
+    assert all(leg["decodes_per_publish"] == 1.0
+               and leg["agg_mode"] == 1.0
+               for leg in results["tree"].values()), (
+        "tree root must fold compressed frames with ONE decode per "
+        f"published version: {results['tree']}")
+    assert leader_decodes and all(d == 0.0 for d in leader_decodes), (
+        f"leaders performed per-push ingest decodes: {leader_decodes}")
+
+    row = {
+        "bench": "tree_bench", "t": time.time(),
+        "quick": bool(args.quick), "rtt_ms": args.rtt_ms,
+        "pods": PODS, "pushes": pushes,
+        "metrics": {
+            "tree_bench.star_growth_x": round(star_growth, 4),
+            "tree_bench.tree_growth_x": round(tree_growth, 4),
+            "tree_bench.tree_root_cpu_ms_per_publish_64w": round(
+                results["tree"][64]["root_cpu_ms_per_publish"], 4),
+            "tree_bench.star_root_cpu_ms_per_publish_64w": round(
+                results["star"][64]["root_cpu_ms_per_publish"], 4),
+            "tree_bench.tree_bytes_per_publish_64w": round(
+                results["tree"][64]["bytes_per_publish"], 1),
+            "tree_bench.star_bytes_per_publish_64w": round(
+                results["star"][64]["bytes_per_publish"], 1),
+        },
+        "legs": results,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(f"\nrow appended to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

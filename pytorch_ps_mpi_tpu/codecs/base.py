@@ -30,7 +30,18 @@ SURVEY §2.2):
   form of the same algebra, used by the async serve loop's
   ``CodecWire`` aggregator: each arriving push folds into a compressed
   accumulator and the one decode happens at publish time
-  (``decodes_per_publish == 1``).
+  (``decodes_per_publish == 1``). The hierarchical tree
+  (``parallel.tree``) runs the SAME streaming algebra at every
+  intermediate hop: a leader folds its group's payloads without any
+  per-push decode, finalizes once per upstream round, and re-encodes
+  the aggregate for the next hop behind per-hop error feedback
+  (``codecs.error_feedback.HopErrorFeedback``), so the fold algebra is
+  the tree's one aggregation primitive and its SUM semantics must hold
+  recursively — a folded-then-re-encoded payload is a valid input to
+  the parent's fold. Codecs whose payload statistics are per-input
+  (sign's mean|g|, int8's absmax) keep working because the re-encode
+  recomputes them on the aggregate; nothing mid-tree ever assumes a
+  payload came from a single worker.
 - ``init_state(shape, dtype)`` — per-leaf codec state (e.g. error-feedback
   memory); ``()`` for stateless codecs. Explicit state threading replaces
   the reference's mutable ``code.codes`` side channel (``ps.py:165``).

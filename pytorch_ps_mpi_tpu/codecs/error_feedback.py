@@ -5,11 +5,33 @@ into per-worker memory and added back before the next encode, restoring
 convergence for biased codecs (sign, top-k). The memory is explicit codec
 state threaded through the train step — the principled replacement for the
 reference's mutable ``code.codes`` side channel (``ps.py:165``).
+
+Two EF placements exist since the hierarchical-aggregation tree
+(``parallel.tree``):
+
+- :class:`ErrorFeedback` — the classic WORKER-side wrapper: residual
+  memory per worker, corrected at the encode site, threaded as codec
+  state through the jitted step.
+- :class:`HopErrorFeedback` — the per-HOP form a tree LEADER runs on the
+  host: the leader folds its group's compressed payloads (one decode
+  never happens per push), and when it re-encodes the folded aggregate
+  for the upstream hop, the re-encode's residual is accumulated in
+  leader-local memory and added back into the NEXT round's aggregate.
+  Each hop's error is therefore bounded by its own EF recursion
+  (Karimireddy et al.'s Thm. 2 applies per hop), and the hops COMPOSE:
+  worker-side EF bounds the worker→leader encode error, hop EF bounds
+  the leader→root re-encode error, so end-to-end fidelity degrades
+  additively in the number of hops rather than multiplicatively. The
+  caveat (documented in docs/OPERATIONS.md): hop residual memory lives
+  on the leader, so a leader crash loses at most one round's residual —
+  the group's fallback pushes are NOT corrected for the dead leader's
+  unflushed residual.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from pytorch_ps_mpi_tpu.codecs.base import Codec, register_codec
 
@@ -87,3 +109,68 @@ class ErrorFeedback(Codec):
         mem = np.asarray(state["memory"], np.float32)
         out["ef_residual_norm"] = float(np.linalg.norm(mem.reshape(-1)))
         return out
+
+
+class HopErrorFeedback:
+    """Per-hop error feedback for an aggregation-tree leader's re-encode.
+
+    The leader's hop is ``finalize (group aggregate) → encode → push
+    upstream``; the encode is lossy for compressing codecs, and without
+    correction the loss would compound hop over hop. This class keeps
+    the hop's residual in LEADER-local host memory, keyed to the wire's
+    template leaves: every round the residual is added back into the
+    aggregate before encoding, and the new residual is measured against
+    the decode of the EXACT payload that ships (bit-for-bit what the
+    parent will see) — the EF recursion, applied at the hop instead of
+    the worker. Host numpy throughout: no jit dispatch beyond the wire's
+    own jitted encode/decode, and the decode-back is the one extra
+    decode a correction-by-definition requires (it never counts against
+    the leader's ``decodes_done``, which tracks PER-PUSH ingest decodes
+    — the tree's "zero decodes at leaders" invariant).
+
+    ``enabled=False`` turns the whole thing into a plain ``encode`` (no
+    decode-back, no residual) — ``cfg["hop_ef"]`` plumbs it.
+    """
+
+    def __init__(self, wire, enabled: bool = True):
+        self.wire = wire
+        self.enabled = bool(enabled)
+        self._residual = None      # per-leaf flat f32 arrays
+        self.residual_norm = 0.0   # ||residual|| after the last hop
+        self.last_rel_error = 0.0  # hop rel-L2 error BEFORE correction ref
+        self.rounds = 0
+
+    def encode(self, grad_tree):
+        """``grad + residual`` → payload bytes (the wire's ping-pong
+        buffer — ship or seal before the next-next encode). Updates the
+        residual from the shipped payload's decode when enabled."""
+        import jax
+
+        leaves = [np.asarray(x, np.float32)
+                  for x in self.wire.treedef.flatten_up_to(grad_tree)]
+        if self.enabled and self._residual is not None:
+            leaves = [x + r for x, r in zip(leaves, self._residual)]
+        corrected = jax.tree.unflatten(self.wire.treedef, leaves)
+        payload = self.wire.encode_to_bytes(corrected)
+        if self.enabled:
+            sent = self.wire.treedef.flatten_up_to(
+                self.wire.decode_from_bytes(payload))
+            self._residual = [
+                c - np.asarray(t, np.float32)
+                for c, t in zip(leaves, sent)
+            ]
+            res_sq = sum(float(np.vdot(r, r)) for r in self._residual)
+            cor_sq = sum(float(np.vdot(c, c)) for c in leaves)
+            self.residual_norm = res_sq ** 0.5
+            self.last_rel_error = (res_sq ** 0.5) / max(cor_sq ** 0.5, 1e-30)
+        self.rounds += 1
+        return payload
+
+    def probe(self) -> dict:
+        """The hop's fidelity numbers for lineage hop rows / metrics."""
+        return {
+            "hop_ef": self.enabled,
+            "rounds": self.rounds,
+            "ef_residual_norm": round(self.residual_norm, 6),
+            "hop_rel_error": round(self.last_rel_error, 6),
+        }

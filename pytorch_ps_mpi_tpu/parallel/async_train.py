@@ -250,7 +250,14 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                            frame=frame)
 
     rec = _telemetry_from_cfg(cfg, worker=worker_id)
-    if cfg.get("resilient"):
+    if cfg.get("tree_leader"):
+        # aggregation-tree leaf: push to the group leader, fall back to
+        # the root when the leader dies, rejoin on its respawn — the
+        # tree's own failover IS the resilience layer here
+        from pytorch_ps_mpi_tpu.parallel.tree import TreeWorkerConn
+
+        w = TreeWorkerConn(worker_id, params0, cfg)
+    elif cfg.get("resilient"):
         from pytorch_ps_mpi_tpu.resilience.worker import ResilientWorker
 
         w = ResilientWorker(make_transport, worker_id=worker_id,
@@ -778,6 +785,19 @@ def serve(
     cadence = (_PSCheckpointCadence(ckpt, checkpoint_every, applied_before)
                if ckpt else None)
     n_workers = server.num_workers
+    # -- hierarchical-tree root mode (cfg["tree"], parallel.tree) ---------
+    # The expected pusher set is no longer range(n_workers): leaders
+    # (ids cfg["tree_members"]) push composed group aggregates, and leaf
+    # workers appear dynamically only when their leader died and they
+    # fell back to pushing directly. The sync barrier therefore runs
+    # over a MEMBERSHIP-DYNAMIC active set, and every round is averaged
+    # by the TOTAL composed worker-push count carried in the frames'
+    # lineage trailers (one per direct push), which keeps the weighting
+    # exact across degraded groups, ragged group sizes and fallback
+    # pushes without any coordination.
+    tree_mode = bool(cfg.get("tree"))
+    tree_members: set = set(int(w) for w in (cfg.get("tree_members") or ()))
+    tree_joined: set = set()
     # sync_barrier holds a FIFO per worker: the server pops mailboxes
     # eagerly (the single-slot mailbox never back-pressures a fast
     # worker), so a worker may deliver several gradients before a
@@ -892,21 +912,41 @@ def serve(
                 continue
             alive = server.connected(w) if can_connect else (w not in silent)
             if not alive:
+                if tree_mode and w not in tree_members and w in tree_joined:
+                    # a fallback leaf that closed its root socket went
+                    # BACK to its respawned leader — it leaves the
+                    # barrier's membership instead of being carried as
+                    # a dead worker (which would count every later
+                    # healthy round degraded); a fresh direct push
+                    # re-joins it
+                    tree_joined.discard(w)
+                    continue
                 dead_workers.add(w)
                 if rec is not None:
                     rec.event("serve.worker_declared_dead", worker=w)
 
-    def _try_complete_round() -> bool:
+    def _try_complete_round(only_queued: bool = False) -> bool:
         """Complete one sync round over the ACTIVE (not declared-dead)
         workers if each has a queued gradient; degraded rounds (fewer
         than n_workers contributions) are counted, never hung on.
         Numerics-quarantined workers under the ``skip`` policy are
         excluded too: their pushes never enter ``pending``, so waiting
         on them would hang the barrier exactly like a dead worker —
-        and unlike one, their socket stays open."""
+        and unlike one, their socket stays open. ``only_queued`` (tree
+        drain tail) completes a partial round over whatever is queued
+        so no consumed frame is silently dropped from the lineage."""
         nonlocal params, state, applied, degraded_rounds, wait_t0, round_t0
         nonlocal next_numerics_probe
-        active = [w for w in range(n_workers) if w not in dead_workers]
+        if tree_mode:
+            # membership-dynamic barrier: every tree member (leaders by
+            # construction, fallen-back leaf workers by observation)
+            # that is not declared dead must have a frame queued
+            active = [w for w in sorted(tree_members | tree_joined)
+                      if w not in dead_workers]
+            if only_queued:
+                active = [w for w in active if pending[w]]
+        else:
+            active = [w for w in range(n_workers) if w not in dead_workers]
         if numon is not None and numon.knobs["policy"] == "skip":
             active = [w for w in active if not numon.is_quarantined(w)]
         if not active or any(not pending[w] for w in active):
@@ -917,18 +957,29 @@ def serve(
             # active worker into the wire aggregator, then ONE decode
             # (never a [world, ...] decoded stack, never per-push
             # decodes) — the averaged result feeds the same jitted
-            # update the decode-sum path does
+            # update the decode-sum path does. The mean's denominator is
+            # the COMPOSED push count (frames carry group sums in tree
+            # mode; 1 per frame otherwise, so this is exactly the old
+            # 1/len(active))
             agg = wire.agg_begin()
+            total_comp = 0
             for w in active:
-                agg.fold(pending[w].popleft())
+                buf, comp_n = pending[w].popleft()
+                agg.fold(buf)
+                total_comp += comp_n
             server.decodes_done += 1
-            inv = np.float32(1.0 / len(active))
+            inv = np.float32(1.0 / total_comp)
             summed = jax.tree.map(lambda x: x * inv, agg.finalize())
             n_contrib = agg.frames
         else:
-            batch_grads = [pending[w].popleft() for w in active]
+            batch_grads = []
+            total_comp = 0
+            for w in active:
+                g, comp_n = pending[w].popleft()
+                batch_grads.append(g)
+                total_comp += comp_n
             summed = jax.tree.map(
-                lambda *gs: sum(gs) / len(gs), *batch_grads)
+                lambda *gs: sum(gs) / total_comp, *batch_grads)
             n_contrib = len(batch_grads)
         probe = numon is not None and applied >= next_numerics_probe
         old_params = params if probe else None
@@ -947,7 +998,9 @@ def serve(
             for w2 in range(n_workers):
                 if pending[w2]:
                     round_ready[w2] = up_t0
-        if n_contrib < n_workers:
+        degraded = (bool(dead_workers) if tree_mode
+                    else n_contrib < n_workers)
+        if degraded:
             degraded_rounds += 1
             c_degraded.inc()
             if rec is not None:
@@ -978,6 +1031,11 @@ def serve(
             time.sleep(0.0005)
             continue
         wid, grad_version, grad = item
+        # tree mode: the frame's composed worker-push count (from its
+        # lineage trailer), queued by the framed consume path in item
+        # order — the round mean's per-frame weight; 1 otherwise
+        comp_n = (server._composed_queue.popleft()
+                  if tree_mode and getattr(server, "tree_slots", 0) else 1)
         if agg_armed:
             # payload-level non-finite screen (the aggregation path's
             # stand-in for the numerics monitor's decoded-tree check,
@@ -1037,7 +1095,9 @@ def serve(
             # consumed. A gradient from a declared-dead worker proves it
             # back alive (elastic replacement) — it rejoins the barrier.
             dead_workers.discard(wid)
-            pending[wid].append(grad)
+            if tree_mode:
+                tree_joined.add(wid)
+            pending[wid].append((grad, comp_n))
             if monitor is not None and wid not in round_ready:
                 round_ready[wid] = time.perf_counter()
             if not _try_complete_round():
@@ -1046,6 +1106,10 @@ def serve(
             up_t0 = time.perf_counter()
             probe = numon is not None and applied >= next_numerics_probe
             old_params = params if probe else None
+            if comp_n > 1:
+                # a composed frame carries its group's SUM: apply the
+                # group mean so the async step size is load-independent
+                grad = jax.tree.map(lambda x: x / comp_n, grad)
             params, state = update(params, grad, state)
             applied += 1
             if probe:
@@ -1056,6 +1120,12 @@ def serve(
                 next_numerics_probe = applied + numerics_probe_every
             _post_update(up_t0)
             wait_t0 = time.perf_counter()
+    if tree_mode and sync_barrier:
+        # drain tail: frames consumed but still queued when the stop
+        # condition fired compose one final partial round each, so
+        # every consumed push lands in some version's lineage
+        while _try_complete_round(only_queued=True):
+            pass
     wall = time.perf_counter() - t0
     if cadence:  # final state always captured, whatever the stop reason
         cadence.final_save(params, state, server, applied_before + applied)

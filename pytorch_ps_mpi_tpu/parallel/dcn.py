@@ -418,7 +418,7 @@ class ShmPSServer(PSServerTelemetry):
 
     def __init__(self, name: str, num_workers: int, template: PyTree,
                  max_staleness: int = 4, code=None, bucket_mb: float = 0.0,
-                 frame: bool = False):
+                 frame: bool = False, tree_slots: int = 0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -435,6 +435,22 @@ class ShmPSServer(PSServerTelemetry):
         )
         nbytes = _flat_size(template) * 4
         payload_bytes = self.wire.wire_bytes if self.wire else nbytes
+        # tree_slots > 0: aggregation-tree parent — every push carries a
+        # fixed-size composed-lineage trailer (parallel.tree; needs
+        # frames, the trailer rides inside the CRC'd frame payload)
+        self.tree_slots = int(tree_slots)
+        self.tree_composed = 0
+        self._wire_payload_bytes = payload_bytes
+        if self.tree_slots:
+            if not frame:
+                raise ValueError("tree_slots requires frame=True (the "
+                                 "lineage trailer rides the framed wire)")
+            import collections as _collections
+
+            from pytorch_ps_mpi_tpu.resilience import frames as _fr
+
+            payload_bytes += _fr.trailer_bytes(self.tree_slots)
+            self._composed_queue = _collections.deque()
         self._expected_payload = payload_bytes
         # frame=True: every push carries a self-verifying header (magic +
         # CRC32 + config fingerprint, resilience.frames) and a bad frame
@@ -446,7 +462,8 @@ class ShmPSServer(PSServerTelemetry):
             from pytorch_ps_mpi_tpu.resilience import frames as _frames
 
             self._frames = _frames
-            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            self._fingerprint = _frames.wire_fingerprint(
+                self.wire, template, tree_slots=self.tree_slots)
             grad_slot = payload_bytes + _frames.HEADER_BYTES
         else:
             grad_slot = payload_bytes
@@ -648,7 +665,7 @@ class ShmPSWorker:
     def __init__(self, name: str, worker_id: int, template: PyTree,
                  timeout: float = 30.0, code=None, seed: int = 0,
                  bucket_mb: float = 0.0, frame: bool = False,
-                 cached_reads: bool = False):
+                 cached_reads: bool = False, tree_slots: int = 0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native psqueue unavailable (no g++?)")
@@ -679,15 +696,22 @@ class ShmPSWorker:
         # monotonic push sequence for the frame trace ID — the fallback
         # when the caller doesn't pass an explicit lineage=(step, seq)
         self._auto_seq = 0
+        # tree_slots > 0: pushes to an aggregation-tree parent carry a
+        # fixed-capacity composed-lineage trailer (default: self)
+        self.tree_slots = int(tree_slots)
+        if self.tree_slots and not self.frame:
+            raise ValueError("tree_slots requires frame=True")
         if self.frame:
             from pytorch_ps_mpi_tpu.resilience import frames as _frames
 
             self._frames = _frames
-            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            self._fingerprint = _frames.wire_fingerprint(
+                self.wire, template, tree_slots=self.tree_slots)
             payload_bytes = (self.wire.wire_bytes if self.wire
                              else _flat_size(template) * 4)
             self._frame_buf = np.empty(
-                _frames.HEADER_BYTES + payload_bytes, np.uint8
+                _frames.HEADER_BYTES + payload_bytes
+                + _frames.trailer_bytes(self.tree_slots), np.uint8
             )
         self._param_buf = np.empty(_flat_size(template), np.float32)
         # version-conditional read cache (OPT-IN here, unlike TCP where
@@ -746,11 +770,14 @@ class ShmPSWorker:
 
     def push_grad(self, grad: PyTree, version: int,
                   timeout: float = 30.0,
-                  lineage: Optional[Tuple[int, int]] = None) -> None:
+                  lineage: Optional[Tuple[int, int]] = None,
+                  composed=None) -> None:
         """``lineage=(step, seq)`` stamps the push's trace ID into the
         v2 frame header (worker id travels in the transport); without it
         a per-transport auto-incrementing seq is used. Ignored on the
-        unframed wire — there is nowhere to carry it."""
+        unframed wire — there is nowhere to carry it. On a tree wire,
+        ``composed`` lists the constituent trace IDs for the lineage
+        trailer (default: this worker itself)."""
         if self.wire:
             # encode-before-send (reference ps.py:94): only payload bytes
             # ever enter the mailbox. encode_to_bytes hands back its
@@ -759,12 +786,26 @@ class ShmPSWorker:
             flat = self.wire.encode_to_bytes(grad)
         else:
             flat = _flatten(grad)
+        self.push_payload(flat, version, timeout=timeout, lineage=lineage,
+                          composed=composed)
+
+    def push_payload(self, flat: np.ndarray, version: int,
+                     timeout: float = 30.0,
+                     lineage: Optional[Tuple[int, int]] = None,
+                     composed=None) -> None:
+        """Push pre-encoded payload bytes — the tree leader's hop path
+        (it encodes explicitly so error feedback can decode the exact
+        payload that shipped)."""
         if self.frame:
             step, seq = lineage if lineage is not None else (0, self._auto_seq)
             self._auto_seq += 1
+            if self.tree_slots and composed is None:
+                composed = [(self.worker_id, step, seq, time.time())]
             flat = self._frames.seal_frame(self._frame_buf, flat,
                                            self._fingerprint,
-                                           step=step, seq=seq)
+                                           step=step, seq=seq,
+                                           composed=composed,
+                                           tree_slots=self.tree_slots)
         if self._tamper is not None:
             # fault injection: corrupt the outgoing bytes AFTER sealing,
             # so the CRC no longer matches what travels

@@ -115,9 +115,18 @@ def server_main(shard_id: int, n_shards: int, port: int,
     if isinstance(cfg.get("server_slow_ms"), dict):
         slow_ms = float(cfg["server_slow_ms"].get(str(shard_id), 0.0))
 
-    server = TcpPSServer(port, num_workers=n_workers, template=template,
+    # hierarchical-tree composition (cfg["tree"], parallel.tree): the
+    # shard's pushers are group LEADERS (ids past n_workers) shipping
+    # composed group sums with lineage trailers — path-sharding stacks
+    # on key-sharding. Stop/accounting switch from frames to the exact
+    # composed worker-push count the trailers carry.
+    tree_mode = bool(cfg.get("tree"))
+    tree_slots = int(cfg.get("tree_slots", 0) or 0) if tree_mode else 0
+    id_space = n_workers + len(cfg.get("tree_members") or ())
+    server = TcpPSServer(port, num_workers=id_space, template=template,
                          max_staleness=int(cfg.get("max_staleness", 4)),
-                         code=code, frame=bool(cfg.get("frame_check")))
+                         code=code, frame=bool(cfg.get("frame_check")),
+                         tree_slots=tree_slots)
 
     # per-shard online diagnosis: each shard server gets its own
     # HealthMonitor and /metrics + /health endpoint (port auto-assigned
@@ -230,7 +239,14 @@ def server_main(shard_id: int, n_shards: int, port: int,
         # remaining steps exit via the bounded server_timeout, not a hang.
         deadline = time.time() + float(cfg.get("server_timeout", 300.0))
         next_tick = 0.0
-        while server.grads_received < expected and time.time() < deadline:
+
+        def _consumed() -> int:
+            # tree mode counts composed worker pushes (the trailers'
+            # exact accounting); star mode counts frames
+            return (server.tree_composed if tree_mode
+                    else server.grads_received)
+
+        while _consumed() < expected and time.time() < deadline:
             now = time.monotonic()
             if now >= next_tick:
                 next_tick = now + float(cfg.get("tick_interval", 0.2))
@@ -246,6 +262,13 @@ def server_main(shard_id: int, n_shards: int, port: int,
             if monitor is not None:
                 monitor.observe_grad(wid, max(0, server.version - ver))
             up_t0 = time.perf_counter()
+            if tree_slots:
+                comp_n = (server._composed_queue.popleft()
+                          if server._composed_queue else 1)
+                if comp_n > 1:
+                    # a leader frame carries its group's SUM — apply the
+                    # group mean (same rule as the tree root's loop)
+                    grad = jax.tree.map(lambda x: x / comp_n, grad)
             params, state = update(params, grad, state)
             applied += 1
             if slow_ms:

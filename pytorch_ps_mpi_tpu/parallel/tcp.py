@@ -220,7 +220,7 @@ class TcpPSServer(PSServerTelemetry):
 
     def __init__(self, port: int, num_workers: int, template: PyTree,
                  max_staleness: int = 4, code=None, bucket_mb: float = 0.0,
-                 frame: bool = False):
+                 frame: bool = False, tree_slots: int = 0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native tcpps unavailable (no g++?)")
@@ -236,6 +236,23 @@ class TcpPSServer(PSServerTelemetry):
         )
         nbytes = _flat_size(template) * 4
         payload_bytes = self.wire.wire_bytes if self.wire else nbytes
+        # tree_slots > 0: this server is an aggregation-tree parent —
+        # every push's payload additionally carries a fixed-size
+        # hop-composed lineage trailer (parallel.tree; requires frames,
+        # the trailer rides inside the CRC'd frame payload)
+        self.tree_slots = int(tree_slots)
+        self.tree_composed = 0
+        self._wire_payload_bytes = payload_bytes
+        if self.tree_slots:
+            if not frame:
+                raise ValueError("tree_slots requires frame=True (the "
+                                 "lineage trailer rides the framed wire)")
+            import collections as _collections
+
+            from pytorch_ps_mpi_tpu.resilience import frames as _fr
+
+            payload_bytes += _fr.trailer_bytes(self.tree_slots)
+            self._composed_queue = _collections.deque()
         self._expected_payload = payload_bytes
         # frame=True: self-verifying headers on every push (magic + CRC32
         # + config fingerprint, resilience.frames); a bad frame — size
@@ -247,7 +264,8 @@ class TcpPSServer(PSServerTelemetry):
             from pytorch_ps_mpi_tpu.resilience import frames as _frames
 
             self._frames = _frames
-            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            self._fingerprint = _frames.wire_fingerprint(
+                self.wire, template, tree_slots=self.tree_slots)
             grad_bytes = payload_bytes + _frames.HEADER_BYTES
         else:
             grad_bytes = payload_bytes
@@ -552,7 +570,7 @@ class TcpPSWorker:
     def __init__(self, host: str, port: int, worker_id: int, template: PyTree,
                  timeout: float = 30.0, code=None, seed: int = 0,
                  bucket_mb: float = 0.0, frame: bool = False,
-                 cached_reads: bool = True):
+                 cached_reads: bool = True, tree_slots: int = 0):
         lib = get_lib()
         if lib is None:
             raise RuntimeError("native tcpps unavailable (no g++?)")
@@ -586,15 +604,23 @@ class TcpPSWorker:
         # monotonic push sequence for the frame trace ID — the fallback
         # when the caller doesn't pass an explicit lineage=(step, seq)
         self._auto_seq = 0
+        # tree_slots > 0: pushes to an aggregation-tree parent — every
+        # frame carries a fixed-capacity composed-lineage trailer (a
+        # leaf pushing directly composes only itself)
+        self.tree_slots = int(tree_slots)
+        if self.tree_slots and not self.frame:
+            raise ValueError("tree_slots requires frame=True")
         if self.frame:
             from pytorch_ps_mpi_tpu.resilience import frames as _frames
 
             self._frames = _frames
-            self._fingerprint = _frames.wire_fingerprint(self.wire, template)
+            self._fingerprint = _frames.wire_fingerprint(
+                self.wire, template, tree_slots=self.tree_slots)
             payload_bytes = (self.wire.wire_bytes if self.wire
                              else _flat_size(template) * 4)
             self._frame_buf = np.empty(
-                _frames.HEADER_BYTES + payload_bytes, np.uint8
+                _frames.HEADER_BYTES + payload_bytes
+                + _frames.trailer_bytes(self.tree_slots), np.uint8
             )
         self._param_buf = np.empty(_flat_size(template), np.float32)
         # version-conditional read cache: the request carries "I have v"
@@ -651,9 +677,14 @@ class TcpPSWorker:
 
     def push_grad(self, grad: PyTree, version: int,
                   timeout: float = 30.0,
-                  lineage: Optional[Tuple[int, int]] = None) -> None:
+                  lineage: Optional[Tuple[int, int]] = None,
+                  composed=None) -> None:
         """``lineage=(step, seq)`` stamps the push's trace ID into the
-        v2 frame header — same contract as ``ShmPSWorker.push_grad``."""
+        v2 frame header — same contract as ``ShmPSWorker.push_grad``.
+        On a tree wire (``tree_slots > 0``), ``composed`` lists the
+        constituent ``(worker, step, seq, send_wall)`` trace IDs for the
+        lineage trailer; default is this worker's own trace ID (the
+        direct-push / fallback case)."""
         if self.wire:
             # encode_to_bytes returns its preallocated ping-pong wire
             # buffer (one contiguous bucket payload per push) — the native
@@ -661,12 +692,27 @@ class TcpPSWorker:
             flat = self.wire.encode_to_bytes(grad)
         else:
             flat = _flatten(grad)
+        self.push_payload(flat, version, timeout=timeout, lineage=lineage,
+                          composed=composed)
+
+    def push_payload(self, flat: np.ndarray, version: int,
+                     timeout: float = 30.0,
+                     lineage: Optional[Tuple[int, int]] = None,
+                     composed=None) -> None:
+        """Push pre-encoded payload bytes (exactly ``wire.wire_bytes``,
+        or the flat f32 vector on a codec-less wire). The tree leader's
+        hop path: it encodes explicitly (error feedback needs the
+        payload AND its decode), then ships the bytes here."""
         if self.frame:
             step, seq = lineage if lineage is not None else (0, self._auto_seq)
             self._auto_seq += 1
+            if self.tree_slots and composed is None:
+                composed = [(self.worker_id, step, seq, time.time())]
             flat = self._frames.seal_frame(self._frame_buf, flat,
                                            self._fingerprint,
-                                           step=step, seq=seq)
+                                           step=step, seq=seq,
+                                           composed=composed,
+                                           tree_slots=self.tree_slots)
         if self._tamper is not None:
             # fault injection: corrupt the outgoing bytes AFTER sealing,
             # so the CRC no longer matches what travels

@@ -37,6 +37,24 @@ async fleet):
   from, and the (send_wall, recv_wall) pair per frame is what the
   cross-process clock-skew fit consumes.
 
+**Hop-composed lineage (the aggregation-tree extension).** A frame
+pushed by a tree LEADER composes many worker pushes into one payload
+(``parallel.tree``): the constituent trace IDs ride a fixed-size
+**lineage trailer** appended after the codec payload, INSIDE the
+CRC'd/length-checked frame payload region — the frame format itself
+stays PSF2 and the native validators (size, fingerprint, CRC) cover the
+trailer for free. A server constructed with ``tree_slots=K`` expects
+every push's payload to be ``wire_bytes + trailer_bytes(K)`` long
+(``K`` = the largest group's size; the slot count joins the wire
+fingerprint, so slot drift is a ``"config"`` rejection, not a silent
+mis-split); a leaf worker pushing DIRECTLY to such a server (leader-
+crash fallback) appends a trailer composing only itself. The trailer is
+``magic u32 | count u32`` followed by ``K`` fixed slots of
+``worker u32 | step u32 | seq u32 | send_wall f64`` (unused slots
+zeroed), so the expected payload size never varies with the round's
+degraded/fallback shape. A validated frame whose trailer magic or
+count is wrong is rejected with the explicit reason ``"trailer"``.
+
 A failed check is a **counted, per-worker rejection**
 (``PSServerTelemetry._reject_frame`` → ``ps_frames_rejected_total``),
 never a server crash: one misconfigured worker cannot take down the PS
@@ -81,6 +99,77 @@ HEADER_BYTES_V1 = 20
 _LINEAGE = struct.Struct("<IId")
 _LINEAGE_OFF = 20
 
+#: lineage-trailer magic ("PSTL" little-endian) — marks the hop-composed
+#: trace-ID block appended after the codec payload on tree wires
+TRAILER_MAGIC = 0x4C545350
+_TRAILER_HEAD = struct.Struct("<II")          # magic, count
+_TRAILER_ENTRY = struct.Struct("<IIId")       # worker, step, seq, send_wall
+TRAILER_ENTRY_BYTES = _TRAILER_ENTRY.size
+assert TRAILER_ENTRY_BYTES == 20
+
+
+def trailer_bytes(slots: int) -> int:
+    """On-wire size of a ``slots``-capacity lineage trailer (0 → 0)."""
+    slots = int(slots)
+    return 0 if slots <= 0 else _TRAILER_HEAD.size + slots * TRAILER_ENTRY_BYTES
+
+
+def pack_trailer(out: np.ndarray, off: int, entries, slots: int) -> int:
+    """Write a lineage trailer into ``out`` at ``off`` and return the
+    bytes written. ``entries`` is a sequence of ``(worker, step, seq,
+    send_wall)`` tuples or dicts with those keys; at most ``slots`` are
+    kept (oldest first — a degraded fold can never overflow its declared
+    capacity, the excess is truncated loudly by the caller's own
+    accounting). Unused slots are zeroed so the frame bytes are
+    deterministic."""
+    slots = int(slots)
+    norm = []
+    for e in entries or ():
+        if isinstance(e, dict):
+            norm.append((int(e["worker"]), int(e.get("step", 0)),
+                         int(e.get("seq", 0)),
+                         float(e.get("send_wall", 0.0))))
+        else:
+            w, s, q, t = e
+            norm.append((int(w), int(s), int(q), float(t)))
+    norm = norm[:slots]
+    _TRAILER_HEAD.pack_into(out, off, TRAILER_MAGIC, len(norm))
+    pos = off + _TRAILER_HEAD.size
+    for w, s, q, t in norm:
+        _TRAILER_ENTRY.pack_into(out, pos, w & 0xFFFFFFFF, s & 0xFFFFFFFF,
+                                 q & 0xFFFFFFFF, t)
+        pos += TRAILER_ENTRY_BYTES
+    end = off + trailer_bytes(slots)
+    out[pos:end] = 0
+    return end - off
+
+
+def read_composed(payload: np.ndarray, wire_bytes: int,
+                  slots: int) -> Optional[list]:
+    """Parse the lineage trailer of a VALIDATED tree-wire frame payload
+    (``payload`` = codec payload + trailer). Returns the composed
+    ``[{worker, step, seq, send_wall}, ...]`` list, or None when the
+    trailer is malformed (wrong magic, impossible count) — callers
+    reject the frame with reason ``"trailer"``."""
+    slots = int(slots)
+    need = wire_bytes + trailer_bytes(slots)
+    if payload.nbytes != need:
+        return None
+    magic, count = _TRAILER_HEAD.unpack_from(payload, wire_bytes)
+    # count == 0 is rejected too: a composed frame that composes
+    # NOTHING would drive the root round's weighting denominator to
+    # zero — it is malformed, not merely empty
+    if magic != TRAILER_MAGIC or count > slots or count == 0:
+        return None
+    out = []
+    pos = wire_bytes + _TRAILER_HEAD.size
+    for _ in range(count):
+        w, s, q, t = _TRAILER_ENTRY.unpack_from(payload, pos)
+        out.append({"worker": int(w), "step": int(s), "seq": int(q),
+                    "send_wall": float(t)})
+        pos += TRAILER_ENTRY_BYTES
+    return out
+
 
 def _codec_desc(code) -> dict:
     """Canonical JSON-able description of a codec's configuration: class
@@ -99,13 +188,17 @@ def _codec_desc(code) -> dict:
     return {"cls": type(code).__name__, "kw": kw}
 
 
-def wire_fingerprint(wire, template: PyTree) -> int:
+def wire_fingerprint(wire, template: PyTree, tree_slots: int = 0) -> int:
     """64-bit fingerprint of the wire agreement. ``wire`` is a
     ``CodecWire`` (or None for the raw-f32 wire); ``template`` the
     parameter pytree. Both ends compute this from their OWN config — a
     matching fingerprint means codec name/kw, bucket layout, payload
     specs, and tree structure all agree. Per-worker codec seeds do not
-    enter (they legitimately differ across the fleet)."""
+    enter (they legitimately differ across the fleet). ``tree_slots``
+    (the lineage-trailer capacity of a tree wire) joins the agreement
+    when nonzero — slot drift is then a ``"config"`` rejection, never a
+    mis-split — and is omitted at 0 so pre-tree fingerprints are
+    unchanged."""
     import jax
 
     if wire is None:
@@ -126,6 +219,8 @@ def wire_fingerprint(wire, template: PyTree) -> int:
                       for s, d in wire._flat_specs],
             "treedef": str(wire.treedef),
         }
+    if int(tree_slots) > 0:
+        desc["tree_slots"] = int(tree_slots)
     blob = json.dumps(desc, sort_keys=True).encode()
     return int.from_bytes(
         hashlib.blake2b(blob, digest_size=8).digest(), "little"
@@ -134,24 +229,36 @@ def wire_fingerprint(wire, template: PyTree) -> int:
 
 def seal_frame(out: np.ndarray, payload: np.ndarray, fingerprint: int,
                step: int = 0, seq: int = 0,
-               send_wall: Optional[float] = None) -> np.ndarray:
+               send_wall: Optional[float] = None,
+               composed=None, tree_slots: int = 0) -> np.ndarray:
     """Write header + payload into the preallocated uint8 buffer ``out``
-    (sized ``HEADER_BYTES + payload.nbytes`` by the caller) and return
-    the exact-length view. ``step``/``seq`` are the push's trace-ID
-    fields (the transport carries the worker id); ``send_wall`` defaults
-    to now — THE encode-site timestamp lineage e2e latency and clock-
-    skew estimation are measured from. One extra memcpy per push versus
-    the unframed wire — the price of the end-to-end check."""
+    (sized ``HEADER_BYTES + payload.nbytes`` — plus
+    ``trailer_bytes(tree_slots)`` on a tree wire — by the caller) and
+    return the exact-length view. ``step``/``seq`` are the push's
+    trace-ID fields (the transport carries the worker id); ``send_wall``
+    defaults to now — THE encode-site timestamp lineage e2e latency and
+    clock-skew estimation are measured from. With ``tree_slots > 0`` a
+    hop-composed lineage trailer (``composed`` entries — defaulting to
+    nothing, which a leaf caller should never want; transports default
+    it to the pushing worker itself) is appended after the payload, and
+    the header's length + CRC cover payload AND trailer, so the native
+    validators check the trailer for free. One extra memcpy per push
+    versus the unframed wire — the price of the end-to-end check."""
     if payload.dtype != np.uint8:
         payload = payload.view(np.uint8)
     payload = payload.reshape(-1)
     n = payload.nbytes
-    _HEADER.pack_into(out, 0, FRAME_MAGIC, n,
-                      zlib.crc32(payload) & 0xFFFFFFFF, fingerprint,
+    out[HEADER_BYTES:HEADER_BYTES + n] = payload
+    total = n
+    if int(tree_slots) > 0:
+        total += pack_trailer(out, HEADER_BYTES + n, composed or (),
+                              tree_slots)
+    body = out[HEADER_BYTES:HEADER_BYTES + total]
+    _HEADER.pack_into(out, 0, FRAME_MAGIC, total,
+                      zlib.crc32(body) & 0xFFFFFFFF, fingerprint,
                       int(step) & 0xFFFFFFFF, int(seq) & 0xFFFFFFFF,
                       time.time() if send_wall is None else float(send_wall))
-    out[HEADER_BYTES:HEADER_BYTES + n] = payload
-    return out[:HEADER_BYTES + n]
+    return out[:HEADER_BYTES + total]
 
 
 def open_frame(
@@ -202,6 +309,26 @@ BATCH_REASONS = {1: "short", 2: "version", 3: "magic", 4: "size",
                  5: "config", 6: "corrupt"}
 
 
+def _split_composed(server, wid: int, payload: np.ndarray):
+    """Tree-wire post-validation step shared by both consume paths:
+    split a validated frame payload into (codec payload, composed
+    lineage entries). On a non-tree server this is the identity.
+    Returns ``(wire_payload, composed, ok)``; a malformed trailer is a
+    counted ``"trailer"`` rejection (``ok=False``). Every valid frame's
+    composed count — stale-dropped ones included — advances
+    ``server.tree_composed``, the canonical exact-accounting counter
+    tree drivers stop on."""
+    slots = int(getattr(server, "tree_slots", 0) or 0)
+    if not slots:
+        return payload, None, True
+    entries = read_composed(payload, server._wire_payload_bytes, slots)
+    if entries is None:
+        server._reject_frame(wid, "trailer")
+        return None, None, False
+    server.tree_composed += len(entries)
+    return payload[:server._wire_payload_bytes], entries, True
+
+
 def framed_batch_consume(server, frames_iter, raw: bool = False) -> list:
     """The batched twin of :func:`framed_poll` for transports whose
     native side already validated the frames (``tps_server_pop_grad_batch``
@@ -214,10 +341,15 @@ def framed_batch_consume(server, frames_iter, raw: bool = False) -> list:
     ``frames_iter`` yields ``(worker, version, status, payload_view,
     step, seq, send_wall)``; ``status`` 0 means validated. Returns the
     consumed ``(worker, version, grad_or_payload)`` list (stale drops
-    and rejections are counted, not returned). Payload views alias the
-    transport's batch buffer — valid until the next batched pop."""
+    and rejections are counted, not returned); the consumed items'
+    metas land on ``server.last_batch_metas`` in the same order (a
+    batch overwrites ``last_push_meta`` per item, so consumers that
+    need EVERY item's trace ID — the tree leader — read the aligned
+    list instead). Payload views alias the transport's batch buffer —
+    valid until the next batched pop."""
     lt = getattr(server, "lineage_tracker", None)
     out = []
+    metas = []
     for wid, version, status, payload, lstep, lseq, send_wall in frames_iter:
         # any frame — valid or not — proves the worker is alive
         server.last_seen[wid] = time.time()
@@ -225,18 +357,24 @@ def framed_batch_consume(server, frames_iter, raw: bool = False) -> list:
             server._reject_frame(wid, BATCH_REASONS.get(status, "magic"))
             continue
         recv_wall = time.time()
+        full_bytes = payload.nbytes
+        payload, composed, ok = _split_composed(server, wid, payload)
+        if not ok:
+            continue
         staleness = max(0, server.version - version)
         server.staleness_seen[staleness] = (
             server.staleness_seen.get(staleness, 0) + 1
         )
         server.grads_received += 1
-        server.bytes_received += payload.nbytes
+        server.bytes_received += full_bytes
         meta = {
             "worker": int(wid), "step": lstep, "seq": lseq,
             "version_read": int(version), "staleness": int(staleness),
-            "bytes": int(payload.nbytes),
+            "bytes": int(full_bytes),
             "send_wall": send_wall, "recv_wall": recv_wall,
         }
+        if composed is not None:
+            meta["composed"] = composed
         if staleness <= server.max_staleness:
             t_dec = time.monotonic()
             if raw:
@@ -252,12 +390,18 @@ def framed_batch_consume(server, frames_iter, raw: bool = False) -> list:
                          staleness=int(staleness))
             if lt is not None:
                 lt.observe_consume(meta)
+            if composed is not None:
+                # the serve loop pops one count per consumed item — the
+                # composed-weighted averaging denominator (tree mode)
+                server._composed_queue.append(len(composed))
             out.append((int(wid), int(version), grad))
+            metas.append(meta)
         else:
             server.stale_drops += 1
             if lt is not None:
                 meta["stale_drop"] = True
                 lt.observe_consume(meta)
+    server.last_batch_metas = metas
     return out
 
 
@@ -304,18 +448,24 @@ def framed_poll(
             continue
         recv_wall = time.time()
         lstep, lseq, send_wall = read_lineage(server._grad_buf)
+        full_bytes = payload.nbytes
+        payload, composed, ok = _split_composed(server, wid, payload)
+        if not ok:
+            continue
         staleness = max(0, server.version - version)
         server.staleness_seen[staleness] = (
             server.staleness_seen.get(staleness, 0) + 1
         )
         server.grads_received += 1
-        server.bytes_received += payload.nbytes
+        server.bytes_received += full_bytes
         meta = {
             "worker": int(wid), "step": lstep, "seq": lseq,
             "version_read": int(version), "staleness": int(staleness),
-            "bytes": int(payload.nbytes),
+            "bytes": int(full_bytes),
             "send_wall": send_wall, "recv_wall": recv_wall,
         }
+        if composed is not None:
+            meta["composed"] = composed
         if staleness <= server.max_staleness:
             t_dec = time.monotonic()
             if raw:
@@ -334,6 +484,8 @@ def framed_poll(
                          staleness=int(staleness))
             if lt is not None:
                 lt.observe_consume(meta)
+            if composed is not None:
+                server._composed_queue.append(len(composed))
             return wid, version, grad
         server.stale_drops += 1
         if lt is not None:

@@ -55,6 +55,7 @@ _SUM_KEYS: Dict[str, str] = {
     "reads_total": "ps_reads_total",
     "reads_shed": "ps_reads_shed_total",
     "slo_breaches": "ps_slo_breaches_all_total",
+    "tree_composed": "ps_tree_composed_total",
 }
 
 #: gauges rolled up as the fleet max (worst member)
@@ -203,6 +204,10 @@ class FleetMonitor:
             "error": None, "ts": None, "uptime_s": None, "age_s": None,
             "verdict": None, "metrics": {}, "labeled": [],
         }
+        if member.get("group") is not None:
+            # aggregation-tree cards carry their group id + leaf members
+            row["group"] = member["group"]
+            row["members"] = member.get("members")
         text = self._fetch(url, "/metrics")
         if text is None:
             row["error"] = "unreachable"
@@ -333,6 +338,32 @@ class FleetMonitor:
                 f"{m['name']}:{r}" for m in ok
                 for r in (m.get("slo") or {}).get("burning", [])}),
         }
+        # per-group rollups: members whose registration card carries a
+        # group id (aggregation-tree leaders) roll up side by side, so
+        # one pane answers "which pod is behind" without PromQL
+        groups: Dict[str, Any] = {}
+        for m in members:
+            g = m.get("group")
+            if g is None:
+                continue
+            row = groups.setdefault(str(g), {
+                "n_members": 0, "n_ok": 0, "grads_received": 0.0,
+                "tree_composed": 0.0, "leaves": [], "worst_verdict": None,
+            })
+            row["n_members"] += 1
+            row["leaves"] = sorted(set(row["leaves"])
+                                   | set(m.get("members") or []))
+            if not m["ok"]:
+                continue
+            row["n_ok"] += 1
+            row["grads_received"] += m["metrics"].get("grads_received", 0.0)
+            row["tree_composed"] += m["metrics"].get("tree_composed", 0.0)
+            v = m.get("verdict")
+            if v is not None and (
+                    row["worst_verdict"] is None
+                    or _VERDICT_RANK.get(v, 0)
+                    > _VERDICT_RANK.get(row["worst_verdict"], 0)):
+                row["worst_verdict"] = v
         # merged per-worker labeled series, member-tagged so one pane
         # shows e.g. every shard's rejection counters side by side
         labeled = [{"member": m["name"], **s}
@@ -345,6 +376,7 @@ class FleetMonitor:
             "members": {m["name"]: m for m in members},
             "fleet": fleet,
             "skew": skew,
+            "groups": groups,
             "slo": slo,
             "labeled": labeled,
         }

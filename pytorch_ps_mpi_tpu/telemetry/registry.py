@@ -348,6 +348,11 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "agg_mode",
     "decodes_per_publish",
     "agg_fallbacks",
+    # hierarchical aggregation (parallel.tree): worker pushes composed
+    # through lineage trailers on every VALID tree-wire frame this
+    # server validated (stale-dropped frames included — tree drivers
+    # stop on this exact count); 0.0 on a non-tree server
+    "tree_composed",
     # parameter-serving read tier (serving.ServingCore): all 0.0 when the
     # read tier is unarmed. reads_total counts read-tier requests served
     # (plus, on TCP, the transport's own native GET_PARAMS worker reads);
@@ -466,6 +471,7 @@ def ps_server_metrics(server) -> Dict[str, float]:
             / max(1.0, float(getattr(server, "grad_publishes", 0)))
             if getattr(server, "grad_publishes", 0) else 0.0),
         "agg_fallbacks": float(getattr(server, "agg_fallbacks", 0)),
+        "tree_composed": float(getattr(server, "tree_composed", 0)),
         "lineage_pushes": float(lt.composed if lt is not None else 0.0),
         "push_e2e_p50_ms": float(
             lt.e2e_ms_quantile(0.50) if lt is not None else 0.0),
@@ -547,6 +553,10 @@ def ps_server_registry(
         r.counter("ps_agg_fallbacks_total",
                   "pushes consumed via decode-sum while aggregation was "
                   "explicitly requested").set(m["agg_fallbacks"])
+        r.counter("ps_tree_composed_total",
+                  "worker pushes composed through hierarchical-tree "
+                  "lineage trailers on valid frames").set(
+                      m["tree_composed"])
         nat_total, nat_nm = getattr(server, "_native_read_stats", (0, 0))
         r.counter("ps_native_reads_total",
                   "transport-level worker snapshot reads (GET_PARAMS)"
@@ -809,7 +819,10 @@ class PSServerTelemetry:
                 fname = str(cfg.get("fleet_name") or name)
                 _fleet.register_endpoint(
                     cfg["fleet_dir"], fname, http.port,
-                    role=cfg.get("fleet_role", "server"))
+                    role=cfg.get("fleet_role", "server"),
+                    # extra card fields (e.g. a tree leader's group id +
+                    # member worker ids) ride the registration verbatim
+                    **(cfg.get("fleet_meta") or {}))
                 self.__dict__["_fleet_registration"] = (
                     cfg["fleet_dir"], fname)
 

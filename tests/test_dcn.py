@@ -33,12 +33,19 @@ def _worker_loop(name, worker_id, n_pushes):
         w.close()
 
 
-def _serve(server, total_grads, lr=0.2, timeout=30.0):
+def _serve(server, total_grads, lr=0.2, timeout=30.0, hard_timeout=300.0):
+    """``timeout`` is an IDLE timeout, refreshed on every consumed
+    gradient (worker startup under full-suite contention can eat tens
+    of seconds before the first delivery — a fixed overall deadline
+    made this loop load-flaky, ISSUE 13's burn-down); ``hard_timeout``
+    bounds the whole call regardless of progress."""
     params = {"w": TEMPLATE["w"].copy()}
     server.publish(params)
     got = 0
+    hard_deadline = time.time() + hard_timeout
     deadline = time.time() + timeout
-    while got < total_grads and time.time() < deadline:
+    while (got < total_grads and time.time() < deadline
+           and time.time() < hard_deadline):
         item = server.poll_grad()
         if item is None:
             time.sleep(0.001)
@@ -47,6 +54,7 @@ def _serve(server, total_grads, lr=0.2, timeout=30.0):
         params = {"w": params["w"] - lr * grad["w"]}
         server.publish(params)
         got += 1
+        deadline = time.time() + timeout
     return params, got
 
 

@@ -251,23 +251,35 @@ def test_net_shed_then_retry_and_error_tenant():
     readers = [ServingReader("127.0.0.1", core.read_port, TMPL,
                              serving_kw=cfg["serving_kw"])
                for _ in range(n)]
-    barrier = threading.Barrier(n)
     errs = []
 
-    def body(r):
-        try:
-            barrier.wait()
-            r.read_params()
-        except Exception as e:  # pragma: no cover
-            errs.append(repr(e))
+    def burst():
+        barrier = threading.Barrier(n)
 
-    ts = [threading.Thread(target=body, args=(r,)) for r in readers]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=30)
-    assert not errs
-    assert core.reads_shed > 0  # depth 1 under a 16-wide burst
+        def body(r):
+            try:
+                barrier.wait()
+                r.read_params()
+            except Exception as e:  # pragma: no cover
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=body, args=(r,)) for r in readers]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+
+    # whether one 16-wide burst actually OVERLAPS a depth-1 queue is a
+    # scheduler roll on a 2-core box (the tiny encode drains in the gap
+    # between thread wakeups more often than not) — repeat the burst
+    # until a shed is observed; if 10 oversubscribed bursts never shed,
+    # admission control is genuinely broken
+    for _ in range(10):
+        burst()
+        assert not errs
+        if core.reads_shed > 0:
+            break
+    assert core.reads_shed > 0  # depth 1 under 16-wide bursts
     assert sum(r.shed_retries for r in readers) > 0
     assert all(r.version == 1 for r in readers)
     with pytest.raises(RuntimeError, match="unknown tenant"):

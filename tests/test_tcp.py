@@ -417,8 +417,12 @@ def test_server_checkpoint_resume_continues_training(tmp_path):
         addr = f"127.0.0.1:{server.port}"
         workers = [spawn_worker(addr, i, cfg) for i in range(2)]
         try:
+            # generous timeout: under full-suite contention on the
+            # 2-core CI box the two jax worker startups alone can eat
+            # minutes — the old 240 s budget made this test load-flaky
+            # (ISSUE 13 burn-down); the happy path is unaffected
             params, m = serve(
-                server, cfg, total_grads=n_grads, timeout=240.0,
+                server, cfg, total_grads=n_grads, timeout=540.0,
                 checkpoint_dir=ckpt_dir, checkpoint_every=10,
                 resume=resume,
             )

@@ -1,0 +1,562 @@
+"""Hierarchical multi-hop aggregation (parallel/tree.py + the composed-
+lineage trailer in resilience/frames.py).
+
+Coverage map:
+
+- wire: trailer seal/read roundtrip, malformed-trailer rejection,
+  slot-count fingerprint drift, batched-consume meta alignment;
+- codec layer: per-hop error feedback (residual bounded, identity ~0,
+  disabled = plain encode);
+- serve loop: composed-count weighted rounds over a membership-dynamic
+  barrier (in-process, thread pushers — the test_dcn pattern);
+- E2E: a real 2-group tree over TCP (root decodes once per publish,
+  every worker trace ID composed at the root THROUGH the leader
+  re-encode), the leader-crash degraded path (fallback + respawn +
+  exact accounting), and the sharded-root composition (path-sharding ×
+  key-sharding) — the heavy ones marked slow (they re-run in
+  `make test` / `make tree-smoke`).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.parallel import dcn, tree
+from pytorch_ps_mpi_tpu.resilience import frames
+
+pytestmark = pytest.mark.skipif(
+    dcn.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+# ---------------------------------------------------------------------------
+# topology plan
+# ---------------------------------------------------------------------------
+
+def test_group_plan_partitions_and_remainder():
+    assert tree.group_plan(6, 2) == [[0, 1], [2, 3], [4, 5]]
+    assert tree.group_plan(5, 2) == [[0, 1], [2, 3], [4]]
+    assert tree.group_plan(3, 8) == [[0, 1, 2]]
+    with pytest.raises(ValueError):
+        tree.group_plan(4, 0)
+    assert tree.leader_wid(6, 1) == 7
+    assert tree.tree_slot_capacity(6, 4) == 4
+    assert tree.tree_slot_capacity(2, 8) == 2
+
+
+# ---------------------------------------------------------------------------
+# wire: the composed-lineage trailer
+# ---------------------------------------------------------------------------
+
+def test_trailer_seal_read_roundtrip_and_reject():
+    slots = 3
+    payload = np.arange(24, dtype=np.uint8)
+    buf = np.zeros(frames.HEADER_BYTES + payload.nbytes
+                   + frames.trailer_bytes(slots), np.uint8)
+    entries = [(2, 5, 7, 11.5), {"worker": 9, "step": 1, "seq": 4,
+                                 "send_wall": 2.25}]
+    sealed = frames.seal_frame(buf, payload, 0xFEED, step=5, seq=7,
+                               composed=entries, tree_slots=slots)
+    body, err = frames.open_frame(
+        sealed, 0xFEED, payload.nbytes + frames.trailer_bytes(slots))
+    assert err is None
+    got = frames.read_composed(body, payload.nbytes, slots)
+    assert got == [
+        {"worker": 2, "step": 5, "seq": 7, "send_wall": 11.5},
+        {"worker": 9, "step": 1, "seq": 4, "send_wall": 2.25},
+    ]
+    # the codec payload half is untouched by the trailer
+    assert bytes(body[:payload.nbytes]) == bytes(payload)
+    # corrupt the trailer magic -> parse refuses (reason "trailer" at
+    # the consume sites); CRC covers the trailer so flipping it is also
+    # a "corrupt" rejection at open_frame level
+    bad = np.array(body, copy=True)
+    bad[payload.nbytes] ^= 0xFF
+    assert frames.read_composed(bad, payload.nbytes, slots) is None
+    # an impossible count refuses too
+    bad2 = np.array(body, copy=True)
+    bad2[payload.nbytes + 4] = slots + 1
+    assert frames.read_composed(bad2, payload.nbytes, slots) is None
+    # a zero-count trailer refuses: a "composed" frame composing
+    # NOTHING would zero the root round's weighting denominator
+    empty = frames.seal_frame(buf, payload, 0xFEED, composed=[],
+                              tree_slots=slots)
+    ebody, eerr = frames.open_frame(
+        empty, 0xFEED, payload.nbytes + frames.trailer_bytes(slots))
+    assert eerr is None
+    assert frames.read_composed(ebody, payload.nbytes, slots) is None
+    # entries past capacity are truncated, not overflowed
+    many = [(w, 0, 0, 0.0) for w in range(10)]
+    sealed2 = frames.seal_frame(buf, payload, 0xFEED, composed=many,
+                                tree_slots=slots)
+    body2, err2 = frames.open_frame(
+        sealed2, 0xFEED, payload.nbytes + frames.trailer_bytes(slots))
+    assert err2 is None
+    assert len(frames.read_composed(body2, payload.nbytes, slots)) == slots
+
+
+def test_tree_slot_count_joins_the_fingerprint():
+    import jax  # noqa: F401  (template flattening inside)
+
+    tmpl = {"w": np.zeros(8, np.float32)}
+    base = frames.wire_fingerprint(None, tmpl)
+    assert frames.wire_fingerprint(None, tmpl, tree_slots=0) == base
+    f2 = frames.wire_fingerprint(None, tmpl, tree_slots=2)
+    f3 = frames.wire_fingerprint(None, tmpl, tree_slots=3)
+    assert len({base, f2, f3}) == 3  # any slot drift = config rejection
+
+
+def test_framed_batch_consume_aligns_metas_and_composed():
+    """The tree leader reads EVERY consumed item's trace meta from
+    ``last_batch_metas`` — ``last_push_meta`` alone is overwritten
+    within one batch (the bug the first live tree run caught)."""
+
+    class FakeServer:
+        max_staleness = 10 ** 9
+        version = 1
+        tree_slots = 2
+        _wire_payload_bytes = 8
+        tree_composed = 0
+        grads_received = 0
+        bytes_received = 0
+        stale_drops = 0
+
+        def __init__(self):
+            self.last_seen = {}
+            self.staleness_seen = {}
+            self.rejects = []
+            import collections
+
+            self._composed_queue = collections.deque()
+
+        def _reject_frame(self, w, reason):
+            self.rejects.append((w, reason))
+
+        def _decode_payload(self, p):
+            return np.frombuffer(p, np.float32).copy()
+
+    srv = FakeServer()
+
+    def payload_for(worker, step):
+        buf = np.zeros(8 + frames.trailer_bytes(2), np.uint8)
+        buf[:8] = np.arange(8, dtype=np.uint8)
+        frames.pack_trailer(buf, 8, [(worker, step, step, 1.0)], 2)
+        return buf
+
+    items = [
+        (0, 1, 0, payload_for(0, 3), 3, 3, 1.0),
+        (1, 1, 0, payload_for(1, 9), 9, 9, 1.0),
+    ]
+    out = frames.framed_batch_consume(srv, iter(items), raw=True)
+    assert [w for w, _, _ in out] == [0, 1]
+    metas = srv.last_batch_metas
+    assert [m["worker"] for m in metas] == [0, 1]
+    assert [m["composed"][0]["step"] for m in metas] == [3, 9]
+    assert srv.tree_composed == 2
+    assert list(srv._composed_queue) == [1, 1]
+    # raw views carry the codec payload ONLY (trailer split off)
+    assert all(g.nbytes == 8 for _, _, g in out)
+    # malformed trailer -> counted "trailer" rejection, item skipped
+    bad = payload_for(0, 0)
+    bad[8] ^= 0xFF
+    out2 = frames.framed_batch_consume(
+        srv, iter([(0, 1, 0, bad, 0, 0, 1.0)]), raw=True)
+    assert out2 == [] and srv.rejects == [(0, "trailer")]
+
+
+def test_server_requires_frames_for_tree_slots():
+    tmpl = {"w": np.zeros(8, np.float32)}
+    with pytest.raises(ValueError):
+        dcn.ShmPSServer(f"/psq_tree_t_{os.getpid()}", 1, tmpl,
+                        tree_slots=2, frame=False)
+
+
+# ---------------------------------------------------------------------------
+# codec layer: per-hop error feedback
+# ---------------------------------------------------------------------------
+
+def test_hop_ef_residual_bounded_and_identity_free():
+    import jax
+
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.codecs.error_feedback import HopErrorFeedback
+    from pytorch_ps_mpi_tpu.parallel.dcn import CodecWire
+
+    tmpl = {"a": np.zeros(96, np.float32)}
+    rng = np.random.RandomState(0)
+    grad = {"a": rng.randn(96).astype(np.float32)}
+    wire = CodecWire(get_codec("sign"), tmpl)
+    hop = HopErrorFeedback(wire, enabled=True)
+    # EF property: the decoded cumulative stream approaches the true
+    # cumulative sum — the residual stays bounded instead of compounding
+    dec_sum = np.zeros(96, np.float32)
+    rounds = 8
+    for _ in range(rounds):
+        p = hop.encode(grad)
+        d = wire.decode_from_bytes(p)
+        dec_sum += np.asarray(jax.tree.leaves(d)[0]).ravel()
+    true = grad["a"] * rounds
+    rel = np.linalg.norm(dec_sum - true) / np.linalg.norm(true)
+    assert rel < 0.5
+    assert hop.residual_norm > 0 and hop.rounds == rounds
+    # a second, EF-less hop on the same codec drifts further: feedback
+    # genuinely tightens the hop
+    hop_off = HopErrorFeedback(wire, enabled=False)
+    dec_off = np.zeros(96, np.float32)
+    for _ in range(rounds):
+        p = hop_off.encode(grad)
+        dec_off += np.asarray(
+            jax.tree.leaves(wire.decode_from_bytes(p))[0]).ravel()
+    rel_off = np.linalg.norm(dec_off - true) / np.linalg.norm(true)
+    assert rel < rel_off
+    # identity hop: residual ~0 (EF a no-op on a lossless wire)
+    wire_id = CodecWire(get_codec("identity"), tmpl)
+    hop_id = HopErrorFeedback(wire_id, enabled=True)
+    hop_id.encode(grad)
+    assert hop_id.residual_norm < 1e-5
+    probe = hop.probe()
+    assert probe["hop_ef"] and probe["ef_residual_norm"] > 0
+
+
+# ---------------------------------------------------------------------------
+# serve loop: composed-count weighted tree rounds (in-process, shm)
+# ---------------------------------------------------------------------------
+
+def test_serve_tree_mode_weights_rounds_by_composed_count():
+    """Two pushers: a 'leader' whose frames carry 3-entry trailers
+    (group SUM of 3 worker grads) and a direct 'fallback' worker
+    composing itself. Every round must divide by 4 — the composed
+    total — not by 2 (the frame count), and ``tree_composed`` must
+    account every worker push."""
+    from pytorch_ps_mpi_tpu.codecs import get_codec
+    from pytorch_ps_mpi_tpu.parallel.async_train import serve
+
+    cfg = {
+        "model": "mlp", "model_kw": {"features": (4, 2)},
+        "in_shape": (4,), "batch": 8, "seed": 1,
+        "codec": "identity",
+        "optim": "sgd", "hyper": {"lr": 0.1},
+        "frame_check": True,
+        "tree": True, "tree_members": [5], "tree_slots": 3,
+        "max_staleness": 10 ** 9,
+    }
+    from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+
+    _, params0, _, _ = make_problem(cfg)
+    name = f"/psq_tree_w_{os.getpid()}"
+    server = dcn.ShmPSServer(name, num_workers=6, template=params0,
+                             max_staleness=10 ** 9,
+                             code=get_codec("identity"), frame=True,
+                             tree_slots=3)
+    steps = 4
+    errors = []
+
+    def pusher(wid, composed_of):
+        try:
+            w = dcn.ShmPSWorker(name, wid, params0,
+                                code=get_codec("identity"), frame=True,
+                                tree_slots=3)
+            try:
+                for s in range(steps):
+                    params, v = w.read_params()
+                    # a deterministic "gradient": ones scaled by the
+                    # composed count (a group SUM of `composed_of`
+                    # unit-gradients)
+                    import jax
+
+                    g = jax.tree.map(
+                        lambda x: np.full_like(x, float(composed_of)),
+                        params)
+                    comp = [(100 + i, s, s, time.time())
+                            for i in range(composed_of)]
+                    w.push_grad(g, v, lineage=(s, s), composed=comp)
+            finally:
+                w.close()
+        except Exception as e:  # surfaces in the main thread's assert
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=pusher, args=(5, 3)),   # leader-like
+        threading.Thread(target=pusher, args=(0, 1)),   # direct leaf
+    ]
+    for t in threads:
+        t.start()
+    try:
+        params, m = serve(server, cfg, total_grads=0,
+                          total_received=2 * steps, sync_barrier=True,
+                          timeout=120.0)
+    finally:
+        for t in threads:
+            t.join(timeout=30)
+        server.close()
+    assert not errors, errors
+    # every round: (3*ones + 1*ones) summed / 4 composed = exactly ones
+    # -> params march down by lr * 1.0 per round, `steps` rounds
+    assert m["tree_composed"] == 4.0 * steps
+    assert m["applied"] == 2.0 * steps          # frames applied
+    assert m["publish_version"] == steps + 1    # one publish per round
+    flat0 = np.concatenate([np.asarray(x).ravel()
+                            for x in __import__("jax").tree.leaves(params0)])
+    flat1 = np.concatenate([np.asarray(x).ravel()
+                            for x in __import__("jax").tree.leaves(params)])
+    np.testing.assert_allclose(flat1, flat0 - 0.1 * steps, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# E2E: real trees (subprocess leaders + workers)
+# ---------------------------------------------------------------------------
+
+TREE_CFG = {
+    "model": "mlp", "model_kw": {"features": (16, 4)},
+    "in_shape": (8,), "batch": 32, "seed": 3,
+    "codec": "topk", "codec_kw": {"fraction": 0.25},
+    "optim": "sgd", "hyper": {"lr": 0.05},
+    "frame_check": True, "transport": "tcp",
+    "max_staleness": 10 ** 9,
+}
+
+
+def _root_composed_ids(lineage_dir):
+    seen = set()
+    path = os.path.join(lineage_dir, "lineage-server.jsonl")
+    for line in open(path):
+        r = json.loads(line)
+        pushes = (r.get("pushes") or []) + (
+            [r["push"]] if "push" in r else [])
+        for p in pushes:
+            for e in p.get("composed") or []:
+                seen.add((e["worker"], e["step"], e["seq"]))
+    return seen
+
+
+def test_tree_e2e_hop_composed_lineage(tmp_path):
+    """The tentpole invariant, live: 2 groups × 2 workers over TCP.
+    The root decodes exactly once per published version, and every
+    worker push's (worker, step, seq) trace ID appears in the root's
+    published-version composition AFTER traversing its leader's
+    re-encode."""
+    cfg = dict(TREE_CFG)
+    cfg.update(steps=4, n_workers=4, group_size=2,
+               lineage=True, lineage_dir=str(tmp_path))
+    params, m = tree.run_tree(cfg, timeout=240.0)
+    assert m["tree"]["worker_codes"] == [0, 0, 0, 0]
+    assert m["tree"]["leader_codes"] == [0, 0]
+    # one decode per published version at the root, aggregation armed
+    assert m["agg_mode"] == 1.0
+    assert m["decodes_per_publish"] == 1.0
+    # exact composed accounting: 4 workers x 4 steps
+    assert m["tree_composed"] == 16.0
+    # the root ingested FRAMES at group granularity (2 per round), not
+    # worker granularity — the whole point of the tree
+    assert m["grads_received"] < 16.0
+    assert m["loss_final"] < m["loss_initial"]
+    ids = _root_composed_ids(str(tmp_path))
+    expect = {(w, s, s) for w in range(4) for s in range(4)}
+    assert ids == expect
+    # hop rows carry the per-stage latency breakdown for every leader
+    hops = 0
+    for g in range(2):
+        for line in open(tmp_path / f"lineage-leader{g}.jsonl"):
+            r = json.loads(line)
+            if r.get("kind") == "hop":
+                hops += 1
+                assert {"fold_s", "encode_s", "push_s"} <= set(r)
+                assert r["composed"]
+    assert hops == m["grads_received"] / 1  # one hop row per root frame
+
+
+@pytest.mark.slow
+def test_tree_leader_crash_fallback_and_exact_accounting(tmp_path):
+    """Degraded-round coverage: leader 0 crashes mid-fold; its group
+    falls back to direct-to-root pushes (their trace IDs STILL appear
+    in the root's compositions), the supervisor respawns the leader,
+    and accounting stays exact: every worker push is either composed at
+    the root or positively logged lost with the dead leader."""
+    cfg = dict(TREE_CFG)
+    cfg.update(steps=8, n_workers=4, group_size=2,
+               degraded_round_after=1.0,
+               lineage=True, lineage_dir=str(tmp_path),
+               leader_kw={"crash_at_round": {"0": 1}, "rejoin_every": 3,
+                          "degrade_after": 1.0, "flush_after": 2.0})
+    params, m = tree.run_tree(cfg, timeout=280.0)
+    assert m["tree"]["worker_codes"] == [0, 0, 0, 0]
+    assert m["tree"]["leader_respawns"] >= 1
+    assert m["decodes_per_publish"] == 1.0
+    assert m["degraded_rounds"] >= 1.0
+    lost = set()
+    for g in range(2):
+        p = tmp_path / f"lineage-leader{g}.jsonl"
+        if not p.exists():
+            continue
+        for line in open(p):
+            r = json.loads(line)
+            if r.get("kind") == "leader_consume" and r.get("lost"):
+                lost.add((r["worker"], r["step"], r["seq"]))
+    ids = _root_composed_ids(str(tmp_path))
+    expect = {(w, s, s) for w in range(4) for s in range(8)}
+    assert ids | lost == expect
+    assert not (ids & lost)
+    # the crashed group's workers reached the root both ways: at least
+    # one composed ID arrived via fallback or post-respawn rejoin
+    assert any(w in (0, 1) for w, _, _ in ids)
+
+
+@pytest.mark.slow
+def test_tree_composes_with_key_sharding(tmp_path):
+    """Path-sharding × key-sharding: leaders slice their group
+    aggregate across 2 shard roots. Each shard must account every
+    worker push (composed counting), keep versions monotonic, and the
+    assembled parameters must have moved."""
+    from pytorch_ps_mpi_tpu.parallel import sharded
+    from pytorch_ps_mpi_tpu.parallel.async_train import (
+        join_workers,
+        make_problem,
+        spawn_worker,
+    )
+
+    n_workers, group_size, steps, n_shards = 4, 2, 3, 2
+    groups = tree.group_plan(n_workers, group_size)
+    cfg = dict(TREE_CFG)
+    cfg.update(steps=steps, n_workers=n_workers, group_size=group_size,
+               tree=True, tree_slots=2,
+               tree_members=[tree.leader_wid(n_workers, g)
+                             for g in range(len(groups))],
+               server_timeout=240.0)
+    _, params0, _, _ = make_problem(cfg)
+
+    outs = [str(tmp_path / f"shard{s}.npz") for s in range(n_shards)]
+    servers = [sharded.spawn_shard_server(s, n_shards, cfg, outs[s])
+               for s in range(n_shards)]
+    leaders, workers = [], []
+    try:
+        ports = [sharded.read_server_port(p) for p in servers]
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        for g, grp in enumerate(groups):
+            lp = tree.spawn_leader(addrs, g, grp, cfg)
+            hello = tree.read_leader_hello(lp)
+            leaders.append(lp)
+            for w in grp:
+                wcfg = dict(cfg)
+                wcfg["tree_leader"] = hello["addr"]
+                workers.append(spawn_worker(addrs[0], w, wcfg))
+        worker_codes = join_workers(workers, timeout=240.0)
+        leader_codes = join_workers(leaders, timeout=120.0)
+        server_codes = join_workers(servers, timeout=120.0)
+    finally:
+        for p in servers + leaders + workers:
+            if p.poll() is None:
+                p.terminate()
+    assert worker_codes == [0] * n_workers
+    assert leader_codes == [0] * len(groups)
+    assert server_codes == [0] * n_shards
+    total = 0
+    for out in outs:
+        z = np.load(out, allow_pickle=False)
+        assert int(z["version"]) >= 1
+        total += int(z["grads_received"])
+    final = sharded.assemble(outs, params0)
+    import jax
+
+    flat0 = np.concatenate([np.asarray(x).ravel()
+                            for x in jax.tree.leaves(params0)])
+    flat1 = np.concatenate([np.asarray(x).ravel()
+                            for x in jax.tree.leaves(final)])
+    assert np.all(np.isfinite(flat1))
+    assert np.linalg.norm(flat1 - flat0) > 0
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_fleet_merge_rolls_up_groups():
+    from pytorch_ps_mpi_tpu.telemetry.fleet import FleetMonitor
+
+    mon = FleetMonitor(endpoints=[])
+    members = [
+        {"name": "leader0", "url": "x", "role": "leader", "ok": True,
+         "error": None, "verdict": "ok", "group": 0, "members": [0, 1],
+         "metrics": {"grads_received": 8.0, "tree_composed": 16.0},
+         "labeled": [], "slo": None},
+        {"name": "leader1", "url": "x", "role": "leader", "ok": False,
+         "error": "unreachable", "verdict": None, "group": 1,
+         "members": [2, 3], "metrics": {}, "labeled": [], "slo": None},
+        {"name": "server", "url": "x", "role": "server", "ok": True,
+         "error": None, "verdict": None,
+         "metrics": {"grads_received": 8.0}, "labeled": [], "slo": None},
+    ]
+    snap = mon._merge(members, now=0.0)
+    g = snap["groups"]
+    assert g["0"]["n_ok"] == 1 and g["0"]["tree_composed"] == 16.0
+    assert g["0"]["leaves"] == [0, 1]
+    assert g["1"]["n_ok"] == 0 and g["1"]["n_members"] == 1
+    assert snap["fleet"]["tree_composed"] == 16.0
+
+
+def test_ps_top_renders_tree_roles_and_groups():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ps_top", os.path.join(os.path.dirname(__file__), os.pardir,
+                               "tools", "ps_top.py"))
+    ps_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ps_top)
+    snap = {
+        "armed": True, "n_members": 2, "n_ok": 2,
+        "fleet": {"grads_received": 12, "stale_drops": 0,
+                  "reads_total": 0, "reads_shed": 0},
+        "slo": {"breaches_total": 0, "burning": []},
+        "groups": {"0": {"n_members": 1, "n_ok": 1, "leaves": [0, 1],
+                         "grads_received": 6, "tree_composed": 12,
+                         "worst_verdict": "ok"}},
+        "members": {
+            "leader0": {"name": "leader0", "role": "leader", "group": 0,
+                        "ok": True, "verdict": "ok",
+                        "metrics": {"grads_received": 6,
+                                    "publish_version": 3}},
+            "server": {"name": "server", "role": "server", "ok": True,
+                       "verdict": None,
+                       "metrics": {"grads_received": 6,
+                                   "publish_version": 7}},
+        },
+    }
+    out = ps_top.render_fleet(snap)
+    assert "group[0]" in out and "composed=12" in out
+    assert "leader" in out and "grp" in out
+
+
+def test_telemetry_report_summarizes_hops(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", os.path.join(
+            os.path.dirname(__file__), os.pardir, "tools",
+            "telemetry_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    rows = [
+        {"kind": "publish", "version": 1, "t": 0.0, "apply_s": 0.001,
+         "pushes": [{"worker": 4, "step": 0, "seq": 0, "staleness": 0,
+                     "composed": [{"worker": 0, "step": 0, "seq": 0,
+                                   "send_wall": 0.0}]}]},
+        {"kind": "hop", "leader": 0, "round": 0, "up_seq": 0, "t": 0.0,
+         "composed": [{"worker": 0, "step": 0, "seq": 0}],
+         "fold_s": 0.001, "encode_s": 0.002, "push_s": 0.003,
+         "hop_rel_error": 0.1},
+        {"kind": "hop", "leader": 0, "round": 1, "up_seq": 1, "t": 1.0,
+         "composed": [{"worker": 0, "step": 1, "seq": 1},
+                      {"worker": 1, "step": 1, "seq": 1}],
+         "fold_s": 0.002, "encode_s": 0.001, "push_s": 0.004,
+         "hop_rel_error": 0.05},
+    ]
+    lin = tr._summarize_lineage(rows)
+    assert len(lin["hops"]) == 1
+    h = lin["hops"][0]
+    assert h["leader"] == 0 and h["rounds"] == 2
+    assert h["composed_total"] == 3
+    assert h["push_ms_p50"] == pytest.approx(3.5, rel=0.2)
+    assert h["rel_error_last"] == 0.05

@@ -138,7 +138,18 @@ def render_fleet(snap: Dict[str, Any],
             f"  skew[{key}]: min={s.get('min', 0):g} "
             f"max={s.get('max', 0):g} "
             f"spread={s.get('spread_frac', 0) * 100:.0f}% [{flag}]")
-    cols = ["member", "role", "ok", "verdict", "grads", "version",
+    for g, row in sorted((snap.get("groups") or {}).items()):
+        # aggregation-tree per-group rollup: which pod is behind, which
+        # leader is down, how many worker pushes its hop composed
+        leaves = row.get("leaves") or []
+        lines.append(
+            f"  group[{g}]: leaders {row.get('n_ok', 0)}/"
+            f"{row.get('n_members', 0)} ok  "
+            f"leaves={','.join(str(w) for w in leaves) or '-'}  "
+            f"grads={int(row.get('grads_received', 0))}  "
+            f"composed={int(row.get('tree_composed', 0))}  "
+            f"worst={row.get('worst_verdict') or '-'}")
+    cols = ["member", "role", "grp", "ok", "verdict", "grads", "version",
             "stale-p95", "e2e-p95", "reads", "up", "age"]
     rows = []
     members = sorted((snap.get("members") or {}).values(),
@@ -147,6 +158,7 @@ def render_fleet(snap: Dict[str, Any],
         mm = m.get("metrics") or {}
         rows.append([
             str(m.get("name")), str(m.get("role", "-")),
+            "-" if m.get("group") is None else str(m["group"]),
             "yes" if m.get("ok") else (m.get("error") or "no"),
             m.get("verdict") or "-",
             str(int(mm.get("grads_received", 0))),
@@ -159,7 +171,7 @@ def render_fleet(snap: Dict[str, Any],
         ])
     widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
               for i, c in enumerate(cols)]
-    fmt = "  ".join(f"{{:<{w}}}" if i in (0, 1, 2, 3) else f"{{:>{w}}}"
+    fmt = "  ".join(f"{{:<{w}}}" if i in (0, 1, 2, 3, 4) else f"{{:>{w}}}"
                     for i, w in enumerate(widths))
     lines.append(fmt.format(*cols))
     lines.append("  ".join("-" * w for w in widths))

@@ -175,6 +175,39 @@ CFG_KEYS = {
                          "registration name override (default: role name)"),
     "fleet_role": CfgKey("str", "caller",
                          "registration role tag (default 'server')"),
+    # -- hierarchical aggregation tree (parallel.tree) ---------------------
+    "tree": CfgKey("bool", "caller",
+                   "arm the aggregation-tree topology: serve() runs the "
+                   "membership-dynamic root barrier with composed-count "
+                   "weighted rounds"),
+    "group_size": CfgKey("int", "caller",
+                         "workers per leaf group (one leader each; the "
+                         "last group takes the remainder)"),
+    "leader_kw": CfgKey("dict", "caller",
+                        "leader-loop knobs (group_transport, group_codec, "
+                        "degrade_after, rejoin_every, crash_at_round, "
+                        "...) — see parallel.tree.LEADER_KNOBS"),
+    "hop_ef": CfgKey("bool", "caller",
+                     "per-hop error feedback on the leader's upstream "
+                     "re-encode (default True)"),
+    "tree_slots": CfgKey("int", "internal",
+                         "composed-lineage trailer capacity on pushes to "
+                         "the root (max group size; set by run_tree)"),
+    "tree_members": CfgKey("list[int]", "internal",
+                           "the root barrier's expected pusher ids "
+                           "(leader wids; set by run_tree)"),
+    "tree_leader": CfgKey("str", "internal",
+                          "this leaf worker's group-leader address "
+                          "(host:port or shm:<name>; set by run_tree)"),
+    "tree_fallback": CfgKey("str", "internal",
+                            "the root's address for direct-push fallback "
+                            "when the leader dies (set by run_tree)"),
+    "tree_async": CfgKey("bool", "caller",
+                         "run the tree root WITHOUT the sync barrier "
+                         "(each composed frame applies on arrival)"),
+    "fleet_meta": CfgKey("dict", "internal",
+                         "extra fleet-registration card fields (a tree "
+                         "leader's group id + member ids)"),
     # -- parameter-serving read tier --------------------------------------
     "serving": CfgKey("bool", "caller",
                       "arm the snapshot ring/read tier without binding "

@@ -63,6 +63,7 @@ INSTRUMENT_MAP: Dict[str, Optional[str]] = {
     "agg_mode": "ps_agg_mode",
     "decodes_per_publish": "ps_decodes_per_publish",
     "agg_fallbacks": "ps_agg_fallbacks_total",
+    "tree_composed": "ps_tree_composed_total",
     "lineage_pushes": "ps_lineage_pushes_total",
     "push_e2e_p50_ms": "ps_push_e2e_p50_ms",
     "push_e2e_p95_ms": "ps_push_e2e_p95_ms",

@@ -175,6 +175,37 @@ def _summarize_lineage(rows: List[Dict[str, Any]]
     for r in rounds:
         k = (r.get("gating_worker"), r.get("stage"))
         critical[k] = critical.get(k, 0) + 1
+    # per-hop latency breakdown (hierarchical tree): leader "hop" rows
+    # carry the fold / EF-re-encode / upstream-push stage walls — the
+    # numbers that say where a tree's round time goes
+    hop_rows = [r for r in rows if r.get("kind") == "hop"]
+    per_leader: Dict[Any, Dict[str, List[float]]] = {}
+    for r in hop_rows:
+        d = per_leader.setdefault(r.get("leader"), {
+            "fold": [], "encode": [], "push": [], "composed": [],
+            "rel_error": []})
+        for key, src in (("fold", "fold_s"), ("encode", "encode_s"),
+                         ("push", "push_s")):
+            if r.get(src) is not None:
+                d[key].append(float(r[src]))
+        d["composed"].append(float(len(r.get("composed") or [])))
+        if r.get("hop_rel_error") is not None:
+            d["rel_error"].append(float(r["hop_rel_error"]))
+    hops = []
+    for leader, d in sorted(per_leader.items(), key=lambda kv: str(kv[0])):
+        row: Dict[str, Any] = {
+            "leader": leader, "rounds": len(d["composed"]),
+            "composed_total": int(sum(d["composed"])),
+        }
+        for key in ("fold", "encode", "push"):
+            vals = sorted(d[key])
+            row[f"{key}_ms_p50"] = (1e3 * _percentile(vals, 0.50)
+                                    if vals else None)
+            row[f"{key}_ms_p95"] = (1e3 * _percentile(vals, 0.95)
+                                    if vals else None)
+        row["rel_error_last"] = (d["rel_error"][-1]
+                                 if d["rel_error"] else None)
+        hops.append(row)
     return {
         "publishes": len(publishes),
         "pushes_composed": sum(sizes),
@@ -190,6 +221,7 @@ def _summarize_lineage(rows: List[Dict[str, Any]]
             for (w, s), n in sorted(critical.items(),
                                     key=lambda kv: -kv[1])
         ],
+        "hops": hops,
     }
 
 
@@ -528,6 +560,19 @@ def format_table(summary: Dict[str, Any]) -> str:
             lines.append(
                 f"  critical path: worker {c['worker']} "
                 f"[{c['stage']}] gated {c['rounds']} rounds"
+            )
+        for h in lin.get("hops", []):
+            rel = h.get("rel_error_last")
+            lines.append(
+                f"  hop [leader {h['leader']}]: {h['rounds']} rounds, "
+                f"{h['composed_total']} pushes composed  "
+                f"fold p50/p95={_ms(h.get('fold_ms_p50'))}/"
+                f"{_ms(h.get('fold_ms_p95'))}  "
+                f"encode={_ms(h.get('encode_ms_p50'))}/"
+                f"{_ms(h.get('encode_ms_p95'))}  "
+                f"push={_ms(h.get('push_ms_p50'))}/"
+                f"{_ms(h.get('push_ms_p95'))}"
+                + ("" if rel is None else f"  rel-err={rel:.4g}")
             )
     hist = summary.get("history")
     if hist:
