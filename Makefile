@@ -16,6 +16,7 @@ test:
 	$(MAKE) native-asan
 	$(MAKE) obs-smoke
 	$(MAKE) tree-smoke
+	$(MAKE) control-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -167,6 +168,27 @@ bench:
 tpu-watch:
 	python tools/tpu_watch.py
 
+# Self-driving control-plane gate (in the default `make test` path): a
+# canned straggler+NaN+overload run with the controller armed must
+# downshift the codec identity->int8 mid-run through the wire-epoch
+# handshake (zero frames lost on BOTH transports — in-flight old-epoch
+# frames consumed, native TCP batch re-armed after retire), de-weight
+# exactly the stale worker's pushes (AsySG-InCon LR scaling),
+# quarantine then probation-readmit the NaN worker, and raise the
+# read tier's admission depth until a pipelined reader storm completes
+# shed-free. Every action row carries its triggering verdict,
+# Controller.replay() over the persisted TSDB rows re-derives the
+# sequence byte-identically, nothing flaps, and the controlled loss
+# beats the same scenario uncontrolled — gated below via bench_gate
+# (wall + loss ratio trajectory rows in
+# benchmarks/results/control_smoke.jsonl).
+control-smoke:
+	JAX_PLATFORMS=cpu python tools/control_smoke.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/control_smoke.jsonl \
+		--metric 'control_smoke.wall_total_s:lower:1.5' \
+		--metric 'control_smoke.loss_ratio:lower:0.5'
+
 # Static-analysis gate (in the default `make test` path): analyze_smoke
 # runs `python -m tools.psanalyze` on the tree (must be SILENT — the
 # five rules: thread-affinity, cfg-schema, metrics-surface,
@@ -243,4 +265,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke

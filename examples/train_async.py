@@ -161,6 +161,13 @@ def main(argv=None):
     ap.add_argument("--slo-target", action="append", default=[],
                     help="override one SLO target, KEY=VALUE "
                          "(repeatable; e.g. push_e2e_p95_ms=250)")
+    ap.add_argument("--control", action="store_true",
+                    help="arm the self-driving controller (requires "
+                         "--telemetry-dir for its action/replay rows): "
+                         "verdicts become recorded reversible actions — "
+                         "staleness LR de-weighting, evict/readmit, "
+                         "read-tier tuning, and (with a ladder via "
+                         "cfg['control_kw']) codec renegotiation")
     ap.add_argument("--fleet-dir", default=None,
                     help="fleet registration directory: this server "
                          "registers its endpoint there (re-registering "
@@ -300,6 +307,13 @@ def main(argv=None):
             cfg["slo_kw"] = {"targets": targets}
     if args.profile:
         cfg["profile"] = True
+    if args.control:
+        if not args.telemetry_dir:
+            ap.error("--control needs --telemetry-dir (action rows, "
+                     "replay input rows and control-epoch.json land "
+                     "there)")
+        cfg["control"] = True
+        cfg["control_dir"] = args.telemetry_dir
     if args.fleet_dir:
         cfg["fleet_dir"] = args.fleet_dir
         if args.metrics_port is None:
@@ -518,13 +532,14 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     # beacon-*.jsonl are health-monitor side channels, numerics-*.jsonl
     # are codec-fidelity/grad-norm trajectories, lineage-*.jsonl are
     # per-version push compositions, timeseries-*.jsonl are retained
-    # metric histories, and slo-*.jsonl are SLO verdict events — not
-    # flight-recorder files, so exclude them from the merged trace
-    # (telemetry_report's dir mode routes each to its own section)
+    # metric histories, slo-*.jsonl are SLO verdict events, and
+    # control-*.jsonl are controller action rows — not flight-recorder
+    # files, so exclude them from the merged trace (telemetry_report's
+    # dir mode routes each to its own section)
     files = sorted(f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
                    if not os.path.basename(f).startswith(
                        ("faults-", "beacon-", "numerics-", "lineage-",
-                        "timeseries-", "slo-")))
+                        "timeseries-", "slo-", "control-")))
     events = []
     for f in files:
         events.extend(load_jsonl(f)[1])
@@ -543,6 +558,7 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     obs_files = sorted(
         glob.glob(os.path.join(tdir, "timeseries-*.jsonl"))
         + glob.glob(os.path.join(tdir, "slo-*.jsonl"))
+        + glob.glob(os.path.join(tdir, "control-*.jsonl"))
         + glob.glob(os.path.join(tdir, "profile-*.txt")))
     print(format_table(summarize(files + lineage_files + obs_files,
                                  by_worker=False)))

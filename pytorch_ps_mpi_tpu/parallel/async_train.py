@@ -274,6 +274,16 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
 
     inj = FaultInjector.from_cfg(cfg, role=worker_id)
     push_timeout = float(cfg.get("push_timeout", 60.0))
+    # self-driving control plane, worker half: when the controller is
+    # armed, the server publishes codec renegotiations (wire-epoch
+    # bumps) as an atomically-replaced control-epoch.json; the worker
+    # polls it between steps (one os.stat per step) and rebuilds its
+    # wire onto the new epoch. No other worker-side change exists — LR
+    # scaling and evict/readmit are applied entirely server-side.
+    control_dir = cfg.get("control_dir") or (
+        cfg.get("telemetry_dir")
+        if (cfg.get("control") or cfg.get("control_kw")) else None)
+    epoch_state: Dict[str, Any] = {"epoch": 0, "mtime": 0}
     # monotonic push seq — the third leg of the (worker, step, seq)
     # trace ID stamped into every framed push at THIS encode site;
     # duplicates get their own seq (both frames really travel)
@@ -322,6 +332,15 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     try:
         for step in range(steps):
             t_step0 = time.monotonic()
+            if control_dir is not None:
+                from pytorch_ps_mpi_tpu import control as _control
+
+                doc = _control.poll_epoch(control_dir, epoch_state)
+                if doc is not None:
+                    try:
+                        _control.apply_epoch(w, doc)
+                    except Exception:
+                        pass  # a bad epoch doc must never kill a worker
             drop = duplicate = poison = False
             if inj is not None:
                 for f in inj.faults_at(step):
@@ -648,6 +667,24 @@ def serve(
     ``history`` / ``slo`` / ``profile``; the routes stay scrapable
     until ``server.close()``.
 
+    Self-driving control plane (:mod:`pytorch_ps_mpi_tpu.control`):
+    ``cfg["control"]`` (or ``control_kw`` / ``control_dir``) arms a
+    :class:`Controller` fed at this loop's tick + consume sites. It
+    renegotiates the wire codec/``bucket_mb``/agg-mode online from the
+    measured wire-vs-compute balance (an epoch bump through the frame
+    fingerprint handshake — workers poll ``control-epoch.json`` in
+    ``control_dir`` and in-flight old-epoch frames are consumed, never
+    rejected), applies staleness-aware per-push LR weights (AsySG-InCon
+    bound; decode paths only — a compressed payload cannot be scaled),
+    backoff-evicts churn-verdict workers from the sync barrier and
+    readmits quarantined workers after a clean probation, and tunes the
+    read tier's admission depth + snapshot ring from shed/ageout rates.
+    Every action lands in ``control-server.jsonl`` with its triggering
+    verdict; the input rows persist through the TSDB
+    (``timeseries-control-server.jsonl``) so ``Controller.replay``
+    re-derives the identical sequence. The final snapshot rides the
+    returned metrics as ``control``.
+
     Resilience hooks:
 
     - ``on_tick``: called from INSIDE the loop (same thread as every
@@ -740,6 +777,30 @@ def serve(
 
     inj = FaultInjector.from_cfg(cfg, role="server")
 
+    # -- self-driving control plane (cfg["control"] / "control_kw") -------
+    # The Controller closes the verdict→action loop: fed at the SAME
+    # on_tick/consume sites as the monitors above (no thread ever
+    # touches a native handle), it renegotiates the wire codec from the
+    # measured wire-vs-compute balance (epoch bump through the frame
+    # fingerprint handshake — in-flight old-epoch frames are consumed,
+    # not rejected), de-weights stale workers' pushes per the
+    # AsySG-InCon bound (applied below as a per-push weight — no
+    # worker-side change), backoff-evicts churning workers from the
+    # sync barrier and readmits quarantined ones after a clean
+    # probation, and tunes the read tier's admission depth + snapshot
+    # ring. Every action is a recorded, replayable, reversible event
+    # row (control-server.jsonl); Controller.replay() re-derives the
+    # identical sequence from the persisted TSDB input rows.
+    # Constructed BEFORE the aggregation arming below: a restarted
+    # generation may restore the fleet's current wire epoch here, and
+    # the agg decision must see the RESTORED wire (and the restore must
+    # never race an already-set agg_mode).
+    ctl = None
+    if cfg.get("control") or cfg.get("control_kw") or cfg.get("control_dir"):
+        from pytorch_ps_mpi_tpu.control import Controller
+
+        ctl = Controller(server, cfg, core=core)
+
     # -- homomorphic aggregation (cfg["agg"]: "auto" | "on" | "off") ------
     # Armed, the sync-barrier loop stops decoding per push: each arriving
     # payload is kept in its COMPRESSED form, a round folds one payload
@@ -776,6 +837,36 @@ def serve(
         print(f"compressed-domain aggregation requested but not armed "
               f"({why}); falling back to decode-sum", flush=True)
     server.agg_mode = 1.0 if agg_armed else 0.0
+    if ctl is not None:
+        ctl.set_agg(agg_armed)
+
+    def _agg_now() -> bool:
+        """Compressed-domain folding is live only while no controller
+        transition needs the decode path: a codec renegotiation first
+        suspends aggregation (mixed-epoch payloads cannot share one
+        accumulator), then bumps the epoch, then re-arms — and only
+        while the CURRENT wire (a renegotiation may have replaced the
+        boot one) actually supports the algebra under the same
+        exactness policy the boot check enforced."""
+        if not agg_armed:
+            return False
+        if ctl is not None and ctl.agg_suspended:
+            return False
+        if getattr(server, "_epoch_table", None):
+            return False  # old-epoch frames may still be in flight
+        w = server.wire
+        if w is not wire:
+            # renegotiated wire: re-validate the algebra (cached per
+            # wire object — agg_supported walks every unit)
+            ok = w.__dict__.get("_agg_ok_cached")
+            if ok is None:
+                ok = w.agg_supported and (
+                    agg_req == "on"
+                    or getattr(w.code, "agg_exact", True))
+                w.__dict__["_agg_ok_cached"] = ok
+            if not ok:
+                return False
+        return True
 
     loss0 = float(eval_loss(params, eval_batch))
     core.publish(params)
@@ -842,14 +933,22 @@ def serve(
     inbox: collections.deque = collections.deque()
 
     def _next_item():
+        # items ride the inbox tagged with the WIRE they were validated
+        # against at POLL time (None = decoded): a controller agg
+        # suspension or epoch bump mid-inbox must neither reinterpret
+        # already-polled payload views as decoded trees nor mis-decode
+        # them with a renegotiated wire installed after the poll
         if inbox:
             return inbox.popleft()
+        raw = _agg_now()
+        enc = server.wire if raw else None
         if batch_poll is not None:
-            batch = batch_poll(raw=agg_armed)
+            batch = batch_poll(raw=raw)
             if batch is not None:
-                inbox.extend(batch)
+                inbox.extend((it, enc) for it in batch)
                 return inbox.popleft() if inbox else None
-        return server.poll_grad(raw=True) if agg_armed else server.poll_grad()
+        item = server.poll_grad(raw=raw)
+        return None if item is None else (item, enc)
 
     def _fire_server_faults() -> None:
         """Server-targeted faults fire when the global applied count
@@ -949,22 +1048,38 @@ def serve(
             active = [w for w in range(n_workers) if w not in dead_workers]
         if numon is not None and numon.knobs["policy"] == "skip":
             active = [w for w in active if not numon.is_quarantined(w)]
+        if ctl is not None:
+            # controller-evicted (churn-verdict) workers leave the
+            # barrier exactly like quarantined ones: the round completes
+            # degraded over the survivors, their queued pushes are held,
+            # and the backoff readmission re-includes them — the
+            # existing degraded-round rejoin machinery, driven by a
+            # verdict instead of a dead transport
+            active = [w for w in active if not ctl.is_evicted(w)]
         if not active or any(not pending[w] for w in active):
             return False
         up_t0 = time.perf_counter()
-        if agg_armed:
+        entries = [pending[w].popleft() for w in active]
+        # read the server's CURRENT wire, not the boot-time capture: a
+        # controller renegotiation replaces server.wire mid-run
+        cur_wire = server.wire
+        if _agg_now() and all(e[3] is cur_wire for e in entries):
             # compressed-domain round: fold one queued payload per
             # active worker into the wire aggregator, then ONE decode
             # (never a [world, ...] decoded stack, never per-push
             # decodes) — the averaged result feeds the same jitted
-            # update the decode-sum path does. The mean's denominator is
-            # the COMPOSED push count (frames carry group sums in tree
-            # mode; 1 per frame otherwise, so this is exactly the old
-            # 1/len(active))
-            agg = wire.agg_begin()
+            # update the decode-sum path does. Folding requires every
+            # entry raw AND encoded with the CURRENT wire (entries
+            # carry their encode wire — a renegotiation between queue
+            # and round sends them down the decode path instead). The
+            # mean's denominator is the COMPOSED push count (frames
+            # carry group sums in tree mode; 1 per frame otherwise, so
+            # this is exactly the old 1/len(active)). Controller LR
+            # weights do NOT apply here — a compressed payload cannot
+            # be scaled per push (documented in docs/OPERATIONS.md).
+            agg = cur_wire.agg_begin()
             total_comp = 0
-            for w in active:
-                buf, comp_n = pending[w].popleft()
+            for buf, comp_n, _wgt, _wire in entries:
                 agg.fold(buf)
                 total_comp += comp_n
             server.decodes_done += 1
@@ -972,14 +1087,31 @@ def serve(
             summed = jax.tree.map(lambda x: x * inv, agg.finalize())
             n_contrib = agg.frames
         else:
-            batch_grads = []
+            batch_grads, wgts = [], []
             total_comp = 0
-            for w in active:
-                g, comp_n = pending[w].popleft()
+            for g, comp_n, wgt, enc_wire in entries:
+                if enc_wire is not None:
+                    # a payload queued raw before the controller
+                    # suspended aggregation (or before an epoch bump):
+                    # decode it now with the wire it was ENCODED with
+                    # (counted in decodes_done like any decode-sum push)
+                    g = server._decode_payload(g, wire=enc_wire)
                 batch_grads.append(g)
+                wgts.append(float(wgt))
                 total_comp += comp_n
-            summed = jax.tree.map(
-                lambda *gs: sum(gs) / total_comp, *batch_grads)
+            if all(wt == 1.0 for wt in wgts):
+                # bit-identical to the pre-control decode-sum round
+                summed = jax.tree.map(
+                    lambda *gs: sum(gs) / total_comp, *batch_grads)
+            else:
+                # staleness-aware per-push LR scaling (AsySG-InCon):
+                # de-weighted pushes contribute a smaller step; the
+                # denominator stays the composed count, so a weight
+                # only ever SHRINKS the stale worker's effective LR
+                summed = jax.tree.map(
+                    lambda *gs: sum(wt * gg for wt, gg
+                                    in zip(wgts, gs)) / total_comp,
+                    *batch_grads)
             n_contrib = len(batch_grads)
         probe = numon is not None and applied >= next_numerics_probe
         old_params = params if probe else None
@@ -1018,25 +1150,33 @@ def serve(
                 on_tick()
             # monitor upkeep (beacon/probe tailing), same thread
             core.tick()
+            if ctl is not None:
+                # the verdict→action sweep (self-throttled): builds one
+                # input row, persists it, runs the decision engine,
+                # executes any actions — all on this thread
+                ctl.tick()
+                if agg_armed:
+                    server.agg_mode = 1.0 if _agg_now() else 0.0
             if stop_when is not None and not draining and stop_when():
                 draining = True  # consume what's queued, then return
             if sync_barrier and now - round_t0 > degrade_after:
                 _mark_dead_workers()
                 while _try_complete_round():
                     pass
-        item = _next_item()
-        if item is None:
+        pair = _next_item()
+        if pair is None:
             if draining:
                 break
             time.sleep(0.0005)
             continue
-        wid, grad_version, grad = item
+        (wid, grad_version, grad), item_wire = pair
+        item_raw = item_wire is not None
         # tree mode: the frame's composed worker-push count (from its
         # lineage trailer), queued by the framed consume path in item
         # order — the round mean's per-frame weight; 1 otherwise
         comp_n = (server._composed_queue.popleft()
                   if tree_mode and getattr(server, "tree_slots", 0) else 1)
-        if agg_armed:
+        if item_raw:
             # payload-level non-finite screen (the aggregation path's
             # stand-in for the numerics monitor's decoded-tree check,
             # which can't run here — arming requires numon off): a push
@@ -1044,7 +1184,7 @@ def serve(
             # compressed accumulator, so reject it like any bad frame
             # and let the barrier wait for the worker's next push (the
             # same consumed-but-skipped discipline as numerics "skip")
-            if not wire.payload_finite(grad):
+            if not item_wire.payload_finite(grad):
                 server._reject_frame(wid, "nonfinite")
                 if lint is not None:
                     lint.discard_last(wid, reason="nonfinite")
@@ -1055,6 +1195,20 @@ def serve(
             # round — the per-push cost, in place of a jitted decode +
             # full-tree rebuild
             grad = np.copy(grad)
+        elif agg_armed:
+            # the controller suspended folding (codec-renegotiation
+            # window) so this push arrived DECODED — but the numerics
+            # monitor is off by the agg arming rule, so the aggregation
+            # path's non-finite screen must follow the push onto the
+            # decode path or a NaN gradient would reach the optimizer
+            # during exactly the transition window
+            if not all(bool(np.all(np.isfinite(np.asarray(leaf))))
+                       for leaf in jax.tree.leaves(grad)):
+                server._reject_frame(wid, "nonfinite")
+                if lint is not None:
+                    lint.discard_last(wid, reason="nonfinite")
+                wait_t0 = time.perf_counter()
+                continue
         elif agg_req == "on":
             server.agg_fallbacks += 1
         wait_s = time.perf_counter() - wait_t0
@@ -1065,6 +1219,11 @@ def serve(
                       step=applied, version=grad_version)
         if monitor is not None:
             monitor.observe_grad(wid, staleness, wait_s)
+        if ctl is not None:
+            # the controller's consume-site feed: per-worker staleness
+            # (the lr_scale rule's fallback input when lineage's exact
+            # windows are unarmed)
+            ctl.observe_push(wid, staleness)
         if numon is not None:
             # numerics validation BEFORE the gradient can touch the
             # optimizer: count/quarantine non-finite pushes, then let
@@ -1097,7 +1256,25 @@ def serve(
             dead_workers.discard(wid)
             if tree_mode:
                 tree_joined.add(wid)
-            pending[wid].append((grad, comp_n))
+            if ctl is not None and ctl.is_evicted(wid):
+                # a backoff-evicted worker's pushes are DROPPED, not
+                # queued: an unbounded pending backlog would re-apply
+                # seconds-stale gradients one round at a time after
+                # readmission. Same consumed-but-skipped discipline as
+                # numerics "skip" — minus the rejection counter, which
+                # feeds the churn verdict and would re-evict the worker
+                # the moment it was readmitted. It rejoins the barrier
+                # with its first post-readmission push.
+                if lint is not None:
+                    lint.discard_last(wid, reason="evicted")
+                if rec is not None:
+                    rec.event("serve.evicted_drop", worker=wid)
+                wait_t0 = time.perf_counter()
+                continue
+            pending[wid].append((
+                grad, comp_n,
+                ctl.push_weight(wid) if ctl is not None else 1.0,
+                item_wire))
             if monitor is not None and wid not in round_ready:
                 round_ready[wid] = time.perf_counter()
             if not _try_complete_round():
@@ -1106,7 +1283,13 @@ def serve(
             up_t0 = time.perf_counter()
             probe = numon is not None and applied >= next_numerics_probe
             old_params = params if probe else None
-            if comp_n > 1:
+            wgt = ctl.push_weight(wid) if ctl is not None else 1.0
+            if wgt != 1.0:
+                # staleness-aware per-push LR scaling (AsySG-InCon
+                # bound): the stale worker's update shrinks; comp_n
+                # folds into the same map below
+                grad = jax.tree.map(lambda x: x * wgt / comp_n, grad)
+            elif comp_n > 1:
                 # a composed frame carries its group's SUM: apply the
                 # group mean so the async step size is load-independent
                 grad = jax.tree.map(lambda x: x / comp_n, grad)
@@ -1173,6 +1356,16 @@ def serve(
     if lint is not None:
         m["lineage"] = lint.snapshot()
         lint.close()
+    if ctl is not None:
+        snap = ctl.snapshot()
+        # zero-frame-loss accounting for codec renegotiations: every
+        # old-epoch frame consumed during a transition is counted here
+        # (they would have been "config" rejections without the epoch
+        # table)
+        snap["epoch_old_frames"] = int(
+            getattr(server, "epoch_old_frames", 0))
+        m["control"] = snap
+        ctl.close()
     if server.timeseries_db is not None:
         # one closing sample so the retained history ends on the FINAL
         # counter state, not the last tick-cadence snapshot (force: the

@@ -401,6 +401,85 @@ class WireAggregator:
             pass  # interpreter teardown
 
 
+def _renegotiate_common(server, code, bucket_mb: float = 0.0) -> None:
+    """The shared server half of a codec/bucket_mb renegotiation (shm
+    and TCP): build the new wire, keep the old epoch accepted, make the
+    new fingerprint current. The epoch bump is executed entirely through
+    the PR 3 frame handshake — the fingerprint IS the epoch
+    discriminator, so no transport protocol change is needed."""
+    if not server.frame:
+        raise RuntimeError("wire renegotiation requires frame_check "
+                           "(the fingerprint is the epoch handshake)")
+    if server.wire is None:
+        raise RuntimeError("wire renegotiation requires a codec wire")
+    if getattr(server, "tree_slots", 0):
+        raise RuntimeError("wire renegotiation is not supported on tree "
+                           "wires (the hop codec is the tree's own "
+                           "agreement)")
+    if getattr(server, "agg_mode", 0.0):
+        raise RuntimeError("suspend compressed-domain aggregation before "
+                           "renegotiating (mixed-epoch payloads cannot "
+                           "share one accumulator)")
+    from pytorch_ps_mpi_tpu.resilience import frames as _frames
+
+    new_wire = CodecWire(code, server.template, bucket_mb=bucket_mb)
+    new_frame = new_wire.wire_bytes + _frames.HEADER_BYTES
+    # the cap is the BOOT wire's frame size, latched at the first
+    # renegotiation (when server.wire IS still the boot wire) — not the
+    # receive buffer, which on TCP is sized to max(snapshot, frame) and
+    # would admit entries every WORKER's boot-sized frame buffer must
+    # then decline (a fleet-wide silent config rejection after retire)
+    cap = server.__dict__.setdefault(
+        "_reneg_frame_cap", server._expected_payload + _frames.HEADER_BYTES)
+    if new_frame > cap:
+        raise ValueError(
+            f"renegotiated wire needs {new_frame} B frames but the "
+            f"boot wire (and every worker's frame buffer) was sized "
+            f"for {cap} B — ladder entries must not exceed the boot "
+            "wire's payload size")
+    table = server.__dict__.setdefault("_epoch_table", {})
+    table[server._fingerprint] = {
+        "wire": server.wire,
+        "expected": server._expected_payload,
+        "epoch": getattr(server, "_epoch", 0),
+    }
+    while len(table) > 2:  # at most two retiring epochs in flight
+        table.pop(next(iter(table)))
+    server._epoch = getattr(server, "_epoch", 0) + 1
+    server.wire = new_wire
+    server._fingerprint = _frames.wire_fingerprint(
+        new_wire, server.template)
+    server._expected_payload = new_wire.wire_bytes
+    server._wire_payload_bytes = new_wire.wire_bytes
+    server._epoch_transition = True
+
+
+def _worker_renegotiate_common(worker, code,
+                               bucket_mb: float = 0.0) -> bool:
+    """The shared worker half of a renegotiation: rebuild the codec
+    wire (same per-worker seed, so stochastic codecs keep distinct
+    streams) and recompute the fingerprint. Returns False — declining,
+    never raising — when this worker cannot switch (unframed wire, no
+    codec, tree trailer wire, or a payload the boot-sized frame buffer
+    cannot hold); a declining worker keeps pushing its old epoch, which
+    the server consumes until that epoch retires."""
+    if (not getattr(worker, "frame", False) or worker.wire is None
+            or getattr(worker, "tree_slots", 0)):
+        return False
+    from pytorch_ps_mpi_tpu.resilience import frames as _frames
+
+    new_wire = CodecWire(code, worker.template,
+                         seed=getattr(worker, "_seed", 0),
+                         bucket_mb=bucket_mb)
+    if (_frames.HEADER_BYTES + new_wire.wire_bytes
+            > worker._frame_buf.nbytes):
+        return False
+    worker.wire = new_wire
+    worker._fingerprint = _frames.wire_fingerprint(
+        new_wire, worker.template)
+    return True
+
+
 class ShmPSServer(PSServerTelemetry):
     """Owns params; publishes snapshots, consumes gradients in arrival
     order (the PS side of the reference's rank-0 loop, README.md:61-77).
@@ -503,17 +582,40 @@ class ShmPSServer(PSServerTelemetry):
         if rc != 0:
             raise RuntimeError("psq_publish_params failed")
 
-    def _decode_payload(self, payload: np.ndarray) -> PyTree:
+    def _decode_payload(self, payload: np.ndarray,
+                        wire=None) -> PyTree:
         """Payload bytes (a view into the receive buffer) → gradient
         tree; shared by the framed and legacy poll paths. Counted in
-        ``decodes_done`` — the numerator of ``decodes_per_publish``."""
+        ``decodes_done`` — the numerator of ``decodes_per_publish``.
+        ``wire`` overrides the server's current wire — the old-epoch
+        decode path during a codec renegotiation transition."""
         self.decodes_done += 1
-        if self.wire:
+        wire = wire if wire is not None else self.wire
+        if wire:
             # zero-copy: decode reads the receive buffer through a
             # memoryview; the jitted decode's device transfer is the copy
-            return self.wire.decode_from_bytes(payload)
+            return wire.decode_from_bytes(payload)
         flat = np.frombuffer(payload, np.float32).copy()
         return _unflatten(flat, self.template)
+
+    def renegotiate_wire(self, code, bucket_mb: float = 0.0) -> None:
+        """Install a NEW codec wire as the current epoch (the
+        controller's codec/bucket_mb renegotiation). The old epoch's
+        wire stays in ``_epoch_table`` so in-flight old-fingerprint
+        frames are consumed — decoded with their own wire — instead of
+        rejected; :meth:`finish_renegotiation` retires it once the
+        fleet has switched. The new wire's framed payload must fit the
+        boot-sized transport buffers (mailbox slots are sized once at
+        creation), so a ladder can only move between the boot config
+        and anything smaller."""
+        _renegotiate_common(self, code, bucket_mb)
+
+    def finish_renegotiation(self) -> None:
+        """Retire every old epoch: frames carrying a retired fingerprint
+        become counted ``"config"`` rejections again (the pre-transition
+        behavior for config drift)."""
+        self._epoch_table = {}
+        self._epoch_transition = False
 
     def _poll_grad_framed(self, raw: bool = False
                           ) -> Optional[Tuple[int, int, PyTree]]:
@@ -684,8 +786,9 @@ class ShmPSWorker:
         self.template = template
         # worker's wire must agree with the server's (same codec config
         # AND bucket_mb); stochastic codecs get a per-worker PRNG stream
+        self._seed = seed + worker_id  # re-used by renegotiate()
         self.wire = (
-            CodecWire(code, template, seed=seed + worker_id,
+            CodecWire(code, template, seed=self._seed,
                       bucket_mb=bucket_mb)
             if code is not None else None
         )
@@ -823,6 +926,12 @@ class ShmPSWorker:
                 raise RuntimeError("psq_push_grad failed")
             time.sleep(0.002)  # mailbox full: server hasn't consumed yet
         raise TimeoutError("push_grad timed out")
+
+    def renegotiate(self, code, bucket_mb: float = 0.0) -> bool:
+        """Switch this worker's wire to a renegotiated codec epoch (the
+        controller published it via ``control-epoch.json``). Returns
+        False when declined — see :func:`_worker_renegotiate_common`."""
+        return _worker_renegotiate_common(self, code, bucket_mb=bucket_mb)
 
     def close(self):
         if self._h:

@@ -185,6 +185,21 @@ def server_main(shard_id: int, n_shards: int, port: int,
     ocfg.pop("fleet_name", None)
     server.arm_observability(ocfg, name=f"shard{shard_id}")
 
+    # per-shard control plane: staleness LR scaling + read-tier tuning
+    # on this shard's own verdicts (control-shard<i>.jsonl). The codec
+    # rule is forced off — a shard cannot renegotiate the wire
+    # unilaterally, every shard's fingerprint must move together with
+    # the workers' (single-server runs own the epoch file).
+    ctl = None
+    if cfg.get("control") or cfg.get("control_kw") or cfg.get("control_dir"):
+        from pytorch_ps_mpi_tpu.control import Controller
+
+        ccfg = dict(cfg)
+        ccfg["control_kw"] = {**(cfg.get("control_kw") or {}),
+                              "ladder": None}
+        ctl = Controller(server, ccfg, core=core,
+                         name=f"shard{shard_id}")
+
     ckpt = None
     applied_before = 0
     checkpoint_every = int(cfg.get("checkpoint_every", 50))
@@ -254,21 +269,32 @@ def server_main(shard_id: int, n_shards: int, port: int,
                     # TSDB sample + SLO sweep, serve-thread only — the
                     # same tick discipline as the single-server loop
                     server.observability_tick()
+                if ctl is not None:
+                    ctl.tick()
             item = server.poll_grad()
             if item is None:
                 time.sleep(0.0005)
                 continue
             wid, ver, grad = item
+            staleness = max(0, server.version - ver)
             if monitor is not None:
-                monitor.observe_grad(wid, max(0, server.version - ver))
+                monitor.observe_grad(wid, staleness)
+            if ctl is not None:
+                ctl.observe_push(wid, staleness)
             up_t0 = time.perf_counter()
+            comp_n = 1
             if tree_slots:
                 comp_n = (server._composed_queue.popleft()
                           if server._composed_queue else 1)
-                if comp_n > 1:
-                    # a leader frame carries its group's SUM — apply the
-                    # group mean (same rule as the tree root's loop)
-                    grad = jax.tree.map(lambda x: x / comp_n, grad)
+            wgt = ctl.push_weight(wid) if ctl is not None else 1.0
+            if wgt != 1.0:
+                # per-push staleness LR weight, shard-local (the
+                # controller's lr_scale rule); comp_n folds in too
+                grad = jax.tree.map(lambda x: x * wgt / comp_n, grad)
+            elif comp_n > 1:
+                # a leader frame carries its group's SUM — apply the
+                # group mean (same rule as the tree root's loop)
+                grad = jax.tree.map(lambda x: x / comp_n, grad)
             params, state = update(params, grad, state)
             applied += 1
             if slow_ms:
@@ -304,8 +330,12 @@ def server_main(shard_id: int, n_shards: int, port: int,
                                if core is not None else {}),
             slo=json.dumps(server.slo_watchdog.snapshot()
                            if server.slo_watchdog is not None else {}),
+            control=json.dumps(ctl.snapshot()
+                               if ctl is not None else {}),
         )
     finally:
+        if ctl is not None:
+            ctl.close()
         if tracker is not None:
             tracker.close()
         server.close()

@@ -345,16 +345,51 @@ class TcpPSServer(PSServerTelemetry):
         self._lib.tps_server_pump(self._h)  # serve waiting readers promptly
         self._refresh_read_stats()
 
-    def _decode_payload(self, payload: np.ndarray) -> PyTree:
+    def _decode_payload(self, payload: np.ndarray,
+                        wire=None) -> PyTree:
         """Payload bytes (a view into the receive buffer) → gradient
         tree; shared by the framed and legacy poll paths. Counted in
-        ``decodes_done`` — the numerator of ``decodes_per_publish``."""
+        ``decodes_done`` — the numerator of ``decodes_per_publish``.
+        ``wire`` overrides the server's current wire — the old-epoch
+        decode path during a codec renegotiation transition."""
         self.decodes_done += 1
-        if self.wire:
+        wire = wire if wire is not None else self.wire
+        if wire:
             # zero-copy: decode reads the receive buffer via memoryview
-            return self.wire.decode_from_bytes(payload)
+            return wire.decode_from_bytes(payload)
         flat = np.frombuffer(payload, np.float32).copy()
         return _unflatten(flat, self.template)
+
+    def renegotiate_wire(self, code, bucket_mb: float = 0.0) -> None:
+        """Install a NEW codec wire as the current epoch (the
+        controller's codec/bucket_mb renegotiation). During the
+        transition the native batched-ingest fast path is bypassed —
+        its in-C++ validator knows one fingerprint — and the Python
+        framed poll consumes BOTH epochs; :meth:`finish_renegotiation`
+        re-arms the native validator on the new fingerprint. Ladder
+        entries must not exceed the boot wire's payload size (the
+        transport's max_msg is fixed at bind time)."""
+        from pytorch_ps_mpi_tpu.parallel.dcn import _renegotiate_common
+
+        _renegotiate_common(self, code, bucket_mb)
+
+    def finish_renegotiation(self) -> None:
+        """Retire every old epoch and re-point the native frame
+        validator (and the batch buffer sizing) at the current wire."""
+        self._epoch_table = {}
+        self._epoch_transition = False
+        if self._batch_max:
+            payload_bytes = self._expected_payload
+            self._lib.tps_server_set_frame_check(
+                self._h, self._fingerprint, payload_bytes)
+            batch_max = max(1, min(64, (16 << 20)
+                                   // max(payload_bytes, 1)))
+            if batch_max * payload_bytes > self._batch_buf.nbytes:
+                self._batch_buf = np.empty(
+                    batch_max * payload_bytes, np.uint8)
+            if batch_max != self._batch_max:
+                self._batch_metas = (_BatchMeta * batch_max)()
+                self._batch_max = batch_max
 
     def _note_connections(self) -> None:
         """Latch first-connect times: a worker's liveness clock starts
@@ -411,6 +446,11 @@ class TcpPSServer(PSServerTelemetry):
         from pytorch_ps_mpi_tpu.utils import native as _native
 
         if not self._batch_max or _native.fast_path_disabled():
+            return None
+        if getattr(self, "_epoch_transition", False):
+            # mid-renegotiation: the in-C++ validator knows only one
+            # fingerprint — fall back to the Python framed poll, which
+            # consumes both epochs, until finish_renegotiation()
             return None
         if raw and not self.wire:
             raise ValueError("poll_grad_batch(raw=True) needs a codec wire")
@@ -592,8 +632,9 @@ class TcpPSWorker:
             )
         self.worker_id = worker_id
         self.template = template
+        self._seed = seed + worker_id  # re-used by renegotiate()
         self.wire = (
-            CodecWire(code, template, seed=seed + worker_id,
+            CodecWire(code, template, seed=self._seed,
                       bucket_mb=bucket_mb)
             if code is not None else None
         )
@@ -726,6 +767,17 @@ class TcpPSWorker:
             raise TimeoutError("push_grad timed out awaiting server ack")
         if rc != 1:
             raise RuntimeError(f"tps_worker_push_grad -> {rc}")
+
+    def renegotiate(self, code, bucket_mb: float = 0.0) -> bool:
+        """Switch this worker's wire to a renegotiated codec epoch (the
+        controller published it via ``control-epoch.json``). Returns
+        False when declined — see
+        :func:`~pytorch_ps_mpi_tpu.parallel.dcn._worker_renegotiate_common`."""
+        from pytorch_ps_mpi_tpu.parallel.dcn import (
+            _worker_renegotiate_common,
+        )
+
+        return _worker_renegotiate_common(self, code, bucket_mb=bucket_mb)
 
     def close(self):
         if self._h:

@@ -609,6 +609,16 @@ class TreeWorkerConn:
         if w is not None:
             w._tamper = fn
 
+    def renegotiate(self, code, bucket_mb: float = 0.0) -> bool:
+        """Decline controller wire renegotiation: a tree leaf's group
+        codec (and the root's trailer-bearing upstream wire) is the
+        tree topology's own agreement — the leader re-encodes the hop,
+        so swapping the leaf wire unilaterally would split the group's
+        fold. The leaf keeps its epoch; the root consumes it until the
+        old epoch retires (the controller disables the codec rule in
+        tree mode for exactly this reason)."""
+        return False
+
     def _connect_leader(self, timeout: float, initial: bool = False) -> bool:
         from pytorch_ps_mpi_tpu.parallel.dcn import ShmPSWorker
         from pytorch_ps_mpi_tpu.parallel.tcp import TcpPSWorker

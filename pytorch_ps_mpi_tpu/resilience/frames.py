@@ -309,6 +309,31 @@ BATCH_REASONS = {1: "short", 2: "version", 3: "magic", 4: "size",
                  5: "config", 6: "corrupt"}
 
 
+def _open_with_epochs(server, buf: np.ndarray):
+    """Open a frame against the server's CURRENT wire agreement, falling
+    back to any still-accepted older epoch (the controller's codec
+    renegotiation installs the new wire beside the old one in
+    ``server._epoch_table``; in-flight old-epoch frames are consumed —
+    decoded with THEIR epoch's wire — never rejected). Returns
+    ``(payload, err, wire, epoch)``: ``wire`` is None for a
+    current-epoch frame (callers use the server's own decode path)."""
+    payload, err = open_frame(buf, server._fingerprint,
+                              server._expected_payload)
+    if err is None:
+        # note the worker's epoch: the controller retires the old epoch
+        # once every live worker has been seen on the current one
+        return payload, None, None, getattr(server, "_epoch", 0)
+    table = getattr(server, "_epoch_table", None)
+    if err in ("config", "size") and table:
+        for fp_old, ep in table.items():
+            payload, err2 = open_frame(buf, fp_old, ep["expected"])
+            if err2 is None:
+                server.epoch_old_frames = getattr(
+                    server, "epoch_old_frames", 0) + 1
+                return payload, None, ep["wire"], ep["epoch"]
+    return None, err, None, None
+
+
 def _split_composed(server, wid: int, payload: np.ndarray):
     """Tree-wire post-validation step shared by both consume paths:
     split a validated frame payload into (codec payload, composed
@@ -439,13 +464,13 @@ def framed_poll(
             return None
         # any frame — valid or not — proves the worker is alive
         server.last_seen[wid] = time.time()
-        payload, err = open_frame(
-            server._grad_buf[:n], server._fingerprint,
-            server._expected_payload,
-        )
+        payload, err, old_wire, epoch = _open_with_epochs(
+            server, server._grad_buf[:n])
         if err is not None:
             server._reject_frame(wid, err)
             continue
+        if getattr(server, "_epoch_table", None) is not None:
+            server.__dict__.setdefault("_epoch_seen", {})[wid] = epoch
         recv_wall = time.time()
         lstep, lseq, send_wall = read_lineage(server._grad_buf)
         full_bytes = payload.nbytes
@@ -468,11 +493,16 @@ def framed_poll(
             meta["composed"] = composed
         if staleness <= server.max_staleness:
             t_dec = time.monotonic()
-            if raw:
+            if raw and old_wire is None:
                 grad = payload
                 meta["decode_s"] = 0.0  # deferred to the round's ONE decode
             else:
-                grad = server._decode_payload(payload)
+                # an old-epoch frame is decoded with ITS epoch's wire —
+                # even in raw mode, where it cannot enter the current
+                # wire's compressed accumulator (the controller suspends
+                # aggregation around a renegotiation, so this is the
+                # defensive path, not the expected one)
+                grad = server._decode_payload(payload, wire=old_wire)
                 meta["decode_s"] = round(time.monotonic() - t_dec, 6)
             server.last_push_meta = meta
             # the server-side anchor of the cross-process flow arrow:

@@ -229,6 +229,13 @@ class Supervisor:
         """Called from inside the serve loop (same thread as the native
         transport — never racing a pump): respawn dead workers, observe
         post-restart reconnects."""
+        # per-worker respawn counts, stashed on the server for the
+        # control plane: a respawn-looping worker is churn the
+        # controller's evict rule should see even when the worker's own
+        # beacon counters died with it
+        server._supervisor_respawns = {
+            r.wid: r.respawns for r in self._recs.values() if r.respawns
+        }
         for rec in self._recs.values():
             if rec.done or rec.abandoned:
                 continue

@@ -74,6 +74,11 @@ class ResilientWorker:
         # the server already consumed)
         self._auto_seq = 0
         self._tamper = None
+        # the last applied wire renegotiation (controller epoch bump) —
+        # re-applied after every reconnect, because the factory builds
+        # the BOOT wire and a replacement pushing the boot fingerprint
+        # would be config-rejected once the old epoch retires
+        self._renegotiated: Optional[tuple] = None
         self._w: Optional[Any] = None
         self._w = self._build(initial=True)
 
@@ -115,6 +120,11 @@ class ResilientWorker:
                         attempt=attempt, reconnects=self.reconnects,
                     )
                 w._tamper = self._tamper
+                if self._renegotiated is not None:
+                    code, bucket_mb = self._renegotiated
+                    reneg = getattr(w, "renegotiate", None)
+                    if reneg is not None:
+                        reneg(code, bucket_mb=bucket_mb)
                 return w
             except (TimeoutError, RuntimeError, OSError) as e:
                 last = e
@@ -193,6 +203,18 @@ class ResilientWorker:
         # the transport consumed any one-shot tamper with the push
         self._tamper = getattr(self._w, "_tamper", None)
         return out
+
+    def renegotiate(self, code, bucket_mb: float = 0.0) -> bool:
+        """Forward a wire renegotiation to the inner transport and
+        remember it, so every later reconnect rebuilds onto the CURRENT
+        epoch instead of the factory's boot wire."""
+        reneg = getattr(self._w, "renegotiate", None)
+        if reneg is None:
+            return False
+        ok = bool(reneg(code, bucket_mb=bucket_mb))
+        if ok:
+            self._renegotiated = (code, float(bucket_mb))
+        return ok
 
     def close(self) -> None:
         if self._w is not None:

@@ -352,6 +352,24 @@ class ServingCore:
         with self._lock:
             self.reads_shed += 1
 
+    # -- control-plane actuators ------------------------------------------
+    def set_admission_depth(self, depth: int) -> None:
+        """Live admission-depth change (the controller's read-tier
+        tuning): the network loop reads ``core.admission_depth`` at
+        every enqueue, so the new bound applies to the next request."""
+        if depth < 1:
+            raise ValueError(f"admission depth must be >= 1, got {depth}")
+        self.admission_depth = int(depth)
+
+    def set_ring(self, ring: int) -> None:
+        """Live snapshot-ring resize across every tenant store (and for
+        stores created later)."""
+        self.knobs["ring"] = int(ring)
+        with self._lock:
+            stores = list(self._stores.values())
+        for store in stores:
+            store.resize(int(ring))
+
     def observe_read(self, dur_s: float) -> None:
         self._read_hist.observe(float(dur_s))
 

@@ -498,6 +498,12 @@ class HealthMonitor:
             # the slo section: per-rule burn rates, latched breach
             # states, recent verdicts — what the fleet pane rolls up
             out["slo"] = wd.snapshot()
+        cl = getattr(self.server, "controller", None)
+        if cl is not None:
+            # the control section: executed actions, wire epoch, LR
+            # weights, eviction/probation state — the verdict→action
+            # half of the pane (ps_top renders it as the control pane)
+            out["control"] = cl.snapshot()
         db = getattr(self.server, "timeseries_db", None)
         if db is not None:
             out["history"] = db.snapshot()

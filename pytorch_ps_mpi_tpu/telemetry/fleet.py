@@ -56,6 +56,7 @@ _SUM_KEYS: Dict[str, str] = {
     "reads_shed": "ps_reads_shed_total",
     "slo_breaches": "ps_slo_breaches_all_total",
     "tree_composed": "ps_tree_composed_total",
+    "control_actions": "ps_control_actions_total",
 }
 
 #: gauges rolled up as the fleet max (worst member)
@@ -258,6 +259,19 @@ class FleetMonitor:
                     "reads_per_s": serving.get("reads_per_s", 0.0),
                     "queue_depth": serving.get("queue_depth", 0),
                 }
+            control = doc.get("control")
+            if isinstance(control, dict):
+                # the member's controller card: what the pane's
+                # controller rollup sums/maxes across the fleet
+                row["control"] = {
+                    "actions_total": control.get("actions_total", 0),
+                    "flaps": control.get("flaps", 0),
+                    "epoch": control.get("epoch", 0),
+                    "evicted": control.get("evicted", []),
+                    "lr_scale": control.get("lr_scale", {}),
+                    "recent_actions": (control.get("recent_actions")
+                                       or [])[-3:],
+                }
         return row
 
     def _cache_fresh(self, now: float) -> Optional[Dict[str, Any]]:
@@ -338,6 +352,24 @@ class FleetMonitor:
                 f"{m['name']}:{r}" for m in ok
                 for r in (m.get("slo") or {}).get("burning", [])}),
         }
+        # controller rollup: one line answers "is the fleet self-driving
+        # and did anything flap" without opening every member's pane
+        control = {
+            "actions_total": sum(
+                int((m.get("control") or {}).get("actions_total", 0))
+                for m in ok),
+            "flaps": sum(
+                int((m.get("control") or {}).get("flaps", 0))
+                for m in ok),
+            "epoch_max": max(
+                [int((m.get("control") or {}).get("epoch", 0))
+                 for m in ok] or [0]),
+            "evicted": sorted({
+                f"{m['name']}:w{w}" for m in ok
+                for w in (m.get("control") or {}).get("evicted", [])}),
+            "members_armed": sum(
+                1 for m in ok if m.get("control") is not None),
+        }
         # per-group rollups: members whose registration card carries a
         # group id (aggregation-tree leaders) roll up side by side, so
         # one pane answers "which pod is behind" without PromQL
@@ -378,6 +410,7 @@ class FleetMonitor:
             "skew": skew,
             "groups": groups,
             "slo": slo,
+            "control": control,
             "labeled": labeled,
         }
 

@@ -259,6 +259,7 @@ class NumericsMonitor:
         self.pushes = 0
         self.nonfinite_frames_total = 0
         self.nonfinite_elems_total = 0
+        self.readmissions = 0
         self.last_grad_norm = 0.0
         self.norm_ewma: Optional[float] = None
         self._norm_samples = 0
@@ -512,6 +513,25 @@ class NumericsMonitor:
 
         record_event(name, **kw)
 
+    def readmit(self, worker: int) -> bool:
+        """Probation readmission (the control plane's verdict→action
+        loop): clear the worker's quarantine AND its offense count, so
+        its next pushes are validated on merit — one fresh non-finite
+        push re-quarantines it at ``quarantine_after`` offenses exactly
+        like a first offense. Returns False when the worker was not
+        quarantined. Counted in ``readmissions`` (the controller's
+        probation backoff is what keeps this from flapping)."""
+        if not 0 <= worker < self.num_workers:
+            return False
+        h = self._w[worker]
+        if not h.quarantined:
+            return False
+        h.quarantined = False
+        h.nonfinite = 0
+        self.readmissions += 1
+        self._record("numerics.readmit", worker=worker)
+        return True
+
     # -- read side --------------------------------------------------------
     def is_quarantined(self, worker: int) -> bool:
         return (0 <= worker < self.num_workers
@@ -565,6 +585,7 @@ class NumericsMonitor:
             "nonfinite_elems_total": self.nonfinite_elems_total,
             "quarantined": [w["worker"] for w in workers
                             if w["verdict"] == "quarantined"],
+            "readmissions": self.readmissions,
             "grad_norm": {"last": self.last_grad_norm,
                           "ewma": self.norm_ewma},
             "update_ratio": self.update_ratio,

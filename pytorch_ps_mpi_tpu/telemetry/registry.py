@@ -369,6 +369,19 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "reads_shed",
     "coalesce_hits",
     "reads_not_modified",
+    # self-driving control plane (control.Controller): all 0.0 when the
+    # controller is unarmed. control_actions counts executed controller
+    # actions (codec renegotiations, LR re-weights, evict/readmit,
+    # read-tier tuning); control_epoch is the current wire epoch (codec
+    # renegotiations since boot — the frame-fingerprint handshake's
+    # generation counter); control_evicted is the number of workers
+    # currently backoff-evicted from the sync barrier;
+    # control_lr_scale_min is the smallest per-worker staleness LR
+    # weight in force (1.0 = nobody de-weighted; 0.0 only when unarmed)
+    "control_actions",
+    "control_epoch",
+    "control_evicted",
+    "control_lr_scale_min",
 )
 
 #: The canonical-key subset the ``/health`` fleet rollup republishes
@@ -386,6 +399,8 @@ HEALTH_FLEET_ROLLUP_KEYS: Tuple[str, ...] = (
     "agg_mode",
     "decodes_per_publish",
     "agg_fallbacks",
+    "control_actions",
+    "control_epoch",
 )
 assert set(HEALTH_FLEET_ROLLUP_KEYS) <= set(PS_SERVER_METRIC_KEYS)
 
@@ -434,6 +449,7 @@ def ps_server_metrics(server) -> Dict[str, float]:
     nm = getattr(server, "numerics_monitor", None)
     lt = getattr(server, "lineage_tracker", None)
     sc = getattr(server, "serving_core", None)
+    cl = getattr(server, "controller", None)
     rm = sc.read_metrics() if (sc is not None and sc.armed) else {}
     # the transport's own worker-read path (TCP GET_PARAMS) counts too:
     # totals and cheap not-modified replies ride the same canonical keys
@@ -485,6 +501,13 @@ def ps_server_metrics(server) -> Dict[str, float]:
         "coalesce_hits": rm.get("coalesce_hits", 0.0),
         "reads_not_modified": (rm.get("reads_not_modified", 0.0)
                                + float(nat_nm)),
+        "control_actions": float(
+            cl.actions_total if cl is not None else 0.0),
+        "control_epoch": float(cl.epoch if cl is not None else 0.0),
+        "control_evicted": float(
+            len(cl.evicted) if cl is not None else 0.0),
+        "control_lr_scale_min": float(
+            cl.lr_scale_min() if cl is not None else 0.0),
     }
 
 
@@ -638,6 +661,15 @@ class PSServerTelemetry:
     #: the canonical ``reads_*`` metrics source), set by
     #: :class:`~pytorch_ps_mpi_tpu.serving.ServingCore` on construction
     serving_core: Optional[Any] = None
+    #: the attached self-driving controller (the ``control_*`` canonical
+    #: keys' source and ``/health``'s ``control`` section), set by
+    #: :class:`~pytorch_ps_mpi_tpu.control.Controller` — see
+    #: :mod:`pytorch_ps_mpi_tpu.control`
+    controller: Optional[Any] = None
+    #: old-epoch frames consumed during codec-renegotiation transitions
+    #: (``server.renegotiate_wire`` keeps the retiring wire accepted —
+    #: these frames would have been ``"config"`` rejections without it)
+    epoch_old_frames: int = 0
     #: the retained metrics history (``/history``'s source), set by
     #: :meth:`arm_observability` — see :mod:`.timeseries`
     timeseries_db: Optional[Any] = None
@@ -698,6 +730,11 @@ class PSServerTelemetry:
                 doc["serving"] = sc.serving_snapshot()
             if self.slo_watchdog is not None:
                 doc["slo"] = self.slo_watchdog.snapshot()
+            if self.controller is not None:
+                # the monitor-less route still reports the controller:
+                # action counts, eviction state, epoch — the pane a
+                # fleet poller rolls up
+                doc["control"] = self.controller.snapshot()
             if self.timeseries_db is not None:
                 doc["history"] = self.timeseries_db.snapshot()
             return json.dumps(doc)

@@ -241,7 +241,14 @@ def test_serve_tree_mode_weights_rounds_by_composed_count():
         "codec": "identity",
         "optim": "sgd", "hyper": {"lr": 0.1},
         "frame_check": True,
-        "tree": True, "tree_members": [5], "tree_slots": 3,
+        # BOTH pushers are declared members: with only the leader
+        # declared, the membership-dynamic barrier can legitimately
+        # complete a 1-member round before the fallback leaf's first
+        # frame is observed (arrival-order race), which turns the exact
+        # publish_version/round accounting below into a flake. Static
+        # membership pins the round structure; the dynamic-join path is
+        # exercised by the E2E tree tests.
+        "tree": True, "tree_members": [5, 0], "tree_slots": 3,
         "max_staleness": 10 ** 9,
     }
     from pytorch_ps_mpi_tpu.parallel.async_train import make_problem

@@ -61,6 +61,18 @@ def chaos_cfg(workdir: str) -> dict:
         "fault_plan": FAULT_PLAN,
         "fault_seed": 7,
         "fault_log_dir": os.path.join(workdir, "faults"),
+        # the control plane rides the chaos run (ISSUE 14): no ladder —
+        # this wire has no codec to renegotiate — but the staleness /
+        # evict / probation rules are live through every crash, respawn
+        # and server restart (each generation's serve() re-arms a
+        # controller; the action file appends across generations), so
+        # the chaos gate proves the controller never destabilizes
+        # recovery
+        "control": True,
+        "control_dir": os.path.join(workdir, "control"),
+        "control_kw": {"eval_every_s": 0.25, "warmup_s": 1.0,
+                       "cooldown_s": 1.0,
+                       "read_p95_target_ms": 250.0},
     }
 
 

@@ -132,6 +132,18 @@ def render_fleet(snap: Dict[str, Any],
         + (f"  BURNING: {','.join(slo.get('burning', []))}"
            if slo.get("burning") else "")
     )
+    ctl = snap.get("control") or {}
+    if ctl.get("members_armed"):
+        # fleet controller rollup: one line answers "is the fleet
+        # self-driving, did anything flap, who is evicted"
+        flaps = int(ctl.get("flaps", 0))
+        lines.append(
+            f"  control: {ctl.get('members_armed', 0)} armed  "
+            f"actions={int(ctl.get('actions_total', 0))}  "
+            f"flaps={flaps}{' (!)' if flaps else ''}  "
+            f"epoch={int(ctl.get('epoch_max', 0))}"
+            + (f"  evicted={','.join(ctl.get('evicted', []))}"
+               if ctl.get("evicted") else ""))
     for key, s in sorted((snap.get("skew") or {}).items()):
         flag = "SKEW" if s.get("flagged") else "ok"
         lines.append(
@@ -232,6 +244,49 @@ def _fmt_s(v: Optional[float]) -> str:
     return f"{v:.2f}s"
 
 
+def render_control(control: Dict[str, Any]) -> List[str]:
+    """The control pane lines from a ``/health`` ``control`` section
+    (pure — the testable core): action/flap counts, wire epoch +
+    ladder position, LR de-weights, eviction/probation state, read-tier
+    setpoints, and the last-action tail."""
+    ladder = control.get("ladder") or []
+    idx = control.get("ladder_idx", 0)
+    rung = (f"  wire={ladder[idx]}" if 0 <= idx < len(ladder) else "")
+    flaps = int(control.get("flaps", 0))
+    lines = [
+        f"control  actions={control.get('actions_total', 0)}  "
+        f"flaps={flaps}{' (!)' if flaps else ''}  "
+        f"epoch={control.get('epoch', 0)}"
+        f"{'*' if control.get('transition_active') else ''}{rung}  "
+        f"depth={control.get('admission_depth', 0)}  "
+        f"ring={control.get('ring', 0)}"
+        + ("  agg=SUSPENDED" if control.get("agg_suspended") else "")
+        + ("  pinned=" + ",".join(control["pinned"])
+           if control.get("pinned") else "")
+    ]
+    scales = {int(w): v for w, v in
+              (control.get("lr_scale") or {}).items() if v != 1.0}
+    bits = []
+    if scales:
+        bits.append("lr " + " ".join(
+            f"w{w}={v:.2f}" for w, v in sorted(scales.items())))
+    if control.get("evicted"):
+        bits.append("evicted " + ",".join(
+            f"w{w}" for w in control["evicted"]))
+    if control.get("probation"):
+        bits.append("probation " + ",".join(
+            f"w{w}" for w in control["probation"]))
+    if bits:
+        lines.append("  " + "  ".join(bits))
+    for a in (control.get("recent_actions") or [])[-3:]:
+        who = "" if a.get("worker") is None else f" w{a['worker']}"
+        lines.append(
+            f"  {a.get('rule')}.{a.get('action')}{who}: "
+            f"{a.get('old')} -> {a.get('new')} "
+            f"[{(a.get('verdict') or {}).get('kind')}]")
+    return lines
+
+
 def render_table(health: Dict[str, Any], sort: str = "worker",
                  color: bool = False) -> str:
     """One dashboard frame from a ``/health`` document (pure — the
@@ -288,6 +343,9 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
                 f"latest=v{t.get('latest', 0)}  "
                 f"refs_out={t.get('refs_out', 0)}"
             )
+    control = health.get("control")
+    if control:
+        lines.extend(render_control(control))
     cols = ["wk", "verdict", "cause", "grads", "inter-ewma", "inter-p95",
             "stale-ewma", "stale-x", "e2e-ms", "gnorm", "nan", "relerr",
             "anom", "gate-rounds", "gate-s", "retry", "reconn", "rej",

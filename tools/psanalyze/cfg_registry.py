@@ -208,6 +208,21 @@ CFG_KEYS = {
     "fleet_meta": CfgKey("dict", "internal",
                          "extra fleet-registration card fields (a tree "
                          "leader's group id + member ids)"),
+    # -- self-driving control plane (control.Controller) -------------------
+    "control": CfgKey("bool", "cli",
+                      "arm the verdict→action controller inside the "
+                      "serve loop (codec renegotiation, staleness LR "
+                      "weights, evict/readmit, read-tier tuning)"),
+    "control_kw": CfgKey("dict", "caller",
+                         "Controller knobs (ladder, cooldown_s, "
+                         "wire_hi/lo, probation_s, pin, ...) — see "
+                         "control.CONTROL_KNOBS"),
+    "control_dir": CfgKey("str", "caller",
+                          "control-plane directory: action rows "
+                          "(control-<name>.jsonl), replay input rows "
+                          "(timeseries-control-<name>.jsonl) and the "
+                          "worker-polled control-epoch.json (falls "
+                          "back to telemetry_dir)"),
     # -- parameter-serving read tier --------------------------------------
     "serving": CfgKey("bool", "caller",
                       "arm the snapshot ring/read tier without binding "

@@ -74,6 +74,10 @@ INSTRUMENT_MAP: Dict[str, Optional[str]] = {
     "reads_shed": "ps_reads_shed_total",
     "coalesce_hits": "ps_coalesce_hits_total",
     "reads_not_modified": "ps_reads_not_modified_total",
+    "control_actions": "ps_control_actions_total",
+    "control_epoch": "ps_control_epoch",
+    "control_evicted": "ps_control_evicted",
+    "control_lr_scale_min": "ps_control_lr_scale_min",
 }
 
 

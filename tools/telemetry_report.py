@@ -313,6 +313,47 @@ def _summarize_slo(rows: List[Dict[str, Any]]
     }
 
 
+def _summarize_actions(rows: List[Dict[str, Any]],
+                       flap_window_s: float = 10.0
+                       ) -> Optional[Dict[str, Any]]:
+    """The actions section: per-rule controller action counts, a flap
+    check (a double reversal on one (rule, worker) inside
+    ``flap_window_s`` — e.g. evict→readmit→evict — is a flap suspect),
+    and the last-action tail. Rows come from ``control-*.jsonl``
+    (``pytorch_ps_mpi_tpu.control``)."""
+    if not rows:
+        return None
+    per_rule: Dict[str, Dict[str, int]] = {}
+    hist: Dict[Any, List[Dict[str, Any]]] = {}
+    flaps: List[Dict[str, Any]] = []
+    # time order, not file-glob order: a sharded run contributes one
+    # control-*.jsonl per shard and the tail must show the NEWEST
+    # actions across all of them
+    rows = sorted(rows, key=lambda x: float(x.get("t", 0.0)))
+    for r in rows:
+        rule = str(r.get("rule"))
+        d = per_rule.setdefault(rule, {})
+        d[str(r.get("action"))] = d.get(str(r.get("action")), 0) + 1
+        key = (rule, r.get("worker"))
+        h = hist.setdefault(key, [])
+        if (len(h) >= 2
+                and float(r.get("t", 0.0)) - float(h[-2].get("t", 0.0))
+                < flap_window_s
+                and r.get("new") == h[-1].get("old")
+                and h[-1].get("new") == h[-2].get("old")):
+            flaps.append({"rule": rule, "worker": r.get("worker"),
+                          "t": r.get("t")})
+        h.append(r)
+        if len(h) > 4:
+            del h[0]
+    return {
+        "actions": len(rows),
+        "rules": [{"rule": k, **v} for k, v in sorted(per_rule.items())],
+        "flap_suspects": flaps,
+        "tail": rows[-16:],
+    }
+
+
 def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     """Merged summary over every file: per-span-name stats, event counts,
     and recorder meta (dropped counts make truncation visible)."""
@@ -326,6 +367,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     lineage_rows: List[Dict[str, Any]] = []
     ts_rows: List[Dict[str, Any]] = []
     slo_rows: List[Dict[str, Any]] = []
+    action_rows: List[Dict[str, Any]] = []
     profile_paths: List[str] = []
     for path in files:
         base = os.path.basename(path)
@@ -352,6 +394,21 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
                         continue
                     try:
                         slo_rows.append(json.loads(line))
+                    except ValueError:
+                        continue
+            continue
+        if base.startswith("control-") and path.endswith(".jsonl"):
+            # controller action rows (pytorch_ps_mpi_tpu.control) —
+            # routed to the actions section, never the span merge (the
+            # replay INPUT rows ride timeseries-control-*.jsonl and are
+            # routed with the other retained histories above)
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        action_rows.append(json.loads(line))
                     except ValueError:
                         continue
             continue
@@ -454,6 +511,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
         "history": _summarize_history(ts_rows),
         "profile": _summarize_profiles(profile_paths),
         "slo": _summarize_slo(slo_rows),
+        "actions": _summarize_actions(action_rows),
         "dropped_total": sum(m.get("dropped") or 0 for m in meta),
     }
 
@@ -622,6 +680,28 @@ def format_table(summary: Dict[str, Any]) -> str:
                 f"  {e.get('kind')} {e.get('rule')} "
                 f"burn_short={e.get('burn_short')} "
                 f"burn_long={e.get('burn_long')} t={e.get('t')}")
+    act = summary.get("actions")
+    if act:
+        lines.append("")
+        flap_txt = ("no flaps" if not act["flap_suspects"]
+                    else f"{len(act['flap_suspects'])} FLAP SUSPECT(S)")
+        lines.append(f"control actions ({act['actions']} total, "
+                     f"{flap_txt}):")
+        for r in act["rules"]:
+            counts = "  ".join(f"{k}={v}" for k, v in sorted(r.items())
+                               if k != "rule")
+            lines.append(f"  {r['rule']}: {counts}")
+        for a in act["tail"][-8:]:
+            who = ("" if a.get("worker") is None
+                   else f" w{a['worker']}")
+            lines.append(
+                f"  {a.get('rule')}.{a.get('action')}{who}: "
+                f"{a.get('old')} -> {a.get('new')} "
+                f"[{(a.get('verdict') or {}).get('kind')}] "
+                f"t={a.get('t')}")
+        for fl in act["flap_suspects"]:
+            lines.append(f"  FLAP: {fl['rule']} worker={fl['worker']} "
+                         f"t={fl['t']}")
     if summary["dropped_total"]:
         lines.append("")
         lines.append(
