@@ -17,6 +17,7 @@ test:
 	$(MAKE) obs-smoke
 	$(MAKE) tree-smoke
 	$(MAKE) control-smoke
+	$(MAKE) whatif-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -189,12 +190,27 @@ control-smoke:
 		--metric 'control_smoke.wall_total_s:lower:1.5' \
 		--metric 'control_smoke.loss_ratio:lower:0.5'
 
+# Round-anatomy what-if gate (in the default `make test` path): a
+# 3-worker sync run with 200 ms injected into worker 1's WIRE stage
+# (fault kind wire_delay — the sleep sits between the frame's
+# send_wall stamp and the bytes traveling) must be named by the
+# advisor: wire ranked #1, its debottleneck projection matching the
+# measured A/B round-time improvement within ±30%, the offline
+# reconstruction from persisted lineage rows agreeing with the live
+# engine, and the armed anatomy+lineage bookkeeping within the
+# standing ≤5% telemetry budget (the second command re-asserts the
+# recorder half). Appends a bench_gate trajectory row to
+# benchmarks/results/whatif_smoke.jsonl.
+whatif-smoke:
+	JAX_PLATFORMS=cpu python tools/whatif_smoke.py
+	python tools/telemetry_smoke.py
+
 # Static-analysis gate (in the default `make test` path): analyze_smoke
 # runs `python -m tools.psanalyze` on the tree (must be SILENT — the
-# five rules: thread-affinity, cfg-schema, metrics-surface,
-# codec-contract, abi-drift) and then proves each rule still fires on
-# its seeded defect (plus pragma suppression and a caught ASan
-# overflow). Appends a bench_gate trajectory row to
+# six rules: thread-affinity, cfg-schema, metrics-surface,
+# codec-contract, abi-drift, sidecar-registry) and then proves each
+# rule still fires on its seeded defect (plus pragma suppression and a
+# caught ASan overflow). Appends a bench_gate trajectory row to
 # benchmarks/results/analyze_smoke.jsonl gating analyze wall time.
 analyze:
 	python tools/analyze_smoke.py
@@ -265,4 +281,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke whatif-smoke

@@ -162,7 +162,27 @@ def _scrape(port: int) -> dict:
     return out
 
 
-def run_star(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
+def _anatomy_summary(m: dict) -> dict:
+    """Per-stage critical-path shares + the top advisor row from the
+    serve metrics' anatomy section (RESULTS.md's star-vs-tree table)."""
+    anat = m.get("anatomy")
+    if not anat:
+        return {}
+    top = (anat["advisor"][0] if anat.get("advisor") else {})
+    return {
+        "rounds": anat["rounds"],
+        "critical_shares": {c["stage"]: c["share"]
+                            for c in anat["critical_path"]},
+        "stage_p50_ms": {s: v["p50_ms"]
+                         for s, v in anat.get("stages", {}).items()},
+        "top_stage": top.get("stage"),
+        "top_debottleneck_frac": (top.get("debottleneck") or {}).get(
+            "saving_frac"),
+    }
+
+
+def run_star(n_workers: int, pushes: int, rtt_ms: float, timeout: float,
+             anatomy_dir=None):
     """Star baseline: every pusher ships compressed frames straight to
     the root, paying the DCN RTT."""
     from pytorch_ps_mpi_tpu.codecs import get_codec
@@ -175,6 +195,8 @@ def run_star(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
 
     cfg = dict(BASE_CFG)
     cfg["n_workers"] = n_workers
+    if anatomy_dir:
+        cfg.update(lineage=True, lineage_dir=anatomy_dir)
     _, params0, _, _ = make_problem(cfg)
     root = TcpPSServer(0, num_workers=n_workers, template=params0,
                        max_staleness=10 ** 9,
@@ -211,11 +233,13 @@ def run_star(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
         "frames_per_publish": m["grads_received"] / publishes,
         "decodes_per_publish": m["decodes_per_publish"],
         "agg_mode": m["agg_mode"],
+        "anatomy": _anatomy_summary(m),
         "wall_s": wall,
     }
 
 
-def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
+def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float,
+             anatomy_dir=None):
     """Tree leg: real leaders (one per pod) fold the pods' pushes and
     ship ONE compressed frame per round to the root over the emulated
     DCN; pod pushers ride the cheap intra-pod link (no RTT)."""
@@ -239,6 +263,10 @@ def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
                tree=True, tree_slots=SLOTS, metrics_port=0,
                tree_members=[leader_wid(n_workers, g)
                              for g in range(PODS)])
+    if anatomy_dir:
+        # root-side lineage + round anatomy: composed trailers expand
+        # the leader hops, the leaders' hop logs land beside the root's
+        cfg.update(lineage=True, lineage_dir=anatomy_dir)
     groups = group_plan(n_workers, group_size)
     assert len(groups) == PODS
     _, params0, _, _ = make_problem(cfg)
@@ -306,6 +334,7 @@ def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float):
                            for s in leader_stats],
         "leader_upstream_pushes": [
             s.get("ps_tree_upstream_pushes_total") for s in leader_stats],
+        "anatomy": _anatomy_summary(m),
         "wall_s": wall,
     }
 
@@ -317,21 +346,33 @@ def main(argv=None) -> int:
     ap.add_argument("--rtt-ms", type=float, default=4.0,
                     help="emulated DCN round trip (must be > 0: the "
                     "gate is only honest with a real DCN tax)")
+    ap.add_argument("--anatomy", action="store_true",
+                    help="arm root-side lineage + round anatomy per "
+                    "leg and record per-stage critical-path shares "
+                    "(RESULTS.md's star-vs-tree anatomy table)")
     ap.add_argument("--out", default=RESULTS)
     args = ap.parse_args(argv)
     assert args.rtt_ms > 0, "tree_bench requires a nonzero emulated RTT"
     pushes = 3 if args.quick else 8
     timeout = 240.0 if args.quick else 480.0
 
+    import tempfile
+
+    def _adir(tag):
+        return (tempfile.mkdtemp(prefix=f"tree_anatomy_{tag}_")
+                if args.anatomy else None)
+
     results = {"star": {}, "tree": {}}
     for n in (8, 64):
         print(f"== star  {n:3d} workers x {pushes} pushes "
               f"@ rtt {args.rtt_ms} ms", flush=True)
-        results["star"][n] = run_star(n, pushes, args.rtt_ms, timeout)
+        results["star"][n] = run_star(n, pushes, args.rtt_ms, timeout,
+                                      anatomy_dir=_adir(f"star{n}"))
         print("   ", {k: round(v, 3) if isinstance(v, float) else v
                       for k, v in results["star"][n].items()}, flush=True)
         print(f"== tree  {n:3d} workers ({PODS} pods)", flush=True)
-        results["tree"][n] = run_tree(n, pushes, args.rtt_ms, timeout)
+        results["tree"][n] = run_tree(n, pushes, args.rtt_ms, timeout,
+                                      anatomy_dir=_adir(f"tree{n}"))
         print("   ", {k: (round(v, 3) if isinstance(v, float) else v)
                       for k, v in results["tree"][n].items()}, flush=True)
 

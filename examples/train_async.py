@@ -523,23 +523,22 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     from pytorch_ps_mpi_tpu.telemetry import (
         clock_offsets_from_rows,
         export_chrome_trace,
+        is_sidecar,
         load_jsonl,
         load_lineage_rows,
     )
     from tools.telemetry_report import format_table, summarize
 
-    # faults-*.jsonl are injected-fault logs (resilience layer),
-    # beacon-*.jsonl are health-monitor side channels, numerics-*.jsonl
-    # are codec-fidelity/grad-norm trajectories, lineage-*.jsonl are
-    # per-version push compositions, timeseries-*.jsonl are retained
-    # metric histories, slo-*.jsonl are SLO verdict events, and
-    # control-*.jsonl are controller action rows — not flight-recorder
-    # files, so exclude them from the merged trace (telemetry_report's
-    # dir mode routes each to its own section)
+    # sidecar JSONLs (fault logs, beacons, numerics trajectories,
+    # lineage compositions, anatomy rounds, retained histories, SLO
+    # verdicts, controller actions) are not flight-recorder files: the
+    # shared SIDECAR_PREFIXES registry (pytorch_ps_mpi_tpu.telemetry)
+    # routes them away from the merged trace here AND from
+    # telemetry_report's dir-mode span merge — one list, enforced by
+    # psanalyze's sidecar-registry rule, instead of the two
+    # hand-patched copies every observability PR used to edit
     files = sorted(f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
-                   if not os.path.basename(f).startswith(
-                       ("faults-", "beacon-", "numerics-", "lineage-",
-                        "timeseries-", "slo-", "control-")))
+                   if not is_sidecar(f))
     events = []
     for f in files:
         events.extend(load_jsonl(f)[1])
@@ -553,14 +552,19 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
         device_trace_dir=device_trace_dir, device_t0_wall=device_t0_wall,
         lineage_rows=lineage_rows or None, clock_offsets=offsets,
     )
-    # the observability-plane artifacts join the printed report through
-    # their own sections (history/profile/slo), never the span merge
-    obs_files = sorted(
-        glob.glob(os.path.join(tdir, "timeseries-*.jsonl"))
-        + glob.glob(os.path.join(tdir, "slo-*.jsonl"))
-        + glob.glob(os.path.join(tdir, "control-*.jsonl"))
-        + glob.glob(os.path.join(tdir, "profile-*.txt")))
-    print(format_table(summarize(files + lineage_files + obs_files,
+    # every sidecar with a report route joins the printed report through
+    # its own section (numerics/lineage/anatomy/history/slo/actions),
+    # never the span merge — the same registry decides both directions
+    from pytorch_ps_mpi_tpu.telemetry import (
+        SIDECAR_PREFIXES,
+        sidecar_prefix,
+    )
+
+    section_files = sorted(
+        f for f in glob.glob(os.path.join(tdir, "*.jsonl"))
+        if SIDECAR_PREFIXES.get(sidecar_prefix(f) or "") is not None)
+    obs_files = sorted(glob.glob(os.path.join(tdir, "profile-*.txt")))
+    print(format_table(summarize(files + section_files + obs_files,
                                  by_worker=False)))
     out = {
         "telemetry_trace": trace_path,

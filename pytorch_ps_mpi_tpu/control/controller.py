@@ -12,7 +12,13 @@ ever touches a native transport handle):
 1. **codec / ``bucket_mb`` / agg-mode renegotiation** from the measured
    wire-vs-compute balance ("On the Utility of Gradient Compression":
    compression only wins in specific wire-vs-compute regimes, so the
-   regime is picked *online*). A renegotiation is an **epoch bump**
+   regime is picked *online*).  The regime inputs come from the
+   lineage-derived round-anatomy estimator
+   (:meth:`telemetry.anatomy.RoundAnatomy.regime_estimate`) whenever
+   lineage is armed — measured wire-stage times from frame
+   timestamps, immune to a worker whose beacons are off or skewed —
+   with the beacon-median fleet EWMAs as the fallback; the row's
+   ``regime_src`` records which source fed it. A renegotiation is an **epoch bump**
    executed through the PR 3 frame handshake: the server installs the
    new :class:`~pytorch_ps_mpi_tpu.parallel.dcn.CodecWire` beside the
    old one and accepts BOTH fingerprints during the transition
@@ -836,26 +842,42 @@ class Controller:
         hm = getattr(server, "health_monitor", None)
         nm = getattr(server, "numerics_monitor", None)
         lt = getattr(server, "lineage_tracker", None)
-        compute, wire = [], []
-        if hm is not None:
-            for h in hm._w:
-                if h.compute_ewma.value is not None:
-                    compute.append(h.compute_ewma.value)
-                if h.wire_ewma.value is not None:
-                    wire.append(h.wire_ewma.value)
+        an = getattr(server, "anatomy", None)
+        # wire-vs-compute regime: the lineage-derived round-anatomy
+        # estimator wins when armed and warmed — it measures the wire
+        # stage from frame timestamps (clock-corrected), so a worker
+        # whose BEACONS are off or skewed cannot hide a wire-bound
+        # fleet.  Beacon medians are the fallback.  Either way the
+        # numbers land in THIS persisted row, so replay consumes the
+        # estimator's output byte-identically without knowing which
+        # source produced it.
+        est = an.regime_estimate() if an is not None else None
+        if est is not None:
+            row["compute_s"] = float(est["compute_s"])
+            row["wire_s"] = float(est["wire_s"])
+            row["regime_src"] = 1.0  # 1 = lineage anatomy, 0 = beacons
+        else:
+            compute, wire = [], []
+            if hm is not None:
+                for h in hm._w:
+                    if h.compute_ewma.value is not None:
+                        compute.append(h.compute_ewma.value)
+                    if h.wire_ewma.value is not None:
+                        wire.append(h.wire_ewma.value)
 
-        def _med(xs):
-            # fleet MEDIAN, not mean: one compute-bound straggler must
-            # not mask a wire-bound fleet (the same robustness argument
-            # as the diagnosis layer's median+MAD gates) — the codec
-            # rule picks the regime for the FLEET
-            s = sorted(xs)
-            n = len(s)
-            return (s[n // 2] if n % 2
-                    else 0.5 * (s[n // 2 - 1] + s[n // 2])) if s else 0.0
+            def _med(xs):
+                # fleet MEDIAN, not mean: one compute-bound straggler
+                # must not mask a wire-bound fleet (the same robustness
+                # argument as the diagnosis layer's median+MAD gates) —
+                # the codec rule picks the regime for the FLEET
+                s = sorted(xs)
+                n = len(s)
+                return (s[n // 2] if n % 2
+                        else 0.5 * (s[n // 2 - 1] + s[n // 2])) if s else 0.0
 
-        row["compute_s"] = _med(compute)
-        row["wire_s"] = _med(wire)
+            row["compute_s"] = _med(compute)
+            row["wire_s"] = _med(wire)
+            row["regime_src"] = 0.0
         respawns = getattr(server, "_supervisor_respawns", None) or {}
         for w in range(self.num_workers):
             if lt is not None and lt._w[w].stale_win:

@@ -355,6 +355,17 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                     elif kind == "delay":
                         inj.fire(f)
                         time.sleep(float(f.get("delay_ms", 100.0)) / 1e3)
+                    elif kind == "wire_delay":
+                        # emulated wire latency: the transport sleeps
+                        # AFTER sealing the frame (send_wall stamped),
+                        # so the delay lands in the lineage wire stage
+                        # — unlike "delay", which inflates produce
+                        inj.fire(f)
+                        wd = float(f.get("delay_ms", 100.0)) / 1e3
+                        if hasattr(w, "set_wire_delay"):
+                            w.set_wire_delay(wd)
+                        else:
+                            w._wire_delay_s = wd
                     elif kind == "drop":
                         inj.fire(f)
                         drop = True
@@ -375,14 +386,19 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                         else:
                             w._tamper = tamper
             if drop:
-                # a dropped push cannot also be corrupted: disarm any
-                # tamper armed this step, or it would leak onto the NEXT
-                # step's push (logged under the wrong step) — the fault
-                # deterministically never fires instead
+                # a dropped push cannot also be corrupted or
+                # wire-delayed: disarm any one-shot hooks armed this
+                # step, or they would leak onto the NEXT step's push
+                # (logged under the wrong step) — the faults
+                # deterministically never fire instead
                 if hasattr(w, "set_tamper"):
                     w.set_tamper(None)
                 else:
                     w._tamper = None
+                if hasattr(w, "set_wire_delay"):
+                    w.set_wire_delay(0.0)
+                else:
+                    w._wire_delay_s = 0.0
             # one measured path for recorder spans AND health beacons:
             # durations are taken once and shared (explicit ts/dur events
             # are exactly what rec.span records)
@@ -611,6 +627,19 @@ def serve(
     critical-path rows, and the snapshot rides the returned metrics as
     ``lineage``. Requires ``frame_check`` (the trace ID rides the frame
     header); skipped with a printed notice otherwise.
+
+    Round anatomy (``telemetry.anatomy``): armed automatically with
+    lineage (``cfg["anatomy"]`` defaults to ``"auto"``; ``False`` opts
+    out) — every published version is decomposed into its exact
+    stage-level critical path (produce / encode / wire / leader-fold /
+    root-fold / optimizer-publish, clock-skew-corrected, composed
+    trailers expanding tree hops) with Coz-style what-if projections,
+    written as ``anatomy-server.jsonl`` rounds. The ``anatomy_*``
+    canonical keys join the metrics/scrape/TSDB surfaces, ``/health``
+    gains an ``anatomy`` section, the controller's wire-vs-compute
+    regime inputs switch to the lineage-derived estimator, and the
+    final snapshot (incl. the ranked advisor) rides the returned
+    metrics as ``anatomy``.
 
     Parameter serving (:mod:`pytorch_ps_mpi_tpu.serving`): the loop now
     sits on a :class:`~pytorch_ps_mpi_tpu.serving.ServingCore` that owns
@@ -1356,6 +1385,12 @@ def serve(
     if lint is not None:
         m["lineage"] = lint.snapshot()
         lint.close()
+    if core.anatomy is not None:
+        # the round-anatomy section: per-stage critical-path shares and
+        # the ranked what-if advisor (projected round-time savings) —
+        # what tools/whatif_smoke.py gates and RESULTS.md tabulates
+        m["anatomy"] = core.anatomy.snapshot()
+        core.anatomy.close()
     if ctl is not None:
         snap = ctl.snapshot()
         # zero-frame-loss accounting for codec renegotiations: every

@@ -796,6 +796,7 @@ class ShmPSWorker:
         # is computed from THIS side's config — drift fails the compare
         self.frame = bool(frame)
         self._tamper = None  # one-shot outgoing-bytes hook (fault injection)
+        self._wire_delay_s = 0.0  # one-shot post-seal delay (wire_delay)
         # monotonic push sequence for the frame trace ID — the fallback
         # when the caller doesn't pass an explicit lineage=(step, seq)
         self._auto_seq = 0
@@ -914,6 +915,13 @@ class ShmPSWorker:
             # so the CRC no longer matches what travels
             t, self._tamper = self._tamper, None
             t(flat.view(np.uint8))
+        d, self._wire_delay_s = self._wire_delay_s, 0.0
+        if d:
+            # fault injection (kind "wire_delay"): emulated wire latency
+            # — the frame is sealed (send_wall stamped at the encode
+            # site) but the bytes travel late, exactly the window the
+            # lineage wire stage measures
+            time.sleep(d)
         deadline = time.time() + timeout
         while time.time() < deadline:
             rc = self._lib.psq_push_grad(

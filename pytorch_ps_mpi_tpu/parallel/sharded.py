@@ -153,6 +153,15 @@ def server_main(shard_id: int, n_shards: int, port: int,
         from pytorch_ps_mpi_tpu.telemetry.lineage import LineageTracker
 
         tracker = LineageTracker(server, cfg, name=f"shard{shard_id}")
+        if cfg.get("anatomy", "auto") not in (False, "off", 0):
+            # per-shard round anatomy (same auto-with-lineage rule as
+            # serve()): anatomy-shard<i>.jsonl rows + the anatomy_*
+            # canonical keys on this shard's endpoint — a sharded
+            # fleet's per-shard critical paths stay separable
+            from pytorch_ps_mpi_tpu.telemetry.anatomy import RoundAnatomy
+
+            tracker.anatomy = RoundAnatomy(server, cfg,
+                                           name=f"shard{shard_id}")
 
     # per-shard read tier (the ServingCore extraction's point): each
     # shard serves ITS slice under a per-tenant namespace — no trainer
@@ -337,6 +346,8 @@ def server_main(shard_id: int, n_shards: int, port: int,
         if ctl is not None:
             ctl.close()
         if tracker is not None:
+            if tracker.anatomy is not None:
+                tracker.anatomy.close()
             tracker.close()
         server.close()
 

@@ -642,6 +642,7 @@ class TcpPSWorker:
         # is computed from THIS side's config — drift fails the compare
         self.frame = bool(frame)
         self._tamper = None  # one-shot outgoing-bytes hook (fault injection)
+        self._wire_delay_s = 0.0  # one-shot post-seal delay (wire_delay)
         # monotonic push sequence for the frame trace ID — the fallback
         # when the caller doesn't pass an explicit lineage=(step, seq)
         self._auto_seq = 0
@@ -759,6 +760,12 @@ class TcpPSWorker:
             # so the CRC no longer matches what travels
             t, self._tamper = self._tamper, None
             t(flat.view(np.uint8))
+        d, self._wire_delay_s = self._wire_delay_s, 0.0
+        if d:
+            # fault injection (kind "wire_delay"): emulated wire latency
+            # — sealed (send_wall stamped) but traveling late, the
+            # window the lineage wire stage measures
+            time.sleep(d)
         rc = self._lib.tps_worker_push_grad(
             self._h, _u8(flat.view(np.uint8)), flat.nbytes, version,
             int(timeout * 1000),

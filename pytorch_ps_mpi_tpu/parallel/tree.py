@@ -454,6 +454,11 @@ def leader_main(upstream: Sequence[str], group_id: int,
             state["partial_rounds"] += 1
         log.row({
             "kind": "hop", "leader": int(group_id), "round": rounds,
+            # the upstream-facing worker id this hop pushes as — the
+            # root's composed push meta carries it, so offline round
+            # anatomy can join hop rows to root rounds by EITHER the
+            # wid or the composed trace IDs
+            "leader_wid": int(lid),
             "up_seq": up_seq, "t": time.time(),
             "composed": entries, "versions": v_up,
             "fold_s": round(fold_s, 6), "encode_s": round(enc_s, 6),

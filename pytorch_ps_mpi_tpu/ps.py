@@ -1840,7 +1840,11 @@ class MPI_PS:
             self.params, self.opt_state, self.codec_state, microbatches, rng
         )
         if profile:
-            out, _ = self._profiled_call(call, data)
+            out, _ = self._profiled_call(
+                call, data,
+                lowered=lambda: self._compiled[key].lower(
+                    self.params, self.opt_state, self.codec_state,
+                    microbatches, rng).as_text())
         else:
             out = call()
         if self.numerics:
@@ -2013,7 +2017,11 @@ class MPI_PS:
                 self.params, self.opt_state, self.codec_state, batch, rng, *extra
             )
             if profile:
-                out, split = self._profiled_call(call, data)
+                out, split = self._profiled_call(
+                    call, data,
+                    lowered=lambda: fn.lower(
+                        self.params, self.opt_state, self.codec_state,
+                        batch, rng, *extra).as_text())
             else:
                 out = call()
             if self.numerics:
@@ -2045,7 +2053,11 @@ class MPI_PS:
                 self.params, self.opt_state, self.codec_state, grads, rng
             )
             if profile:
-                out, split = self._profiled_call(call, data)
+                out, split = self._profiled_call(
+                    call, data,
+                    lowered=lambda: fn.lower(
+                        self.params, self.opt_state, self.codec_state,
+                        grads, rng).as_text())
             else:
                 out = call()
             if self.numerics:
@@ -2070,13 +2082,17 @@ class MPI_PS:
         self._record_step("ps.step", data)
         return loss, data
 
-    def _profiled_call(self, call, data: Dict[str, float]):
+    def _profiled_call(self, call, data: Dict[str, float], lowered=None):
         """Run one compiled fused step under the JAX profiler and fill the
         reference's ``comm_wait`` (``ps.py:162``) with the program's real
-        per-device mean collective time (VERDICT r2 item 6)."""
+        per-device mean collective time (VERDICT r2 item 6).  ``lowered``
+        (a lazy lowered-text provider) arms the launch-counter fallback
+        for participant counting — ``bucketing.count_collectives`` over
+        the lowered program backstops a trace with no per-lane
+        attribution at all."""
         from pytorch_ps_mpi_tpu.utils.tracing import profiled_device_split
 
-        out, split = profiled_device_split(call)
+        out, split = profiled_device_split(call, lowered=lowered)
         data["comm_wait"] = split["comm_s"]
         data["profile_device_busy"] = split["device_busy_s"]
         data["profile_compute"] = split["compute_s"]

@@ -15,6 +15,12 @@ A **fault plan** is a JSON-able list of entries::
   ===============  ========================================================
   ``drop``         the worker computes but skips the push (one lost grad)
   ``delay``        sleep ``delay_ms`` (default 100) before the push
+  ``wire_delay``   sleep ``delay_ms`` (default 100) INSIDE the push,
+                   after the frame is sealed (its ``send_wall`` stamp is
+                   taken) and before the bytes travel — emulated wire
+                   latency the lineage/anatomy layers must attribute to
+                   the WIRE stage, where ``delay`` lands in produce
+                   (``tools/whatif_smoke.py``'s injected bottleneck)
   ``duplicate``    push the same gradient twice with the same version tag
   ``corrupt``      XOR-flip ``corrupt_bytes`` (default 8) payload bytes —
                    deterministic positions from (seed, fault id); detected
@@ -58,8 +64,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
-FAULT_KINDS = ("drop", "delay", "duplicate", "corrupt", "nan",
-               "crash_worker", "crash_server")
+FAULT_KINDS = ("drop", "delay", "wire_delay", "duplicate", "corrupt",
+               "nan", "crash_worker", "crash_server")
 
 #: Exit code of an injected worker crash (``os._exit``) — distinguishable
 #: from a clean exit (0) and from real crashes in logs, treated like any

@@ -100,6 +100,14 @@ class ResilientWorker:
         if self._w is not None:
             self._w._tamper = fn
 
+    def set_wire_delay(self, delay_s: float) -> None:
+        """One-shot post-seal push delay (fault kind ``wire_delay``):
+        forwarded to the current transport — the sleep runs between the
+        frame's ``send_wall`` stamp and the bytes traveling, so the
+        lineage wire stage measures it."""
+        if self._w is not None:
+            self._w._wire_delay_s = float(delay_s)
+
     def _backoff(self, attempt: int) -> None:
         d = min(self.backoff_max, self.backoff_base * (2.0 ** attempt))
         d *= 1.0 + self.jitter * self._rng.random()

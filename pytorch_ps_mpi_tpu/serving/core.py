@@ -105,6 +105,7 @@ class ServingCore:
         self.health = None
         self.numerics = None
         self.lineage = None
+        self.anatomy = None
         self.metrics_http_port: Optional[int] = None
         if server is not None:
             server.serving_core = self
@@ -233,6 +234,19 @@ class ServingCore:
                 # attaches itself to server.lineage_tracker: framed_poll
                 # feeds it every consumed push's trace ID
                 self.lineage = LineageTracker(server, cfg)
+                anat = cfg.get("anatomy", "auto")
+                if anat not in (False, "off", 0):
+                    # the round-anatomy causal profiler rides armed
+                    # lineage by default ("auto"): exact per-round
+                    # critical paths + the what-if advisor, fed one
+                    # publish row per version by the tracker; opt out
+                    # with cfg["anatomy"] = False / "off"
+                    from pytorch_ps_mpi_tpu.telemetry.anatomy import (
+                        RoundAnatomy,
+                    )
+
+                    self.anatomy = RoundAnatomy(server, cfg)
+                    self.lineage.anatomy = self.anatomy
             else:
                 # the trace ID rides the v2 frame header — without
                 # frames there is nothing on the wire to trace

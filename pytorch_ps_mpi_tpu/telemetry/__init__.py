@@ -38,6 +38,14 @@ dicts). One system, three faces:
   quarantines non-finite pushes with a skip/zero/abort policy, and
   writes divergence postmortems.
 
+- :mod:`anatomy <.anatomy>` — the layer that makes the streams
+  ACTIONABLE: :class:`RoundAnatomy` reconstructs every published
+  version's causal DAG from the lineage rows (clock-offset-corrected,
+  composed trailers expanding tree hops), extracts the exact per-round
+  critical path with stage-level decomposition (produce / encode /
+  wire / leader-fold / root-fold / optimizer-publish), and computes
+  Coz-style what-if projections ("stage X 20% faster ⇒ round time
+  −Y%") — live over the serve loop and offline over persisted rows.
 - :mod:`timeseries <.timeseries>` — the layer that makes the streams
   RETAINED: :class:`MetricsHistory`, a dependency-free in-process TSDB
   (raw + 1 s/10 s/60 s downsampled rings per canonical metric key,
@@ -62,6 +70,54 @@ summary table; ``make telemetry-smoke`` bounds the enabled-recorder
 overhead against the disabled path; ``make obs-smoke`` gates the
 observability plane end-to-end.
 """
+
+from typing import Dict, Optional
+
+#: The ONE registry of JSONL sidecar prefixes written under the
+#: telemetry directory.  A "sidecar" is any structured side channel that
+#: is NOT a flight-recorder event log (``server.jsonl`` /
+#: ``worker-N.jsonl``): its rows have no recorder name/kind, so letting
+#: one into the recorder-span merge corrupts the trace and the report.
+#: Every observability PR used to patch the exclusion list in TWO
+#: hand-maintained places (``tools/telemetry_report.py`` dir mode and
+#: ``examples/train_async._export_telemetry``); both now route through
+#: this map, and ``tools/psanalyze``'s ``sidecar-registry`` rule makes
+#: an UNDECLARED prefix a lint failure instead of a live-run surprise.
+#:
+#: prefix → report route: the ``tools/telemetry_report.py`` section the
+#: file feeds (``None`` = operator-facing raw log with no report
+#: section — excluded from report collection entirely).
+SIDECAR_PREFIXES: Dict[str, Optional[str]] = {
+    "faults-": None,          # injected-fault event logs (resilience)
+    "beacon-": None,          # worker health beacons (diagnosis tails)
+    "numerics-": "numerics",  # grad-norm trajectories + fidelity probes
+    "lineage-": "lineage",    # per-version push compositions + hop rows
+    "anatomy-": "anatomy",    # round-anatomy critical-path rows
+    "timeseries-": "history",  # retained metric history (TSDB)
+    "slo-": "slo",            # SLO verdict events
+    "control-": "actions",    # controller action rows
+}
+
+
+def sidecar_prefix(path: str) -> Optional[str]:
+    """The declared sidecar prefix of a telemetry-dir ``.jsonl`` file
+    name/path, or None for recorder files (``server.jsonl``,
+    ``worker-N.jsonl``) and anything else."""
+    import os as _os
+
+    base = _os.path.basename(path)
+    if not base.endswith(".jsonl"):
+        return None
+    for p in SIDECAR_PREFIXES:
+        if base.startswith(p):
+            return p
+    return None
+
+
+def is_sidecar(path: str) -> bool:
+    """True when the file must stay OUT of the recorder-span merge."""
+    return sidecar_prefix(path) is not None
+
 
 from pytorch_ps_mpi_tpu.telemetry.recorder import (
     FlightRecorder,
@@ -128,8 +184,21 @@ from pytorch_ps_mpi_tpu.telemetry.fleet import (
     parse_prometheus_text,
     register_endpoint,
 )
+from pytorch_ps_mpi_tpu.telemetry.anatomy import (
+    RoundAnatomy,
+    anatomy_from_round_rows,
+    anatomy_from_rows,
+    load_anatomy_rows,
+)
 
 __all__ = [
+    "SIDECAR_PREFIXES",
+    "sidecar_prefix",
+    "is_sidecar",
+    "RoundAnatomy",
+    "anatomy_from_round_rows",
+    "anatomy_from_rows",
+    "load_anatomy_rows",
     "FlightRecorder",
     "configure",
     "disable",

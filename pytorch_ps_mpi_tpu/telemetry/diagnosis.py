@@ -487,6 +487,12 @@ class HealthMonitor:
             # the lineage section: exact e2e/staleness distributions,
             # composition counters, stage-level critical paths
             out["lineage"] = lt.snapshot()
+        an = getattr(self.server, "anatomy", None)
+        if an is not None:
+            # the anatomy section: per-round stage decomposition,
+            # critical-path shares, the ranked what-if advisor — the
+            # pane ps_top renders and the report tabulates
+            out["anatomy"] = an.snapshot()
         sc = getattr(self.server, "serving_core", None)
         if sc is not None and sc.armed:
             # the serving section: snapshot-ring occupancy, read queue

@@ -57,6 +57,7 @@ _SUM_KEYS: Dict[str, str] = {
     "slo_breaches": "ps_slo_breaches_all_total",
     "tree_composed": "ps_tree_composed_total",
     "control_actions": "ps_control_actions_total",
+    "anatomy_rounds": "ps_anatomy_rounds_total",
 }
 
 #: gauges rolled up as the fleet max (worst member)
@@ -65,6 +66,11 @@ _MAX_KEYS: Dict[str, str] = {
     "push_e2e_p95_ms": "ps_push_e2e_p95_ms",
     "read_p95_ms": "ps_read_p95_ms",
     "decodes_per_publish": "ps_decodes_per_publish",
+    # the worst member's wire-gated critical-path share: a tree where
+    # ONE pod's hop is wire-bound shows up here even when the fleet sum
+    # looks healthy (per-hop cost attribution, DynamiQ's lesson)
+    "anatomy_wire_share": "ps_anatomy_wire_share",
+    "anatomy_top_saving_frac": "ps_anatomy_top_saving_frac",
 }
 
 #: per-member gauges the skew detector compares across shards

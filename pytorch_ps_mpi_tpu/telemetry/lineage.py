@@ -222,6 +222,10 @@ class LineageTracker:
         self._rows_since_flush = 0
         self._h_e2e = None
         self._h_wire = None
+        #: the attached round-anatomy engine (telemetry.anatomy) — fed
+        #: one publish row per published version; None when unarmed
+        #: (one None-check per publish)
+        self.anatomy = None
         if server is not None:
             server.lineage_tracker = self
             self.register(server.scrape_registry())
@@ -317,6 +321,12 @@ class LineageTracker:
         if len(pushes) >= 2:
             self._observe_round(row)
         self.overhead_s += time.perf_counter() - t0
+        if self.anatomy is not None:
+            # the round-anatomy engine decomposes the SAME row this
+            # tracker just wrote — exact critical paths and what-if
+            # projections from the one causal record (self-timed there,
+            # deliberately outside this tracker's overhead clock)
+            self.anatomy.observe_publish(row)
         return row
 
     def _observe_round(self, publish_row: Dict[str, Any]) -> None:

@@ -336,6 +336,16 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "lineage_pushes",
     "push_e2e_p50_ms",
     "push_e2e_p95_ms",
+    # round anatomy (telemetry.anatomy.RoundAnatomy): all 0.0 when
+    # anatomy is unarmed. anatomy_rounds counts published versions
+    # decomposed into exact stage-level critical paths;
+    # anatomy_wire_share is the fraction of those rounds gated by the
+    # wire stage (the controller's regime signal, measured not
+    # estimated); anatomy_top_saving_frac is the advisor's best
+    # projected round-time saving at a 20% Coz-style virtual speedup
+    "anatomy_rounds",
+    "anatomy_wire_share",
+    "anatomy_top_saving_frac",
     # homomorphic aggregation (Codec.aggregate + the CodecWire
     # aggregator): agg_mode is 1.0 while the serve loop folds pushes
     # into a compressed accumulator (0.0 unarmed); decodes_per_publish
@@ -448,6 +458,7 @@ def ps_server_metrics(server) -> Dict[str, float]:
         units = 1.0 if jax.tree.leaves(server.template) else 0.0
     nm = getattr(server, "numerics_monitor", None)
     lt = getattr(server, "lineage_tracker", None)
+    an = getattr(server, "anatomy", None)
     sc = getattr(server, "serving_core", None)
     cl = getattr(server, "controller", None)
     rm = sc.read_metrics() if (sc is not None and sc.armed) else {}
@@ -493,6 +504,11 @@ def ps_server_metrics(server) -> Dict[str, float]:
             lt.e2e_ms_quantile(0.50) if lt is not None else 0.0),
         "push_e2e_p95_ms": float(
             lt.e2e_ms_quantile(0.95) if lt is not None else 0.0),
+        "anatomy_rounds": float(an.rounds if an is not None else 0.0),
+        "anatomy_wire_share": float(
+            an.wire_share() if an is not None else 0.0),
+        "anatomy_top_saving_frac": float(
+            an.top_saving_frac() if an is not None else 0.0),
         "reads_total": rm.get("reads_total", 0.0) + float(nat_total),
         "read_p50_ms": rm.get("read_p50_ms", 0.0),
         "read_p95_ms": rm.get("read_p95_ms", 0.0),
@@ -657,6 +673,11 @@ class PSServerTelemetry:
     #: step, seq, staleness, send/recv walls, decode_s), refreshed by
     #: ``framed_poll`` on every successful pop
     last_push_meta: Optional[Dict[str, Any]] = None
+    #: the attached round-anatomy engine (exact per-round critical
+    #: paths + what-if advisor — the ``anatomy_*`` canonical keys'
+    #: source), set by :class:`~pytorch_ps_mpi_tpu.telemetry.anatomy.
+    #: RoundAnatomy` when lineage is armed — see :mod:`.anatomy`
+    anatomy: Optional[Any] = None
     #: the attached parameter-serving core (snapshot ring + read tier +
     #: the canonical ``reads_*`` metrics source), set by
     #: :class:`~pytorch_ps_mpi_tpu.serving.ServingCore` on construction
@@ -735,6 +756,10 @@ class PSServerTelemetry:
                 # action counts, eviction state, epoch — the pane a
                 # fleet poller rolls up
                 doc["control"] = self.controller.snapshot()
+            if self.anatomy is not None:
+                # the monitor-less route still reports the round
+                # anatomy: critical-path shares + the what-if advisor
+                doc["anatomy"] = self.anatomy.snapshot()
             if self.timeseries_db is not None:
                 doc["history"] = self.timeseries_db.snapshot()
             return json.dumps(doc)

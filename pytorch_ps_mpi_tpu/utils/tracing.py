@@ -108,6 +108,60 @@ def _iter_hlo_events(trace_dir: str):
                     yield dev, str(e.name), float(e.start_ns or 0.0), dur
 
 
+def _participant_lanes(events):
+    """The execution lanes that PARTICIPATED in the traced program.
+
+    An HLO collective instruction name is unique within its module
+    (SSA), so the set of lanes (devices / executor threads) that
+    emitted an execution event for it is exactly the collective's
+    participant set — the same number the lowered program's
+    collective-launch counters (``bucketing.count_collectives``, one
+    launch executed once per participant) predict.  Counting distinct
+    LANES (not events) stays correct when a loop executes the same
+    collective several times per lane.  With no collective events,
+    every lane counts.
+
+    Returns ``(participant_lanes, all_lanes)``; callers restrict the
+    comm/compute interval math to the participants so host-side result
+    -fetch programs (which also carry ``hlo_op`` stats on jax 0.4.x
+    CPU) cannot dilute the per-device means."""
+    by_name: Dict[str, set] = {}
+    lanes_all = set()
+    for dev, name, _start, _dur in events:
+        lanes_all.add(dev)
+        if any(s in name.lower() for s in _COMM_SUBSTRINGS):
+            by_name.setdefault(name, set()).add(dev)
+    if by_name:
+        widest = max(by_name.values(), key=len)
+        return set(widest), lanes_all
+    return set(lanes_all), lanes_all
+
+
+def _launch_derived_devices(events, lowered) -> int:
+    """Fallback participant count when the trace carries NO per-lane
+    attribution at all (every event on one merged lane): divide the
+    trace's collective-event count by the lowered program's
+    collective-launch count (``bucketing.count_collectives``) — one
+    launch executes once per participant, so for a single traced run
+    ``events / launches`` IS the participant count.  Returns 0 when it
+    cannot be derived (no lowered text, no collectives)."""
+    if lowered is None:
+        return 0
+    try:
+        text = lowered() if callable(lowered) else lowered
+        from pytorch_ps_mpi_tpu.bucketing import count_collectives
+
+        launches = int(count_collectives(text)["total"])
+    except Exception:
+        return 0
+    if launches <= 0:
+        return 0
+    comm_events = sum(
+        1 for _dev, name, _s, _d in events
+        if any(s in name.lower() for s in _COMM_SUBSTRINGS))
+    return comm_events // launches if comm_events >= launches else 0
+
+
 def profiled_overlap(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]]:
     """Run ``thunk()`` once under the profiler and measure how much of
     the communication time actually EXECUTES CONCURRENTLY with compute —
@@ -135,9 +189,13 @@ def profiled_overlap(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]]:
             jax.block_until_ready(out)
         finally:
             jax.profiler.stop_trace()
+        events = list(_iter_hlo_events(d))
+        lanes, _all = _participant_lanes(events)
         comm_iv: Dict[Any, list] = collections.defaultdict(list)
         comp_iv: Dict[Any, list] = collections.defaultdict(list)
-        for dev, name, start, dur in _iter_hlo_events(d):
+        for dev, name, start, dur in events:
+            if dev not in lanes:
+                continue  # host-side fetch lane, not a participant
             tgt = comm_iv if any(
                 s in name.lower() for s in _COMM_SUBSTRINGS
             ) else comp_iv
@@ -174,7 +232,9 @@ def profiled_overlap(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]]:
         shutil.rmtree(d, ignore_errors=True)
 
 
-def profiled_device_split(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]]:
+def profiled_device_split(
+    thunk: Callable[[], Any], *, lowered=None,
+) -> Tuple[Any, Dict[str, Any]]:
     """Run ``thunk()`` once under the JAX profiler and split *device* op
     time into communication vs compute.
 
@@ -191,6 +251,17 @@ def profiled_device_split(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]
     ``devices`` and the ``top_ops`` time sinks. Empty split (zeros,
     ``devices=0``) when the backend emits no device events (some
     remote/tunneled backends do not support tracing).
+
+    ``devices`` is the measured PARTICIPANT count: the lanes that
+    executed the program's collectives (per-device planes on real
+    backends, per-executor-thread lines on XLA:CPU where jax 0.4.x
+    attributes no ``device_ordinal``).  ``lowered`` — the lowered
+    program text, or a zero-arg callable producing it — arms the
+    launch-counter fallback: on a build whose trace carries NO per-lane
+    attribution at all, the participant count is derived as collective
+    trace events over lowered collective launches
+    (``bucketing.count_collectives``) instead of being misreported
+    as 1.
     """
     d = tempfile.mkdtemp(prefix="jaxtrace_")
     try:
@@ -200,14 +271,25 @@ def profiled_device_split(thunk: Callable[[], Any]) -> Tuple[Any, Dict[str, Any]
             jax.block_until_ready(out)
         finally:
             jax.profiler.stop_trace()
+        events = list(_iter_hlo_events(d))
+        lanes, _all = _participant_lanes(events)
         per_dev: Dict[Any, list] = collections.defaultdict(lambda: [0.0, 0.0])
         top: collections.Counter = collections.Counter()
-        for dev, name, _start, dur in _iter_hlo_events(d):
+        for dev, name, _start, dur in events:
+            if dev not in lanes:
+                continue  # host-side fetch lane, not a participant
             per_dev[dev][1] += dur
             top[name] += dur
             if any(s in name.lower() for s in _COMM_SUBSTRINGS):
                 per_dev[dev][0] += dur
         ndev = len(per_dev)
+        if ndev == 1:
+            est = _launch_derived_devices(events, lowered)
+            if est > 1:
+                # merged-lane trace: the interval sums cover every
+                # participant already, so the launch-derived count is
+                # both the honest ``devices`` and the mean denominator
+                ndev = est
         scale = 1e9 * max(1, ndev)
         comm = sum(v[0] for v in per_dev.values()) / scale
         busy = sum(v[1] for v in per_dev.values()) / scale

@@ -118,7 +118,11 @@ def iter_plane_events(
     path: str,
 ) -> Iterator[Tuple[str, str, float, float, Dict[str, Any]]]:
     """Yield ``(plane_name, event_name, start_ns, dur_ns, stats)`` for
-    every event in every plane of one ``xplane.pb`` file."""
+    every event in every plane of one ``xplane.pb`` file.  The line
+    (execution thread) the event sits on rides ``stats["_line"]`` —
+    XLA:CPU runs each virtual device's program on its own executor
+    thread, so on builds whose events carry no ``device_ordinal`` stat
+    the line is the only per-participant attribution the trace has."""
     with open(path, "rb") as f:
         space = f.read()
     for fno, wt, plane_buf in _fields(space):
@@ -141,9 +145,15 @@ def iter_plane_events(
         stat_names = _metadata_names(smd_raw)
         for line_buf in lines:
             t0_ns = 0
+            line_id = 0
+            line_name = ""
             events: List[bytes] = []
             for lf, lw, lv in _fields(line_buf):
-                if lf == 3 and lw == _WT_VARINT:
+                if lf == 1 and lw == _WT_VARINT:
+                    line_id = lv
+                elif lf == 2 and lw == _WT_LEN:
+                    line_name = lv.decode("utf-8", "replace")
+                elif lf == 3 and lw == _WT_VARINT:
                     t0_ns = lv
                 elif lf == 4 and lw == _WT_LEN:
                     events.append(lv)
@@ -159,21 +169,29 @@ def iter_plane_events(
                         dur_ps = evv
                     elif ef == 4 and ew == _WT_LEN:
                         ev_stats.append(evv)
+                stats = _event_stats(ev_stats, stat_names)
+                stats["_line"] = line_name or str(line_id)
                 yield (
                     plane_name,
                     event_names.get(mid, str(mid)),
                     t0_ns + offset_ps / 1e3,
                     dur_ps / 1e3,
-                    _event_stats(ev_stats, stat_names),
+                    stats,
                 )
 
 
 def iter_hlo_events(path: str):
-    """The ``_iter_hlo_events`` contract from one file: ``(device, name,
+    """The ``_iter_hlo_events`` contract from one file: ``(lane, name,
     start_ns, dur_ns)`` for device op executions (events carrying an
-    ``hlo_op`` stat)."""
+    ``hlo_op`` stat).  The lane is the ``device_ordinal`` stat where
+    the build provides one, else the (plane, line) pair — on jax
+    0.4.x's XLA:CPU the events carry no per-device stat but each
+    virtual device executes on its own ``tf_XLATfrtCpuClient/*``
+    thread line, so the line IS the participant."""
     for plane, name, start_ns, dur_ns, stats in iter_plane_events(path):
         if dur_ns <= 0 or "hlo_op" not in stats:
             continue
-        dev = stats.get("device_ordinal", plane)
+        dev = stats.get("device_ordinal")
+        if dev is None:
+            dev = f"{plane}/{stats.get('_line', '')}"
         yield dev, name, float(start_ns), float(dur_ns)

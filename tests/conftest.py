@@ -76,18 +76,15 @@ TIER1_SLOW = (
 # -> triage note. All pre-existing at the PR 9 seed (verified on clean
 # HEAD, 2026-08-03); none regressed by this PR.
 TIER1_XFAIL = {
-    "tests/test_ps.py::test_profile_step_fills_trace_derived_comm_split":
-        "pre-existing: profile-derived collective split sees 1 "
-        "participant, expected 8 — jax 0.4.37's CPU trace does not "
-        "attribute collective events per virtual device",
-    "tests/test_ps.py::test_profile_step_accumulate":
-        "pre-existing: same root cause as "
-        "test_profile_step_fills_trace_derived_comm_split (profiler "
-        "participant count 1.0 != 8 on jax 0.4.37 CPU)",
-    "tests/test_overlap.py::test_profiled_overlap_invariants_on_real_psum_program":
-        "pre-existing: profiled psum program reports 1 participant, "
-        "expected 8 — same jax 0.4.37 CPU profiler limitation as the "
-        "test_ps profile tests",
+    # The three "CPU profiler participant-count" entries (test_ps
+    # profile tests x2, test_overlap) were burned down in ISSUE 15:
+    # jax 0.4.37's CPU trace events carry no device_ordinal stat, but
+    # each virtual device executes on its own XLine — the xplane
+    # fallback reader now attributes lanes per line, and
+    # utils/tracing counts participants as the lanes that executed the
+    # program's collectives (with a lowered collective-launch-counter
+    # fallback, bucketing.count_collectives, for traces with no
+    # per-lane attribution at all).
     "tests/test_ep.py::test_moe_grads_match_dense_oracle":
         "pre-existing: shard_map(check_rep=True) on jax 0.4.37 cannot "
         "statically infer out_specs replication for the MoE dispatch; "

@@ -1,0 +1,497 @@
+"""Round anatomy: exact critical paths, skew-proof stage durations,
+what-if projections, the lineage-derived controller estimator, and the
+anatomy surfaces (canonical keys / /health / report / ps_top / sidecar
+registry).
+
+The causal contract under test: every decomposed round's stages are
+non-negative whatever the worker clocks do, degraded rounds bill their
+gap to the barrier wait (never a phantom measured stage), composed tree
+pushes expand into leader-hop segments, and a virtual speedup of a
+stage that is never on the critical path projects ~zero saving while
+the real bottleneck projects the measured one.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.telemetry.anatomy import (
+    ANATOMY_KNOBS,
+    SPEEDUP_STAGES,
+    STAGES,
+    RoundAnatomy,
+    anatomy_from_rows,
+    anatomy_path,
+    load_anatomy_rows,
+)
+
+
+def make_rows(n_rounds=16, workers=3, wire_ms=(5.0, 200.0, 5.0),
+              produce_ms=50.0, t0=1000.0, apply_s=0.001,
+              skew_s=(0.0, 0.0, 0.0), start_version=1):
+    """Synthetic sync-barrier lineage publish rows: per-worker constant
+    wire latency (+ optional clock skew added to that worker's
+    send_wall stamps — its clock runs AHEAD by ``skew_s``)."""
+    rows = []
+    t = t0
+    for i in range(n_rounds):
+        v = start_version + i
+        pushes = []
+        for w in range(workers):
+            send_true = t + produce_ms / 1e3
+            recv = send_true + wire_ms[w] / 1e3
+            pushes.append({
+                "worker": w, "step": v, "seq": v,
+                "send_wall": send_true + skew_s[w], "recv_wall": recv,
+                "staleness": 0, "bytes": 128, "decode_s": 0.0005,
+            })
+        t_pub = max(p["recv_wall"] for p in pushes) + apply_s
+        rows.append({"kind": "publish", "version": v, "t": t_pub,
+                     "apply_s": apply_s, "pushes": pushes})
+        t = t_pub
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# decomposition + critical path
+# ---------------------------------------------------------------------------
+
+def test_wire_bottleneck_gates_and_ranks_first():
+    eng = anatomy_from_rows(make_rows())
+    assert eng.rounds == 16
+    # warmup round aside, the wire-delayed worker gates every round
+    assert eng.critical.get("wire", 0) >= eng.rounds - 1
+    adv = eng.advisor()
+    assert adv[0]["stage"] == "wire"
+    # the debottleneck projection: pulling w1's 200ms wire to the 5ms
+    # fleet median removes ~195ms of a ~256ms round
+    frac = adv[0]["debottleneck"]["saving_frac"]
+    assert 0.55 <= frac <= 0.95, frac
+    # a stage never on the critical path projects ~nothing
+    assert eng.whatif("root_fold", 0.5)["saving_frac"] < 0.02
+    assert eng.debottleneck("produce")["saving_frac"] < 0.02
+
+
+def test_whatif_virtual_speedup_is_bounded_and_monotone():
+    eng = anatomy_from_rows(make_rows())
+    s10 = eng.whatif("wire", 0.1)["saving_frac"]
+    s20 = eng.whatif("wire", 0.2)["saving_frac"]
+    s50 = eng.whatif("wire", 0.5)["saving_frac"]
+    assert 0.0 <= s10 <= s20 <= s50 <= 1.0
+    # speeding the gating wire by 20% saves ~20% of its 200ms share
+    assert s20 == pytest.approx(0.2 * 0.200 / 0.2565, rel=0.25)
+    with pytest.raises(ValueError):
+        eng.whatif("barrier", 0.2)  # the residual is not speedup-able
+
+
+def test_whatif_cuts_per_push_not_per_worker():
+    """An async/aggregated publish can compose SEVERAL pushes from one
+    worker: each push's segment must be cut by its own amount (a
+    worker-keyed cut would bill the last push's cut to all of them)."""
+    # one worker, two pushes in one round: wire 0.5s (gating) and 0.1s
+    rows = [{"kind": "publish", "version": 1, "t": 1000.0,
+             "apply_s": 0.001, "pushes": [
+                 {"worker": 0, "step": 0, "seq": 0, "send_wall": 999.0,
+                  "recv_wall": 999.5, "staleness": 0, "bytes": 1,
+                  "decode_s": 0.0},
+                 {"worker": 0, "step": 1, "seq": 1, "send_wall": 999.8,
+                  "recv_wall": 999.9, "staleness": 0, "bytes": 1,
+                  "decode_s": 0.0}]}]
+    eng = anatomy_from_rows(rows)
+    rec = eng._rounds[0]
+    # gate arrives at 0.9s into the round (recv 999.9, start at min
+    # send 999.0); a 20% wire speedup moves the 0.5s push by 0.1s and
+    # the 0.1s push by 0.02s — the new gate is the 0.5s push's 0.4s
+    # arrival vs the late push's 0.88s, so the saving is 0.02s
+    new_s = eng._project_round(rec, "wire", frac=0.2)
+    assert new_s == pytest.approx(rec["round_s"] - 0.02, abs=1e-6)
+    # 100% speedup: both wires vanish; the late push still arrives at
+    # send-time offset 0.8s — saving is exactly its 0.1s wire
+    new_s = eng._project_round(rec, "wire", frac=1.0)
+    assert new_s == pytest.approx(rec["round_s"] - 0.1, abs=1e-6)
+
+
+def test_negative_clock_skew_never_yields_negative_stages():
+    """Worker clocks running AHEAD of the server (send_wall > recv_wall)
+    must not produce negative stage durations: the lower-envelope shift
+    engages exactly when the envelope proves skew."""
+    rows = make_rows(skew_s=(0.0, 10.0, -3.0))
+    eng = anatomy_from_rows(rows)
+    assert eng.rounds == 16
+    for rec in eng._rounds:
+        for p in rec["pushes"]:
+            for st, v in p["segs"].items():
+                assert v is None or v >= 0.0, (st, v)
+        for st, v in rec["stages"].items():
+            assert v is None or v >= 0.0, (st, v)
+    offs = eng.snapshot()["clock_offsets"]
+    # w1's envelope proves its clock is ~10s ahead (recv-send ≈ -10)
+    assert offs[1] < -9.0
+    # w2's clock is BEHIND (recv-send ≈ +3 + latency): a positive
+    # envelope is trusted, never "corrected" into the wire stage
+    assert offs[2] > 2.9
+
+
+def test_positive_envelope_keeps_constant_latency_in_wire():
+    """A genuinely slow (but unskewed) link must not have its constant
+    latency absorbed by the offset fit — only a NEGATIVE envelope
+    engages correction."""
+    eng = anatomy_from_rows(make_rows(wire_ms=(5.0, 200.0, 5.0)))
+    w1_wire = [v for (w, st), win in eng._stage_win.items()
+               if w == 1 and st == "wire" for v in win]
+    assert w1_wire and min(w1_wire) > 0.18  # the 200ms stays measured
+
+
+def test_degraded_round_bills_barrier_not_phantom_stage():
+    """A round that waited on a dead member (huge publish gap, small
+    measured segments) is attributed to the barrier wait."""
+    rows = make_rows(n_rounds=4, wire_ms=(5.0, 6.0, 7.0))
+    # round 5: a leader crash stalls the barrier 8s; the surviving
+    # pushes' own segments stay milliseconds
+    t_prev = rows[-1]["t"]
+    pushes = []
+    for w in range(3):
+        send = t_prev + 8.0 + 0.05
+        pushes.append({"worker": w, "step": 9, "seq": 9,
+                       "send_wall": send, "recv_wall": send + 0.005,
+                       "staleness": 0, "bytes": 128, "decode_s": 0.0005})
+    rows.append({"kind": "publish", "version": 5, "t": t_prev + 8.06,
+                 "apply_s": 0.001, "pushes": pushes})
+    eng = anatomy_from_rows(rows)
+    last = eng._rounds[-1]
+    assert last["stage"] == "barrier"
+    assert last["stages"]["barrier"] > 5.0
+    # the barrier share is visible but the advisor never projects on it
+    assert "barrier" not in {a["stage"] for a in eng.advisor()}
+
+
+def test_supervisor_restart_generations_still_decompose():
+    """Lineage rows from TWO server generations (a supervisor restart:
+    version jump, fresh server clock anchor mid-file) must still yield
+    complete critical paths for every round on both sides."""
+    gen0 = make_rows(n_rounds=6)
+    # generation 1 resumes at a jumped version, later wall clock
+    gen1 = make_rows(n_rounds=6, t0=gen0[-1]["t"] + 30.0,
+                     start_version=40)
+    eng = anatomy_from_rows(gen0 + gen1)
+    assert eng.rounds == 12
+    # the restart-gap round bills the gap to the barrier residual (the
+    # generation was down), not to any phantom measured stage
+    gap_round = eng._rounds[6]
+    assert gap_round["stages"]["barrier"] > 20.0
+    assert gap_round["stage"] == "barrier"
+    # every OTHER round has a complete wire-gated critical path
+    others = [r for i, r in enumerate(eng._rounds) if i != 6]
+    assert sum(1 for r in others if r["stage"] == "wire") >= 10
+
+
+# ---------------------------------------------------------------------------
+# tree topology: composed trailers expand leader hops
+# ---------------------------------------------------------------------------
+
+def _tree_rows(n_rounds=8, hop_rows=True):
+    """Root publish rows whose pushes are LEADER hops carrying composed
+    trailers, plus the leaders' own hop rows (fold/encode measured)."""
+    rows = []
+    t = 1000.0
+    for i in range(n_rounds):
+        v = i + 1
+        pushes = []
+        for g, lid in enumerate((8, 9)):  # two leaders
+            origin = [{"worker": 4 * g + k, "step": v, "seq": v,
+                       "send_wall": t + 0.040 + 0.002 * k}
+                      for k in range(4)]
+            send = t + 0.040 + 0.006 + 0.015  # fold+encode at the leader
+            recv = send + (0.120 if g == 0 else 0.008)  # g0: slow DCN
+            pushes.append({"worker": lid, "step": v, "seq": v,
+                           "send_wall": send, "recv_wall": recv,
+                           "staleness": 0, "bytes": 512,
+                           "decode_s": 0.001, "composed": origin})
+            if hop_rows:
+                rows.append({"kind": "hop", "leader": g,
+                             "leader_wid": lid, "round": i, "up_seq": i,
+                             "t": send, "composed": origin,
+                             "fold_s": 0.006, "encode_s": 0.009,
+                             "push_s": 0.001})
+        t_pub = max(p["recv_wall"] for p in pushes) + 0.002
+        rows.append({"kind": "publish", "version": v, "t": t_pub,
+                     "apply_s": 0.002, "pushes": pushes})
+        t = t_pub
+    return rows
+
+
+def test_tree_composed_pushes_expand_into_hop_segments():
+    eng = anatomy_from_rows(_tree_rows())
+    assert eng.rounds == 8
+    # the slow DCN hop gates the rounds
+    assert eng.critical.get("wire", 0) >= 7
+    # hop rows carved the measured re-encode out of the fold window
+    enc = [v for (w, st), win in eng._stage_win.items()
+           if st == "encode" for v in win]
+    fold = [v for (w, st), win in eng._stage_win.items()
+            if st == "leader_fold" for v in win]
+    assert enc and all(abs(v - 0.009) < 1e-6 for v in enc)
+    assert fold and all(abs(v - 0.006) < 1e-6 for v in fold)
+    adv = eng.advisor()
+    assert adv[0]["stage"] == "wire"
+    stages = {a["stage"] for a in adv}
+    assert {"leader_fold", "encode"} <= stages
+
+
+def test_tree_without_hop_rows_falls_back_to_trailer_bound():
+    """Root-side-only data (live mode): the leader fold window is
+    bounded from the trailer's newest origin send — still non-negative,
+    still attributed to leader_fold, no encode invented."""
+    eng = anatomy_from_rows(_tree_rows(hop_rows=False))
+    fold = [v for (w, st), win in eng._stage_win.items()
+            if st == "leader_fold" for v in win]
+    assert fold and all(0.0 <= v <= 0.03 for v in fold)
+    assert not any(st == "encode" for (w, st) in eng._stage_win)
+
+
+def test_leader_crash_round_attributes_barrier():
+    """A tree round that stalled on a crashed leader (the survivor's
+    push arrives, the round completes seconds later degraded) bills the
+    stall to the barrier wait."""
+    rows = _tree_rows(n_rounds=3)
+    pubs = [r for r in rows if r["kind"] == "publish"]
+    t_prev = pubs[-1]["t"]
+    # degraded round: ONE leader contributes, published 6s late
+    origin = [{"worker": k, "step": 9, "seq": 9,
+               "send_wall": t_prev + 5.95 + 0.001 * k} for k in range(4)]
+    push = {"worker": 8, "step": 9, "seq": 9,
+            "send_wall": t_prev + 5.97, "recv_wall": t_prev + 5.99,
+            "staleness": 0, "bytes": 512, "decode_s": 0.001,
+            "composed": origin}
+    rows.append({"kind": "publish", "version": 9, "t": t_prev + 6.0,
+                 "apply_s": 0.002, "pushes": [push]})
+    eng = anatomy_from_rows(rows)
+    last = eng._rounds[-1]
+    assert last["stage"] == "barrier"
+    assert last["stages"]["barrier"] > 4.0
+
+
+# ---------------------------------------------------------------------------
+# live engine + surfaces
+# ---------------------------------------------------------------------------
+
+class FakeServer:
+    pass
+
+
+def _fake_server():
+    from pytorch_ps_mpi_tpu.telemetry.registry import PSServerTelemetry
+
+    class Fake(PSServerTelemetry):
+        num_workers = 3
+        max_staleness = 4
+        version = 3
+        wire = None
+        template = {"w": np.zeros(4, np.float32)}
+        grads_received = 0
+        bytes_received = 0
+        stale_drops = 0
+        staleness_seen = {}
+
+    return Fake()
+
+
+def test_live_tracker_feeds_anatomy_and_canonical_keys(tmp_path):
+    """LineageTracker → RoundAnatomy wiring: publish rows feed the
+    engine, the canonical anatomy_* keys + scrape instruments answer on
+    any PSServerTelemetry server, and the sidecar file lands."""
+    from pytorch_ps_mpi_tpu.telemetry.lineage import LineageTracker
+    from pytorch_ps_mpi_tpu.telemetry.registry import PS_SERVER_METRIC_KEYS
+
+    server = _fake_server()
+    cfg = {"lineage_dir": str(tmp_path)}
+    lt = LineageTracker(server, cfg)
+    an = RoundAnatomy(server, cfg)
+    lt.anatomy = an
+    assert server.anatomy is an
+    t = 100.0
+    for v in range(4, 12):
+        for w in range(3):
+            send = t + 0.01
+            recv = send + (0.15 if w == 1 else 0.004)
+            lt.observe_consume({
+                "worker": w, "step": v, "seq": v, "version_read": v - 1,
+                "staleness": 0, "bytes": 64,
+                "send_wall": send, "recv_wall": recv,
+                "decode_s": 0.0005})
+        t = t + 0.17
+        lt.observe_publish(version=v, apply_s=0.001,
+                           workers=[0, 1, 2], now=t)
+    assert an.rounds == 8
+    m = server.metrics()
+    assert set(PS_SERVER_METRIC_KEYS) <= set(m)
+    assert m["anatomy_rounds"] == 8.0
+    assert m["anatomy_wire_share"] > 0.8
+    assert m["anatomy_top_saving_frac"] > 0.05
+    text = server.prometheus_text()
+    assert "ps_anatomy_rounds_total 8" in text
+    assert 'ps_anatomy_stage_share{stage="wire"}' in text
+    assert 'ps_anatomy_whatif_saving_frac{stage="wire"}' in text
+    an.close()
+    lt.close()
+    rows = load_anatomy_rows(anatomy_path(str(tmp_path), "server"))
+    assert len(rows) == 8
+    assert all(r["kind"] == "round" for r in rows)
+    # the live rows reproduce offline from the lineage file too
+    lrows = [json.loads(line) for line in
+             open(tmp_path / "lineage-server.jsonl")]
+    off = anatomy_from_rows(lrows)
+    assert off.rounds == 8
+    assert off.advisor()[0]["stage"] == an.advisor()[0]["stage"]
+
+
+def test_controller_prefers_lineage_estimator(tmp_path):
+    """The controller's input row sources wire_s/compute_s from the
+    anatomy regime estimate when armed+warm (regime_src 1.0), and falls
+    back to beacon medians otherwise (regime_src 0.0).  Replay over the
+    persisted rows stays byte-identical either way — the estimator's
+    outputs ride the rows."""
+    from pytorch_ps_mpi_tpu.control import Controller
+    from pytorch_ps_mpi_tpu.telemetry.timeseries import (
+        load_timeseries_rows,
+    )
+
+    server = _fake_server()
+    server.last_seen = {}
+    cfg = {"control_dir": str(tmp_path), "telemetry_dir": str(tmp_path)}
+    ctl = Controller(server, cfg)
+    # no anatomy: beacon fallback
+    row = ctl._input_row(100.0)
+    assert row["regime_src"] == 0.0
+    # armed + warmed anatomy: the lineage-derived estimator wins
+    an = RoundAnatomy(server, cfg, min_rounds=2)
+    for rec in make_rows(n_rounds=4, wire_ms=(40.0, 40.0, 40.0),
+                         produce_ms=10.0):
+        an.observe_publish(rec)
+    row = ctl._input_row(101.0)
+    assert row["regime_src"] == 1.0
+    assert row["wire_s"] == pytest.approx(0.040, rel=0.2)
+    assert row["compute_s"] < row["wire_s"]
+    # engine determinism: replay over the persisted input rows derives
+    # the identical action sequence (none here — the point is parity)
+    ctl.tick(now=102.0)
+    ctl.close()
+    rows = load_timeseries_rows(
+        os.path.join(str(tmp_path), "timeseries-control-server.jsonl"))
+    assert rows and rows[-1]["m"]["regime_src"] == 1.0
+    replayed = Controller.replay(rows, num_workers=3, cfg=cfg)
+    assert replayed == []
+
+
+def test_regime_estimate_needs_both_sides():
+    """A tree root only sees composed hops — produce is the origin
+    side's story and never fills here — so a wire-only window must NOT
+    produce an estimate (it would read as wire_frac 1.0 and drive the
+    codec rule to maximum compression on compute it cannot see): the
+    controller falls back to beacon medians instead."""
+    eng = anatomy_from_rows(_tree_rows())
+    assert eng.rounds >= int(ANATOMY_KNOBS["min_rounds"])
+    assert eng.regime_estimate() is None
+    # direct pushes fill both sides: the estimator answers
+    assert anatomy_from_rows(make_rows()).regime_estimate() is not None
+
+
+def test_round_rows_replay_matches_live_engine(tmp_path):
+    """anatomy_from_round_rows over the engine's own persisted rows
+    reproduces the live advisor (the report's preferred path)."""
+    from pytorch_ps_mpi_tpu.telemetry.anatomy import anatomy_from_round_rows
+
+    live = RoundAnatomy(num_workers=3, cfg={"lineage_dir": str(tmp_path)})
+    for rec in make_rows(n_rounds=7):
+        live.observe_publish(rec)
+    live.close()
+    rows = load_anatomy_rows(anatomy_path(str(tmp_path), "server"))
+    off = anatomy_from_round_rows(rows)
+    assert off.rounds == live.rounds
+    assert off.critical == live.critical
+    a_live, a_off = live.advisor(), off.advisor()
+    assert [a["stage"] for a in a_off] == [a["stage"] for a in a_live]
+    assert (a_off[0]["debottleneck"]["saving_frac"]
+            == pytest.approx(a_live[0]["debottleneck"]["saving_frac"],
+                             rel=1e-6))
+
+
+def test_health_and_ps_top_render_anatomy(tmp_path):
+    from pytorch_ps_mpi_tpu.telemetry.diagnosis import HealthMonitor
+    from tools.ps_top import render_anatomy, render_table
+
+    server = _fake_server()
+    mon = HealthMonitor(server, {"health": True})
+    an = RoundAnatomy(server, {})
+    for rec in make_rows(n_rounds=6):
+        an.observe_publish(rec)
+    doc = json.loads(mon.render_json())
+    assert doc["anatomy"]["rounds"] == 6
+    assert doc["anatomy"]["advisor"][0]["stage"] == "wire"
+    frame = render_table(doc)
+    assert "anatomy  rounds=6" in frame
+    assert "whatif [wire]" in frame
+    lines = render_anatomy(doc["anatomy"])
+    assert any("debottleneck saves" in ln for ln in lines)
+    # the monitor-less /health route carries the section too
+    server2 = _fake_server()
+    an2 = RoundAnatomy(server2, {})
+    for rec in make_rows(n_rounds=3):
+        an2.observe_publish(rec)
+    doc2 = json.loads(server2.health_json())
+    assert doc2["armed"] is False
+    assert doc2["anatomy"]["rounds"] == 3
+
+
+def test_report_anatomy_section_and_sidecar_routing(tmp_path):
+    """anatomy-*.jsonl routes to the report's anatomy section (never the
+    span merge), driven by the shared SIDECAR_PREFIXES registry."""
+    from pytorch_ps_mpi_tpu.telemetry import (
+        SIDECAR_PREFIXES,
+        is_sidecar,
+        sidecar_prefix,
+    )
+    from tools.telemetry_report import collect_files, format_table, summarize
+
+    assert sidecar_prefix("anatomy-server.jsonl") == "anatomy-"
+    assert is_sidecar("/x/y/lineage-leader3.jsonl")
+    assert sidecar_prefix("worker-2.jsonl") is None
+    assert sidecar_prefix("server.jsonl") is None
+    assert SIDECAR_PREFIXES["beacon-"] is None  # raw log: no section
+
+    an = RoundAnatomy(num_workers=3, cfg={"lineage_dir": str(tmp_path)})
+    for rec in make_rows(n_rounds=5):
+        an.observe_publish(rec)
+    an.close()
+    # a beacon file (routeless sidecar) must not be collected at all
+    with open(tmp_path / "beacon-0.jsonl", "w") as f:
+        f.write('{"step": 0}\n')
+    files = collect_files([str(tmp_path)])
+    assert not any("beacon-" in f for f in files)
+    assert any("anatomy-" in f for f in files)
+    summary = summarize(files)
+    anat = summary["anatomy"]
+    assert anat["rounds"] == 5
+    assert anat["advisor"][0]["stage"] == "wire"
+    txt = format_table(summary)
+    assert "round anatomy (5 rounds decomposed)" in txt
+    assert "what-if advisor" in txt
+    # no anatomy rows but lineage rows present: the section rebuilds
+    # offline from the lineage file
+    summary2 = summarize([])
+    assert summary2["anatomy"] is None
+
+
+def test_anatomy_knob_overrides_and_bounded_windows():
+    an = RoundAnatomy(num_workers=2, window=4, stage_window=8)
+    rows = make_rows(n_rounds=12, workers=2, wire_ms=(5.0, 80.0))
+    for rec in rows:
+        an.observe_publish(rec)
+    assert an.rounds == 12           # counters keep counting
+    assert len(an._rounds) == 4      # projections replay a bounded window
+    for win in an._stage_win.values():
+        assert len(win) <= 8
+    assert set(STAGES) >= set(an.critical)
+    assert set(SPEEDUP_STAGES) == set(ANATOMY_KNOBS and SPEEDUP_STAGES)

@@ -111,7 +111,7 @@ def test_clean_tree_has_no_findings(repo_ctx):
 
     findings = [f for rule in all_rules() for f in rule.run(repo_ctx)
                 if not repo_ctx.suppressed(f)]
-    assert len(all_rules()) == 5
+    assert len(all_rules()) == 6
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
@@ -181,6 +181,68 @@ def test_cfg_schema_flags_unknown_key(tmp_path):
     assert len(typos) == 1 and typos[0].line == 3
     assert not any("frame_check" in f.message and "not declared"
                    in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# sidecar registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY_SRC = (
+    "SIDECAR_PREFIXES = {'beacon-': None, 'lineage-': 'lineage'}\n"
+)
+
+
+def test_sidecar_registry_flags_undeclared_prefix(tmp_path):
+    from tools.psanalyze.rules.sidecar_registry import SidecarRegistryRule
+
+    ctx = make_tree(tmp_path, {
+        "pytorch_ps_mpi_tpu/telemetry/__init__.py": _REGISTRY_SRC,
+        "pytorch_ps_mpi_tpu/telemetry/rogue.py": (
+            "import os\n"
+            "def path(d, name):\n"
+            "    return os.path.join(d, f'rogue-{name}.jsonl')\n"),
+    })
+    findings = SidecarRegistryRule().run(ctx)
+    hits = [f for f in findings if '"rogue-"' in f.message]
+    assert len(hits) == 1 and hits[0].path.endswith("rogue.py")
+
+
+def test_sidecar_registry_accepts_declared_and_recorder_files(tmp_path):
+    from tools.psanalyze.rules.sidecar_registry import SidecarRegistryRule
+
+    ctx = make_tree(tmp_path, {
+        "pytorch_ps_mpi_tpu/telemetry/__init__.py": _REGISTRY_SRC,
+        "pytorch_ps_mpi_tpu/telemetry/ok.py": (
+            "def paths(d, w):\n"
+            "    a = f'lineage-leader{w}.jsonl'\n"   # declared prefix
+            "    b = f'worker-{w}.jsonl'\n"          # recorder file
+            "    c = 'server.jsonl'\n"               # no dash: not a sidecar
+            "    d2 = '*.jsonl'\n"
+            "    return a, b, c, d2\n"),
+    })
+    assert SidecarRegistryRule().run(ctx) == []
+
+
+def test_sidecar_registry_flags_reverted_consumer(tmp_path):
+    """A consumer site that stops referencing the registry (the
+    hand-maintained list sneaking back) is a finding."""
+    from tools.psanalyze.rules.sidecar_registry import SidecarRegistryRule
+
+    ctx = make_tree(tmp_path, {
+        "pytorch_ps_mpi_tpu/telemetry/__init__.py": _REGISTRY_SRC,
+        "tools/telemetry_report.py": (
+            "EXCLUDE = ('faults-', 'beacon-')\n"),
+    })
+    findings = SidecarRegistryRule().run(ctx)
+    assert any("no longer consumes" in f.message
+               and f.path == "tools/telemetry_report.py"
+               for f in findings)
+
+
+def test_sidecar_registry_real_tree_clean(repo_ctx):
+    from tools.psanalyze.rules.sidecar_registry import SidecarRegistryRule
+
+    assert SidecarRegistryRule().run(repo_ctx) == []
 
 
 # ---------------------------------------------------------------------------

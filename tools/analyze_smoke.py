@@ -6,12 +6,13 @@ stopped looking. This smoke runs the suite both ways:
 
 1. **clean tree** — ``python -m tools.psanalyze`` over the repo must
    exit 0 with zero findings;
-2. **seeded defects** — for each of the five static rules, a temp copy
+2. **seeded defects** — for each of the six static rules, a temp copy
    of the tree gets exactly the defect class the rule exists for (an
    off-thread native call, a typo'd cfg key, a canonical metric key
    dropped from the schema, a codec claiming an algebra it doesn't
-   implement, a shrunk PSF2 header) and the rule must fire nonzero on
-   it — plus one pragma-suppression check proving the allowlist works;
+   implement, a shrunk PSF2 header, an undeclared telemetry sidecar
+   prefix) and the rule must fire nonzero on it — plus one
+   pragma-suppression check proving the allowlist works;
 3. **sanitizer leg** — a deliberately out-of-bounds C snippet built
    with the ASan flags from ``utils/native.SANITIZE_FLAGS`` must be
    caught at runtime (the wiring ``make native-asan`` relies on
@@ -75,6 +76,14 @@ SEEDS = {
         "native/tcpps.cpp",
         "constexpr size_t kPsfHeader = 36;",
         "constexpr size_t kPsfHeader = 32;",
+    ),
+    # a new sidecar JSONL written under the telemetry dir WITHOUT a
+    # SIDECAR_PREFIXES declaration — the exact "leaks into the
+    # recorder-span merge" bug class the rule exists for
+    "sidecar-registry": (
+        "pytorch_ps_mpi_tpu/telemetry/lineage.py",
+        'return os.path.join(lineage_dir, f"lineage-{name}.jsonl")',
+        'return os.path.join(lineage_dir, f"sneaky-{name}.jsonl")',
     ),
 }
 

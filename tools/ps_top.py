@@ -28,6 +28,11 @@ aggregation, ~world-size on the decode-sum path) and ``agg_fb`` (pushes
 that fell back to decode-sum while aggregation was explicitly
 requested).
 
+When round anatomy is armed (``telemetry.anatomy``, auto with lineage)
+the frame grows an ``anatomy`` pane: per-stage critical-path shares
+(which stage gates the rounds) and the top what-if advisor rows —
+"speeding stage X up 20% saves Y% of round time".
+
 When the parameter-serving read tier is armed the frame grows a
 ``serving`` block: a reader rollup line (reads/s, read p50/p95, shed,
 coalesce hits, queue depth) and one row per tenant namespace (ring
@@ -287,6 +292,28 @@ def render_control(control: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def render_anatomy(anatomy: Dict[str, Any]) -> List[str]:
+    """The anatomy pane lines from a ``/health`` ``anatomy`` section
+    (pure — the testable core): critical-path shares per stage and the
+    top what-if advisor rows ("speeding stage X up 20% saves Y% of
+    round time")."""
+    rounds = int(anatomy.get("rounds", 0))
+    crit = anatomy.get("critical_path") or []
+    parts = "  ".join(
+        f"{c['stage']}={c['share'] * 100:.0f}%" for c in crit[:4])
+    lines = [f"anatomy  rounds={rounds}  critical: {parts or '-'}"]
+    for a in (anatomy.get("advisor") or [])[:3]:
+        w20 = a.get("whatif_20") or {}
+        db = a.get("debottleneck") or {}
+        p50 = a.get("p50_ms")
+        lines.append(
+            f"  whatif [{a['stage']}] p50="
+            f"{'-' if p50 is None else f'{p50:.1f}ms'}  "
+            f"-20% saves {w20.get('saving_frac', 0) * 100:.1f}%  "
+            f"debottleneck saves {db.get('saving_frac', 0) * 100:.1f}%")
+    return lines
+
+
 def render_table(health: Dict[str, Any], sort: str = "worker",
                  color: bool = False) -> str:
     """One dashboard frame from a ``/health`` document (pure — the
@@ -346,6 +373,9 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
     control = health.get("control")
     if control:
         lines.extend(render_control(control))
+    anatomy = health.get("anatomy")
+    if anatomy:
+        lines.extend(render_anatomy(anatomy))
     cols = ["wk", "verdict", "cause", "grads", "inter-ewma", "inter-p95",
             "stale-ewma", "stale-x", "e2e-ms", "gnorm", "nan", "relerr",
             "anom", "gate-rounds", "gate-s", "retry", "reconn", "rej",

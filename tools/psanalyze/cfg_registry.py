@@ -147,6 +147,12 @@ CFG_KEYS = {
     "lineage_dir": CfgKey("str", "cli",
                           "lineage-server.jsonl output directory"),
     "lineage_kw": CfgKey("dict", "caller", "LineageTracker knob overrides"),
+    "anatomy": CfgKey("bool|str", "caller",
+                      "round-anatomy causal profiler: 'auto' (default, "
+                      "armed whenever lineage is) or False/'off'"),
+    "anatomy_kw": CfgKey("dict", "caller",
+                         "RoundAnatomy knobs (window, stage_window, "
+                         "min_rounds, ...)"),
     "timeseries": CfgKey("bool", "cli",
                          "arm the in-process metrics TSDB (/history)"),
     "timeseries_dir": CfgKey("str", "caller",

@@ -4,6 +4,7 @@ from tools.psanalyze.rules.abi_drift import AbiDriftRule
 from tools.psanalyze.rules.cfg_schema import CfgSchemaRule
 from tools.psanalyze.rules.codec_contract import CodecContractRule
 from tools.psanalyze.rules.metrics_surface import MetricsSurfaceRule
+from tools.psanalyze.rules.sidecar_registry import SidecarRegistryRule
 from tools.psanalyze.rules.thread_affinity import ThreadAffinityRule
 
 ALL_RULES = (
@@ -12,4 +13,5 @@ ALL_RULES = (
     MetricsSurfaceRule,
     CodecContractRule,
     AbiDriftRule,
+    SidecarRegistryRule,
 )

@@ -67,6 +67,9 @@ INSTRUMENT_MAP: Dict[str, Optional[str]] = {
     "lineage_pushes": "ps_lineage_pushes_total",
     "push_e2e_p50_ms": "ps_push_e2e_p50_ms",
     "push_e2e_p95_ms": "ps_push_e2e_p95_ms",
+    "anatomy_rounds": "ps_anatomy_rounds_total",
+    "anatomy_wire_share": "ps_anatomy_wire_share",
+    "anatomy_top_saving_frac": "ps_anatomy_top_saving_frac",
     "reads_total": "ps_reads_total",
     "read_p50_ms": "ps_read_p50_ms",
     "read_p95_ms": "ps_read_p95_ms",

@@ -1,0 +1,164 @@
+"""Rule 6: telemetry-sidecar prefix registry.
+
+Every JSONL sidecar written under the telemetry directory
+(``beacon-*.jsonl``, ``lineage-*.jsonl``, ...) must be declared in
+``pytorch_ps_mpi_tpu.telemetry.SIDECAR_PREFIXES``.  The failure mode
+this kills: a new observability layer invents ``foo-<name>.jsonl``,
+forgets one of the two (previously hand-maintained) exclusion lists,
+and its rows silently enter the recorder-span merge — corrupting the
+merged Chrome trace and the report's span table on the next live run.
+With the registry, that bug class is a lint failure at commit time:
+
+1. every string/f-string literal in the package shaped
+   ``<prefix>-...jsonl`` must have its leading dash-terminated prefix
+   declared in ``SIDECAR_PREFIXES`` (or be a recorder file —
+   ``worker-N.jsonl`` — which is the merge's INPUT, not a sidecar);
+2. the registry itself must be well-formed (a dict literal of
+   dash-terminated prefixes);
+3. both historical copy-sites — ``tools/telemetry_report.py`` dir mode
+   and ``examples/train_async.py``'s ``_export_telemetry`` — must
+   actually consume the registry, so the consolidation cannot silently
+   revert to hand-listing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.psanalyze.core import AnalysisContext, Finding, Rule
+
+TELEMETRY_INIT = "pytorch_ps_mpi_tpu/telemetry/__init__.py"
+
+#: dash-terminated prefixes that are recorder files (the span merge's
+#: inputs), not sidecars — the one legitimate undeclared family
+RECORDER_PREFIXES: Tuple[str, ...] = ("worker-",)
+
+#: the two sites whose hand-maintained lists the registry replaced;
+#: each must reference the registry (by any of its exported names)
+CONSUMER_FILES: Tuple[str, ...] = (
+    "tools/telemetry_report.py",
+    "examples/train_async.py",
+)
+_REGISTRY_NAMES = ("SIDECAR_PREFIXES", "sidecar_prefix", "is_sidecar")
+
+
+def _declared_prefixes(ctx: AnalysisContext
+                       ) -> Tuple[Optional[Set[str]], int]:
+    """Parse the SIDECAR_PREFIXES dict literal's keys out of the
+    telemetry package __init__ (no import — the tool must run on a
+    broken tree)."""
+    tree = ctx.tree(TELEMETRY_INIT)
+    if tree is None:
+        return None, 1
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "SIDECAR_PREFIXES":
+                if not isinstance(value, ast.Dict):
+                    return None, node.lineno
+                keys = set()
+                for k in value.keys:
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        return None, node.lineno
+                    keys.add(k.value)
+                return keys, node.lineno
+    return None, 1
+
+
+def _jsonl_literal_prefix(node: ast.AST) -> Optional[str]:
+    """The leading dash-terminated literal prefix of a ``...jsonl``
+    filename literal, or None when the node is not one.
+
+    Handles plain constants (``"faults-server.jsonl"``) and f-strings
+    whose LAST piece ends in ``.jsonl`` and whose FIRST piece is a
+    literal (``f"beacon-{worker}.jsonl"``).  A name with no dash in its
+    leading literal (``server.jsonl``, ``*.jsonl``) has no prefix and
+    is not a sidecar pattern.
+    """
+    lead: Optional[str] = None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if not node.value.endswith(".jsonl"):
+            return None
+        lead = node.value
+    elif isinstance(node, ast.JoinedStr) and node.values:
+        last = node.values[-1]
+        if not (isinstance(last, ast.Constant)
+                and isinstance(last.value, str)
+                and last.value.endswith(".jsonl")):
+            return None
+        first = node.values[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            return None  # fully dynamic name: nothing static to check
+        lead = first.value
+    if not lead:
+        return None
+    # the prefix is everything up to and including the FIRST dash of
+    # the leading literal ("lineage-leader{g}.jsonl" -> "lineage-")
+    dash = lead.find("-")
+    if dash < 1:
+        return None
+    return lead[:dash + 1]
+
+
+class SidecarRegistryRule(Rule):
+    name = "sidecar-registry"
+    description = ("every telemetry-dir JSONL sidecar prefix must be "
+                   "declared in telemetry.SIDECAR_PREFIXES, and both "
+                   "report/export routing sites must consume it")
+
+    def run(self, ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        declared, line = _declared_prefixes(ctx)
+        if declared is None:
+            return [Finding(
+                self.name, TELEMETRY_INIT, line,
+                "SIDECAR_PREFIXES dict literal (str prefix -> report "
+                "route) not found in the telemetry package __init__")]
+        for p in sorted(declared):
+            if not p.endswith("-"):
+                findings.append(Finding(
+                    self.name, TELEMETRY_INIT, line,
+                    f'SIDECAR_PREFIXES key "{p}" must end with "-" '
+                    "(prefixes match file names up to the first dash)"))
+
+        # 1) every sidecar-shaped filename literal in the package
+        known = declared | set(RECORDER_PREFIXES)
+        for rel in ctx.py_files(under=("pytorch_ps_mpi_tpu",)):
+            tree = ctx.tree(rel)
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                pref = _jsonl_literal_prefix(node)
+                if pref is None or pref in known:
+                    continue
+                findings.append(Finding(
+                    self.name, rel, node.lineno,
+                    f'JSONL sidecar prefix "{pref}" is not declared in '
+                    "telemetry.SIDECAR_PREFIXES — its rows would leak "
+                    "into the recorder-span merge (declare it with a "
+                    "report route, or None for a raw operator log)"))
+
+        # 3) the two historical copy-sites consume the registry
+        for rel in CONSUMER_FILES:
+            src = ctx.source(rel)
+            if src is None:
+                # absent surface (the smoke's seeded trees are partial
+                # copies): silence, per the engine's degrade convention
+                continue
+            if not any(name in src for name in _REGISTRY_NAMES):
+                findings.append(Finding(
+                    self.name, rel, 1,
+                    "sidecar routing here no longer consumes "
+                    "telemetry.SIDECAR_PREFIXES — the hand-maintained "
+                    "exclusion list is back (route through the "
+                    "registry instead)"))
+        return findings

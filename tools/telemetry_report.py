@@ -24,6 +24,14 @@ INCLUDING worker-labeled series (``ps_frames_rejected_total{worker="1"}``,
 ``ps_worker_anomaly_total{...}`` — previously silently ignored): labeled
 instruments are tabulated per worker in their own section.
 
+Round-anatomy rows (``anatomy-*.jsonl``, ``telemetry.anatomy``) get the
+**anatomy** section: per-stage critical-path shares and the ranked
+what-if advisor table ("stage X 20% faster ⇒ round time −Y%"); with only
+``lineage-*.jsonl`` present the section is rebuilt offline from the
+lineage rows — the same decomposition either way.  Sidecar routing for
+ALL of these comes from the one shared
+``pytorch_ps_mpi_tpu.telemetry.SIDECAR_PREFIXES`` registry.
+
 The fleet observability plane's artifacts get their own sections, all
 routed AWAY from the recorder-span merge: ``timeseries-*.jsonl``
 (``telemetry.timeseries``) → the **history** section (per-key
@@ -57,22 +65,30 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 
 
 def collect_files(paths: List[str]) -> List[str]:
+    from pytorch_ps_mpi_tpu.telemetry import (
+        SIDECAR_PREFIXES,
+        sidecar_prefix,
+    )
+
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            # faults-*.jsonl are injected-fault event logs (resilience
-            # layer), beacon-*.jsonl are health-monitor side channels,
-            # numerics-*.jsonl are codec-fidelity/grad-norm
-            # trajectories, and lineage-*.jsonl are per-version push
-            # compositions — none are recorder files (their rows have no
-            # recorder name/kind), so they must not enter the span merge.
-            # numerics-*.jsonl, lineage-*.jsonl and postmortem-*.json
-            # ARE picked up here, routed to their own sections by
-            # summarize().
+            # sidecar routing comes from the ONE shared registry
+            # (telemetry.SIDECAR_PREFIXES): a sidecar with a report
+            # route (numerics-/lineage-/anatomy-/timeseries-/slo-/
+            # control-) is picked up here and dispatched to its section
+            # by summarize(); a routeless sidecar (faults-/beacon-) is
+            # an operator-facing raw log and never enters the report.
+            # Recorder files (server.jsonl, worker-N.jsonl) pass
+            # through to the span merge.  psanalyze's sidecar-registry
+            # rule guarantees no prefix exists outside the registry.
+            def _keep(f: str) -> bool:
+                pref = sidecar_prefix(f)
+                return pref is None or SIDECAR_PREFIXES[pref] is not None
+
             out.extend(sorted(
                 f for f in glob.glob(os.path.join(p, "*.jsonl"))
-                if not os.path.basename(f).startswith(
-                    ("faults-", "beacon-"))
+                if _keep(f)
             ))
             out.extend(sorted(glob.glob(os.path.join(p, "*.prom"))))
             out.extend(sorted(glob.glob(
@@ -225,6 +241,39 @@ def _summarize_lineage(rows: List[Dict[str, Any]]
     }
 
 
+def _summarize_anatomy(round_rows: List[Dict[str, Any]],
+                       lineage_rows: List[Dict[str, Any]]
+                       ) -> Optional[Dict[str, Any]]:
+    """The anatomy section: per-stage critical-path shares + the ranked
+    what-if advisor table.  Prefers the live engine's persisted
+    ``anatomy-*.jsonl`` round rows; when only lineage rows exist the
+    engine is rebuilt offline (``telemetry.anatomy.anatomy_from_rows``)
+    — the same decomposition either way."""
+    if not round_rows and not lineage_rows:
+        return None
+    from pytorch_ps_mpi_tpu.telemetry.anatomy import (
+        STAGES,
+        anatomy_from_round_rows,
+        anatomy_from_rows,
+    )
+
+    # prefer the live engine's own persisted round rows (replayed
+    # through the engine's loader so offline state can never drift
+    # from what _observe builds live); lineage rows are the fallback
+    eng = (anatomy_from_round_rows(round_rows) if round_rows
+           else anatomy_from_rows(lineage_rows))
+    if not eng.rounds:
+        return None
+    snap = eng.snapshot()
+    return {
+        "rounds": snap["rounds"],
+        "critical_path": snap["critical_path"],
+        "stages": snap["stages"],
+        "advisor": eng.advisor(),
+        "stage_names": list(STAGES),
+    }
+
+
 def _summarize_history(rows: List[Dict[str, Any]]
                        ) -> Optional[Dict[str, Any]]:
     """The history section: per-key first/last/min/max/p95 over the
@@ -365,6 +414,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     probe_rows: List[Dict[str, Any]] = []
     postmortems: List[Dict[str, Any]] = []
     lineage_rows: List[Dict[str, Any]] = []
+    anatomy_rows: List[Dict[str, Any]] = []
     ts_rows: List[Dict[str, Any]] = []
     slo_rows: List[Dict[str, Any]] = []
     action_rows: List[Dict[str, Any]] = []
@@ -434,6 +484,15 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
             )
 
             lineage_rows.extend(load_lineage_rows(path))
+            continue
+        if base.startswith("anatomy-") and path.endswith(".jsonl"):
+            # round-anatomy critical-path rows (telemetry.anatomy) —
+            # routed to the anatomy section, never the span merge
+            from pytorch_ps_mpi_tpu.telemetry.anatomy import (
+                load_anatomy_rows,
+            )
+
+            anatomy_rows.extend(load_anatomy_rows(path))
             continue
         if base.startswith("numerics-") and path.endswith(".jsonl"):
             # numerics trajectories: the server's grad-norm/update-ratio
@@ -508,6 +567,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
         ),
         "numerics": _summarize_numerics(traj_rows, probe_rows, postmortems),
         "lineage": _summarize_lineage(lineage_rows),
+        "anatomy": _summarize_anatomy(anatomy_rows, lineage_rows),
         "history": _summarize_history(ts_rows),
         "profile": _summarize_profiles(profile_paths),
         "slo": _summarize_slo(slo_rows),
@@ -632,6 +692,42 @@ def format_table(summary: Dict[str, Any]) -> str:
                 f"{_ms(h.get('push_ms_p95'))}"
                 + ("" if rel is None else f"  rel-err={rel:.4g}")
             )
+    anat = summary.get("anatomy")
+    if anat:
+        lines.append("")
+        lines.append(f"round anatomy ({anat['rounds']} rounds decomposed):")
+        for c in anat.get("critical_path", []):
+            st = anat.get("stages", {}).get(c["stage"]) or {}
+            p50 = st.get("p50_ms")
+            lines.append(
+                f"  critical path [{c['stage']}]: {c['rounds']} rounds "
+                f"({c['share'] * 100:.0f}%)"
+                + ("" if p50 is None else f"  stage p50={p50:.1f}ms"))
+        adv = anat.get("advisor") or []
+        if adv:
+            lines.append("  what-if advisor (ranked):")
+            acols = ["stage", "crit%", "p50 ms", "p95 ms", "-20% saves",
+                     "debottleneck saves"]
+            arows = []
+            for a in adv:
+                w20 = a.get("whatif_20") or {}
+                db = a.get("debottleneck") or {}
+                arows.append([
+                    a["stage"],
+                    f"{a['critical_share'] * 100:.0f}",
+                    "-" if a.get("p50_ms") is None else f"{a['p50_ms']:.1f}",
+                    "-" if a.get("p95_ms") is None else f"{a['p95_ms']:.1f}",
+                    f"{w20.get('saving_frac', 0) * 100:.1f}%",
+                    f"{db.get('saving_frac', 0) * 100:.1f}% "
+                    f"({db.get('saved_s', 0):.2f}s)",
+                ])
+            aw = [max(len(c), *(len(r[i]) for r in arows)) if arows
+                  else len(c) for i, c in enumerate(acols)]
+            afmt = "  ".join(f"{{:<{w}}}" if i == 0 else f"{{:>{w}}}"
+                             for i, w in enumerate(aw))
+            lines.append("    " + afmt.format(*acols))
+            for r in arows:
+                lines.append("    " + afmt.format(*r))
     hist = summary.get("history")
     if hist:
         lines.append("")
