@@ -11,6 +11,7 @@ test:
 	$(MAKE) analyze
 	$(MAKE) trace-smoke
 	$(MAKE) read-smoke
+	$(MAKE) read-native-smoke
 	$(MAKE) agg-smoke
 	$(MAKE) native-smoke
 	$(MAKE) native-asan
@@ -96,6 +97,19 @@ read-smoke:
 	JAX_PLATFORMS=cpu python tools/read_smoke.py
 	python tools/telemetry_smoke.py
 
+# Native read-plane gate (in the default `make test` path): the C++
+# epoll tier must build + arm, answer with reply byte streams identical
+# to the Python selectors loop (full/delta/not-modified), serve a
+# concurrent full-read workload with a non-regressing p99 vs the Python
+# loop (trajectory-gated ratio), shed at admission depth 1 with every
+# reader completing via retry-after, and re-serve bit-exact bytes
+# through a FollowerLoop replica hop with lag 0 and nonzero relay
+# accounting. Skips cleanly without a toolchain / with PS_NO_NATIVE.
+# Appends a bench_gate trajectory row to
+# benchmarks/results/read_native_smoke.jsonl.
+read-native-smoke:
+	JAX_PLATFORMS=cpu python tools/read_native_smoke.py
+
 # Homomorphic-aggregation gate (in the default `make test` path): a
 # 2-process shm sync-barrier run over the top-k wire must fold every
 # push into the compressed accumulator and decode exactly ONCE per
@@ -150,8 +164,12 @@ agg-bench:
 		--metric 'agg_bench.native_push_speedup_topk_x:higher:0.5'
 
 # Read-tier load bench: open-loop fleet of simulated readers — delta
-# bytes economics (>=5x reduction gate), saturation sweep with bounded
-# served p99 past the admission limit. Full scale; `--quick` inside
+# bytes economics (>=5x reduction gate), saturation sweeps through BOTH
+# the Python selectors loop and the native C++ epoll tier (bounded
+# served p99 past the admission limit on each; the native shed fraction
+# at max load must not exceed the Python loop's), and a follower
+# replica tree (1 root + 2 replicas serving 3x the reader population,
+# replica lag settling <=2 versions). Full scale; `--quick` inside
 # read-smoke-scale CI runs. Trajectory rows in
 # benchmarks/results/read_bench.jsonl.
 read-bench:
@@ -159,7 +177,9 @@ read-bench:
 	python tools/bench_gate.py \
 		--trajectory benchmarks/results/read_bench.jsonl \
 		--metric 'read_bench.delta_reduction_x:higher:0.5' \
-		--metric 'read_bench.p99_max_load_ms:lower:2.0'
+		--metric 'read_bench.p99_max_load_ms:lower:2.0' \
+		--metric 'read_bench.native_p99_max_load_ms:lower:2.0' \
+		--metric 'read_bench.tree_p99_ms:lower:2.0'
 
 bench:
 	python bench.py
@@ -281,4 +301,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke whatif-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-native-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke whatif-smoke

@@ -9,19 +9,29 @@ request's latency is measured from its *scheduled* arrival time, so
 queueing delay is charged to the server, not silently absorbed by a
 closed loop that only asks as fast as it is answered).
 
-Three stages:
+Four stages:
 
 1. **delta economics** — readers track the publisher via delta reads;
    bytes/read for deltas vs full snapshots from the core's own
    counters. The acceptance bar (``delta_reduction_x >= 5`` for small
    inter-version deltas) is asserted here.
-2. **saturation sweep** — offered load swept past the read tier's
-   capacity; per load: achieved rps, served p50/p99, shed count. The
-   admission queue sheds overload with explicit retry-after replies, so
-   the p99 of SERVED requests must stay bounded (no collapse) past the
-   limit — also asserted.
-3. (implicit) **coalescing** — identical-version delta asks within one
-   version window ride one encode; the hit count is reported.
+2. **saturation sweep (Python loop)** — offered load swept past the
+   read tier's capacity; per load: achieved rps, served p50/p99, shed
+   count. The admission queue sheds overload with explicit retry-after
+   replies, so the p99 of SERVED requests must stay bounded (no
+   collapse) past the limit — also asserted.
+3. **saturation sweep (native tier)** — the same sweep through the C++
+   epoll tier (``read_native``); its served p99 must obey the same
+   bound, and its shed fraction at the highest offered load must not
+   exceed the Python loop's (the native tier drains replies off the
+   GIL, so overload turns into throughput, not sheds). Skipped without
+   a toolchain / under ``PS_NO_NATIVE``.
+4. **follower replica tree** — one root + 2 ``FollowerLoop`` replicas
+   serving 3x the reader population of a single endpoint while the
+   publisher advances; served p99 per endpoint is reported and the
+   replica lag once the publisher stops must settle <= 2 versions.
+(implicit) **coalescing** — identical-version delta asks within one
+version window ride one encode; the hit count is reported.
 
 Artifacts: metric rows into ``benchmarks/results/read_bench_<date>.jsonl``
 and one flat trajectory row appended to
@@ -41,7 +51,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -235,6 +245,95 @@ def run_saturation(core, template, *, readers: int, offered_rps: float,
     }
 
 
+def run_replica_tree(template, serving_kw, *, readers_per: int,
+                     offered_rps: float, duration_s: float,
+                     change_frac: float, publish_interval: float
+                     ) -> Dict[str, float]:
+    """Root + 2 followers serving 3x the single-endpoint reader
+    population while the publisher advances. Lag is the real version
+    gap (root latest - replica latest), sampled throughout."""
+    from pytorch_ps_mpi_tpu.serving import FollowerLoop, ServingCore
+
+    root = ServingCore(None, {"read_port": 0, "serving_kw": serving_kw},
+                       template=template)
+    pub = Publisher(root, template, change_frac, publish_interval)
+    pub.publish_once()
+    reps, loops = [], []
+    for _ in range(2):
+        rep = ServingCore(None, {"read_port": 0,
+                                 "serving_kw": serving_kw},
+                          template=template)
+        loops.append(FollowerLoop(
+            rep, "127.0.0.1", root.read_port, template=template,
+            poll_s=publish_interval / 4, serving_kw=serving_kw).start())
+        reps.append(rep)
+    deadline = time.time() + 30
+    while (any(r.latest_version(None) == 0 for r in reps)
+           and time.time() < deadline):
+        time.sleep(0.01)
+    if any(r.latest_version(None) == 0 for r in reps):
+        raise RuntimeError("replicas never caught the root's snapshot")
+    pub.start()
+
+    lag_max = [0]
+    stop = threading.Event()
+
+    def sample_lag() -> None:
+        while not stop.is_set():
+            gap = max(root.latest_version(None) - r.latest_version(None)
+                      for r in reps)
+            lag_max[0] = max(lag_max[0], gap)
+            time.sleep(0.02)
+
+    sampler = threading.Thread(target=sample_lag, daemon=True)
+    sampler.start()
+    endpoints = [root] + reps
+    results: List[Optional[dict]] = [None] * len(endpoints)
+
+    def drive(i: int) -> None:
+        results[i] = run_saturation(endpoints[i], template,
+                                    readers=readers_per,
+                                    offered_rps=offered_rps,
+                                    duration_s=duration_s)
+
+    drivers = [threading.Thread(target=drive, args=(i,))
+               for i in range(len(endpoints))]
+    for t in drivers:
+        t.start()
+    for t in drivers:
+        t.join(timeout=duration_s + 120)
+    pub.stop()
+    # quiesce: followers must converge on the final root version
+    deadline = time.time() + 30
+    while (any(r.latest_version(None) != root.latest_version(None)
+               for r in reps) and time.time() < deadline):
+        time.sleep(0.01)
+    lag_final = max(root.latest_version(None) - r.latest_version(None)
+                    for r in reps)
+    stop.set()
+    sampler.join(timeout=5)
+    relayed = sum(r.read_metrics()["follower_bytes_relayed"]
+                  for r in reps)
+    done = [r for r in results if r is not None]
+    for fl in loops:
+        fl.close()
+    for c in reps + [root]:
+        c.close()
+    return {
+        "endpoints": float(len(endpoints)),
+        "readers_total": float(readers_per * len(endpoints)),
+        "served_total": float(sum(r["served"] for r in done)),
+        "achieved_rps_total": float(sum(r["achieved_rps"]
+                                        for r in done)),
+        "p99_ms": float(max(r["p99_ms"] for r in done)),
+        "shed_frac": float(max(r["shed_frac"] for r in done)),
+        "lag_max": float(lag_max[0]),
+        "lag_final": float(lag_final),
+        "relayed_bytes": float(relayed),
+        "versions_published": float(pub.published),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--quick", action="store_true",
@@ -252,9 +351,13 @@ def main(argv=None) -> int:
     template = build_template(args.params)
     serving_kw = {"ring": 16, "admission_depth": 32,
                   "retry_after_s": 0.02, "delta_bucket_mb": 1.0}
-    cfg = {"read_port": 0, "serving_kw": serving_kw}
+    # stages 1-2 pin the Python selectors loop (the legacy baseline the
+    # trajectory rows track); stage 3 re-runs the sweep natively
+    cfg = {"read_port": 0, "read_native": False, "serving_kw": serving_kw}
 
     from pytorch_ps_mpi_tpu.serving import ServingCore
+    from pytorch_ps_mpi_tpu.serving.native_read import get_read_lib
+    from pytorch_ps_mpi_tpu.utils.native import fast_path_disabled
 
     rows: List[dict] = []
 
@@ -279,26 +382,61 @@ def main(argv=None) -> int:
                ("x" if k.endswith("_x") else ""))
     core.close()
 
-    # -- stage 2: saturation sweep ---------------------------------------
-    core = ServingCore(None, cfg, template=template)
-    core.publish(flat=np.zeros(
-        sum(int(np.prod(v.shape)) for v in template.values()), np.float32))
+    # -- stage 2: saturation sweep (Python loop) -------------------------
+    n_flat = sum(int(np.prod(v.shape)) for v in template.values())
     sweep = ([100, 400, 1200] if quick
              else [200, 800, 2400, 6000, 12000])
-    print("stage 2 — saturation sweep (full reads, open-loop):")
-    curve = []
-    for rps in sweep:
-        row = run_saturation(core, template, readers=readers,
-                             offered_rps=rps,
-                             duration_s=2.0 if quick else 4.0)
-        curve.append(row)
-        print(f"  offered {row['offered_rps']:>7.0f}/s  achieved "
-              f"{row['achieved_rps']:>7.0f}/s  service p50 "
-              f"{row['p50_ms']:6.2f} ms  p99 {row['p99_ms']:7.2f} ms  "
-              f"sched p99 {row['sched_p99_ms']:8.2f} ms  "
-              f"shed {row['shed']:>6.0f} ({row['shed_frac']:.1%})")
+    dur = 2.0 if quick else 4.0
+
+    def run_sweep(label: str, core_cfg: dict) -> List[dict]:
+        core = ServingCore(None, core_cfg, template=template)
+        want_native = core_cfg.get("read_native") not in (False, None)
+        if core.read_native is not want_native:
+            raise RuntimeError(
+                f"{label}: expected read_native={want_native} but the "
+                f"core armed read_native={core.read_native}")
+        core.publish(flat=np.zeros(n_flat, np.float32))
+        print(f"{label} (full reads, open-loop):")
+        out = []
+        for rps in sweep:
+            row = run_saturation(core, template, readers=readers,
+                                 offered_rps=rps, duration_s=dur)
+            out.append(row)
+            print(f"  offered {row['offered_rps']:>7.0f}/s  achieved "
+                  f"{row['achieved_rps']:>7.0f}/s  service p50 "
+                  f"{row['p50_ms']:6.2f} ms  p99 {row['p99_ms']:7.2f} ms  "
+                  f"sched p99 {row['sched_p99_ms']:8.2f} ms  "
+                  f"shed {row['shed']:>6.0f} ({row['shed_frac']:.1%})")
+        core.close()
+        return out
+
+    curve = run_sweep("stage 2 — saturation sweep, Python loop", cfg)
+    for row in curve:
         rows.append({"metric": "read_bench.saturation", **row})
-    core.close()
+
+    # -- stage 3: the same sweep through the native tier -----------------
+    native_armed = not fast_path_disabled() and get_read_lib() is not None
+    ncurve: List[dict] = []
+    if native_armed:
+        ncurve = run_sweep(
+            "stage 3 — saturation sweep, native C++ tier",
+            {**cfg, "read_native": True})
+        for row in ncurve:
+            rows.append({"metric": "read_bench.saturation_native", **row})
+    else:
+        print("stage 3 — SKIPPED (native read tier unavailable)")
+
+    # -- stage 4: follower replica tree ----------------------------------
+    tree = run_replica_tree(
+        template, serving_kw,
+        readers_per=max(8, readers // (2 if quick else 1) // 3),
+        offered_rps=sweep[-1] / 3.0, duration_s=dur,
+        change_frac=args.change_frac, publish_interval=0.1)
+    print("stage 4 — follower replica tree (1 root + 2 replicas):")
+    for k, v in tree.items():
+        metric(f"tree_{k}", v,
+               "ms" if k.endswith("_ms") else
+               ("bytes" if k.endswith("bytes") else ""))
 
     # bounded-past-the-limit check: compare the SERVED p99 at the highest
     # offered load (where shedding is active) against the lowest load's
@@ -309,6 +447,16 @@ def main(argv=None) -> int:
     metric("achieved_max_rps", max(c["achieved_rps"] for c in curve),
            "ops/sec")
     metric("shed_at_max", curve[-1]["shed"])
+    shed_frac_py = curve[-1]["shed_frac"]
+    metric("shed_frac_at_max", shed_frac_py)
+    np99_hi = shed_frac_nat = None
+    if ncurve:
+        np99_hi = ncurve[-1]["p99_ms"]
+        shed_frac_nat = ncurve[-1]["shed_frac"]
+        metric("native_p99_max_load_ms", np99_hi, "ms")
+        metric("native_achieved_max_rps",
+               max(c["achieved_rps"] for c in ncurve), "ops/sec")
+        metric("native_shed_frac_at_max", shed_frac_nat)
 
     wall = time.perf_counter() - t_wall0
     metric("wall_s", wall, "s")
@@ -329,6 +477,26 @@ def main(argv=None) -> int:
         print(f"FAIL: served p99 collapsed past the admission limit "
               f"({p99_hi:.1f} ms > bound {bound:.1f} ms)", file=sys.stderr)
         ok = False
+    if np99_hi is not None:
+        # the native tier obeys the same no-collapse bound, and its shed
+        # fraction at the highest offered load must not EXCEED the
+        # Python loop's (drains off the GIL: overload becomes
+        # throughput, not sheds; small epsilon for scheduler noise)
+        if np99_hi > bound:
+            print(f"FAIL: native served p99 collapsed "
+                  f"({np99_hi:.1f} ms > bound {bound:.1f} ms)",
+                  file=sys.stderr)
+            ok = False
+        if shed_frac_nat > shed_frac_py + 0.05:
+            print(f"FAIL: native shed fraction at max load "
+                  f"({shed_frac_nat:.1%}) exceeds the Python loop's "
+                  f"({shed_frac_py:.1%})", file=sys.stderr)
+            ok = False
+    if tree["lag_final"] > 2.0:
+        print(f"FAIL: replica lag settled at {tree['lag_final']:.0f} "
+              "versions (> 2) after the publisher stopped",
+              file=sys.stderr)
+        ok = False
 
     os.makedirs(RESULTS_DIR, exist_ok=True)
     day = time.strftime("%Y-%m-%d")
@@ -345,6 +513,13 @@ def main(argv=None) -> int:
             "p99_max_load_ms": round(p99_hi, 3),
             "achieved_max_rps": round(
                 max(c["achieved_rps"] for c in curve), 1),
+            "native_p99_max_load_ms": (round(np99_hi, 3)
+                                       if np99_hi is not None else None),
+            "native_shed_frac_at_max": (round(shed_frac_nat, 4)
+                                        if shed_frac_nat is not None
+                                        else None),
+            "tree_p99_ms": round(tree["p99_ms"], 3),
+            "tree_lag_final": tree["lag_final"],
             "readers": readers, "quick": int(quick),
         }) + "\n")
     print(f"wrote {out}")

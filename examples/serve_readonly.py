@@ -11,7 +11,19 @@ touching the training fleet.
 
 With ``--follow`` the tier keeps polling the checkpoint directory and
 republishes whenever the trainer lands a newer step, so readers track a
-LIVE training run through cheap delta reads.
+LIVE training run through cheap delta reads; the poll backs off
+exponentially while no newer checkpoint appears (tpu_watch-style), so
+an idle follower stops burning a core.
+
+With ``--follow-endpoint HOST:PORT`` the process is a REPLICA instead:
+it subscribes to an upstream read tier's delta stream
+(:class:`~pytorch_ps_mpi_tpu.serving.FollowerLoop`) and re-serves it
+from its own ring — chain replicas to build the distribution tree that
+lets one trainer-side core serve N replicas rather than N×10⁴ readers.
+Replicas register fleet cards with ``role="replica"`` (upstream +
+fanout in the card), export ``replica_lag_versions`` /
+``follower_bytes_relayed``, and survive a root restart by reconnecting
+with backoff while serving their last version.
 
 Examples::
 
@@ -69,8 +81,9 @@ def restore_latest(checkpoint_dir: str, cfg: dict):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
-    ap.add_argument("--checkpoint-dir", required=True,
-                    help="directory of _PSCheckpointCadence snapshots")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="directory of _PSCheckpointCadence snapshots "
+                         "(required unless --follow-endpoint)")
     ap.add_argument("--model", choices=["mlp", "resnet18", "resnet50"],
                     default="mlp",
                     help="model the checkpoint was trained with (defines "
@@ -86,11 +99,40 @@ def main(argv=None):
     ap.add_argument("--admission-depth", type=int, default=64)
     ap.add_argument("--follow", type=float, default=0.0,
                     help="poll the checkpoint dir every N seconds and "
-                         "republish newer steps (0 = serve one snapshot)")
+                         "republish newer steps (0 = serve one snapshot; "
+                         "idle polls back off exponentially to "
+                         "max(8s, 4x this))")
+    ap.add_argument("--follow-endpoint", default=None, metavar="HOST:PORT",
+                    help="replica mode: subscribe to this upstream read "
+                         "tier and re-serve its delta stream (no "
+                         "checkpoint dir needed)")
+    ap.add_argument("--fanout", type=int, default=2,
+                    help="replica mode: downstream replicas this node is "
+                         "provisioned to feed (advertised on the fleet "
+                         "card for tree planning)")
+    ap.add_argument("--serving-kw", default=None,
+                    help="JSON dict merged into serving_kw (delta codec "
+                         "knobs etc. — must match the upstream's codec "
+                         "in replica mode)")
+    ap.add_argument("--read-native", default="auto",
+                    help="native C++ read tier: auto (default; falls "
+                         "back to the Python loop), off")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="register this tier's endpoint card here "
+                         "(role=replica when following an endpoint)")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="replica mode: write reader_round anatomy rows "
+                         "(anatomy-<fleet name>.jsonl) here")
     ap.add_argument("--duration", type=float, default=0.0,
                     help="exit after this many seconds (0 = forever)")
     args = ap.parse_args(argv)
+    if not args.checkpoint_dir and not args.follow_endpoint:
+        ap.error("--checkpoint-dir is required unless --follow-endpoint")
 
+    serving_kw = {"ring": args.ring,
+                  "admission_depth": args.admission_depth}
+    serving_kw.update(json.loads(args.serving_kw) if args.serving_kw
+                      else {})
     cfg = {
         "model": args.model,
         "model_kw": {"num_classes": 10} if args.model != "mlp" else
@@ -98,54 +140,106 @@ def main(argv=None):
         "in_shape": [8] if args.model == "mlp" else [32, 32, 3],
         "batch": 1,
         "seed": 0,
-    }
-    params, version, step, template = restore_latest(
-        args.checkpoint_dir, cfg)
-
-    from pytorch_ps_mpi_tpu.serving import ServingCore
-
-    serve_cfg = {
         "read_port": args.read_port,
+        "read_native": args.read_native,
         "metrics_port": args.metrics_port,
-        "serving_kw": {"ring": args.ring,
-                       "admission_depth": args.admission_depth},
+        "serving_kw": serving_kw,
+        "follow_endpoint": args.follow_endpoint,
+        "follow_fanout": args.fanout,
     }
-    core = ServingCore(None, serve_cfg, template=template,
-                       tenant=args.tenant)
-    core.publish(params, version=max(version, 1), tenant=args.tenant)
+    if args.fleet_dir:
+        cfg["fleet_dir"] = args.fleet_dir
+        cfg["fleet_name"] = (f"replica-{os.getpid()}"
+                             if args.follow_endpoint else "read-tier")
+        if args.follow_endpoint:
+            cfg["fleet_role"] = "replica"
+            cfg["fleet_meta"] = {"upstream": args.follow_endpoint,
+                                 "fanout": cfg.get("follow_fanout")}
+
+    if args.checkpoint_dir:
+        params, version, step, template = restore_latest(
+            args.checkpoint_dir, cfg)
+    else:
+        from pytorch_ps_mpi_tpu.parallel.async_train import make_problem
+
+        _, template, _, _ = make_problem(cfg)
+        params, version, step = None, 0, -1
+
+    from pytorch_ps_mpi_tpu.serving import FollowerLoop, ServingCore
+
+    core = ServingCore(None, cfg, template=template, tenant=args.tenant)
+    if params is not None:
+        core.publish(params, version=max(version, 1), tenant=args.tenant)
+    follower = None
+    if cfg.get("follow_endpoint"):
+        up_host, _, up_port = str(cfg["follow_endpoint"]).rpartition(":")
+        anatomy = None
+        if args.telemetry_dir:
+            from pytorch_ps_mpi_tpu.telemetry.anatomy import RoundAnatomy
+
+            anatomy = RoundAnatomy(
+                None, {"telemetry_dir": args.telemetry_dir},
+                num_workers=1,
+                name=str(cfg.get("fleet_name") or "replica"))
+        follower = FollowerLoop(
+            core, up_host or "127.0.0.1", int(up_port),
+            template=template, tenant=args.tenant,
+            poll_s=args.follow or 0.25, serving_kw=serving_kw,
+            anatomy=anatomy).start()
     hello = {"read_port": core.read_port, "tenant": args.tenant,
-             "version": max(version, 1), "checkpoint_step": step}
+             "version": max(version, 1) if params is not None else 0,
+             "checkpoint_step": step, "native": core.read_native}
+    if follower is not None:
+        hello["upstream"] = cfg["follow_endpoint"]
+        hello["fanout"] = cfg.get("follow_fanout")
     if core.metrics_http_port is not None:
         hello["metrics_port"] = core.metrics_http_port
     print(json.dumps(hello), flush=True)
 
     deadline = time.time() + args.duration if args.duration else None
     last_step = step
+    # idle-backoff pacing (tpu_watch-style): a fresh checkpoint snaps the
+    # poll back to the base cadence; every empty poll doubles it
+    base_sleep = min(args.follow, 1.0) if args.follow else 0.25
+    max_sleep = max(8.0, 4.0 * base_sleep) if args.follow else base_sleep
+    sleep_s = base_sleep
     try:
         while deadline is None or time.time() < deadline:
-            time.sleep(min(args.follow, 1.0) if args.follow else 0.25)
-            if args.follow:
+            time.sleep(sleep_s if deadline is None
+                       else min(sleep_s, max(deadline - time.time(), 0)))
+            if args.follow and args.checkpoint_dir:
                 try:
                     params, version, step, _ = restore_latest(
                         args.checkpoint_dir, cfg)
                 except (FileNotFoundError, ValueError, OSError):
+                    sleep_s = min(sleep_s * 2.0, max_sleep)
                     continue  # trainer mid-write; next poll gets it
                 if step > last_step:
                     v = core.publish(params, version=max(version, 1),
                                      tenant=args.tenant)
                     last_step = step
+                    sleep_s = base_sleep
                     print(json.dumps({"republished": v,
                                       "checkpoint_step": step}),
                           flush=True)
+                else:
+                    sleep_s = min(sleep_s * 2.0, max_sleep)
     except KeyboardInterrupt:
         pass
     finally:
+        if follower is not None:
+            follower.close()
         snap = core.serving_snapshot()
         core.close()
-        print(json.dumps({"final_serving": {
-            k: snap[k] for k in ("reads_total", "reads_delta",
-                                 "reads_not_modified", "reads_shed",
-                                 "coalesce_hits")}}), flush=True)
+        final = {k: snap[k] for k in ("reads_total", "reads_delta",
+                                      "reads_not_modified", "reads_shed",
+                                      "coalesce_hits")}
+        if follower is not None:
+            final["republished"] = follower.republished
+            final["replica_lag_versions"] = snap["replica_lag_versions"]
+            final["follower_bytes_relayed"] = snap[
+                "follower_bytes_relayed"]
+        print(json.dumps({"final_serving": final}), flush=True)
     return 0
 
 

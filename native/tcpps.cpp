@@ -911,3 +911,654 @@ const char* tps_abi_frame_status_name(uint32_t code) {
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Native PSR1 read tier: the serving/net.py event loop rebuilt on this
+// file's epoll machinery. Accept / validate / reply run entirely here —
+// readiness-driven, so an idle reader costs zero syscalls per pump — and
+// payloads are ZERO-COPY: the server never owns snapshot or delta bytes.
+// Python publishes (ptr, len, token) triples pointing at frozen
+// SnapshotStore arrays / cached DeltaCodec encodes; replies writev the
+// header + that view straight to the socket, and when the last byte of
+// the last in-flight send drains, the token surfaces through
+// tps_read_released so Python can fire the refcount release hook —
+// exactly the net.py `done()` contract, at the wire's speed.
+//
+// Threading: unlike the TPS1 server above (single-threaded by contract),
+// the read tier is SHARED between Python's pump thread (tps_read_pump)
+// and the publish/metrics threads (tps_read_publish / tps_read_stats /
+// tps_read_set_admission). One mutex guards all state; epoll_wait runs
+// OUTSIDE the lock (publishes never stall behind a poll), and an eventfd
+// wakes a blocked pump so close/retire are prompt.
+//
+// Reply semantics mirror serving/net.py BYTE for byte (the parity tests
+// compare raw reply streams): same 40-byte little-endian header, same
+// kind selection (not-modified / delta / full / retry / error), same
+// shed-at-parse admission check, same "unknown tenant" / "bad request
+// magic/op" error payloads. Version-window boundaries (publish + delta
+// encodes) stay in Python; everything per-request lives here.
+
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/eventfd.h>
+#include <sys/uio.h>
+#include <unordered_map>
+
+namespace {
+
+constexpr uint32_t kPsrMagic = 0x31525350;  // "PSR1"
+constexpr uint8_t kPsrOpRead = 1;
+constexpr uint8_t kPsrFlagWantDelta = 1;
+
+enum PsrKind : uint8_t {
+  PSR_FULL = 0,
+  PSR_DELTA = 1,
+  PSR_NOT_MODIFIED = 2,
+  PSR_RETRY = 3,
+  PSR_ERROR = 4,
+};
+
+#pragma pack(push, 1)
+struct PsrReq {  // serving/net.py _REQ = "<IBBHQ"
+  uint32_t magic;
+  uint8_t op;
+  uint8_t flags;
+  uint16_t tenant_len;
+  uint64_t have_version;
+};
+struct PsrRep {  // serving/net.py _REP = "<IBBHQQdQ"
+  uint32_t magic;
+  uint8_t kind;
+  uint8_t pad1;
+  uint16_t pad2;
+  uint64_t version;
+  uint64_t base_version;
+  double retry_after_s;
+  uint64_t payload_len;
+};
+#pragma pack(pop)
+static_assert(sizeof(PsrReq) == 16, "PSR1 request must be 16 bytes");
+static_assert(sizeof(PsrRep) == 40, "PSR1 reply must be 40 bytes");
+
+// A published payload view: Python-owned bytes (frozen snapshot / cached
+// delta encode) identified by an opaque token. The server only ever
+// reads through `data`; when the buffer is both retired (no longer the
+// serveable latest) and drained (no in-flight send references it), the
+// token joins the released queue for Python to unpin.
+struct RBuf {
+  uint64_t token = 0;
+  const uint8_t* data = nullptr;
+  uint64_t len = 0;
+  uint32_t inflight = 0;  // queued tx items referencing this buffer
+  bool retired = false;   // superseded by a newer publish
+  uint64_t served = 0;    // replies that rode this buffer (coalescing)
+};
+
+struct RTenant {
+  uint64_t latest = 0;            // latest published version (0 = none)
+  RBuf* full = nullptr;           // latest full snapshot view
+  std::map<uint64_t, RBuf*> deltas;  // base version -> delta view
+};
+
+// One queued reply: header (+ any inline error text) in `head`, then an
+// optional zero-copy payload view.
+struct TxItem {
+  std::vector<uint8_t> head;
+  size_t head_off = 0;
+  RBuf* view = nullptr;
+  uint64_t view_off = 0;
+  bool counted_pending = false;  // admitted reply (sheds don't count)
+};
+
+struct RConn {
+  int fd = -1;
+  std::vector<uint8_t> rx;
+  std::deque<TxItem> tx;
+  bool closing = false;     // drop after the tx queue drains (bad magic)
+  bool want_write = false;  // EPOLLOUT armed
+};
+
+// Per-read-server stats block, packed for the ctypes mirror in
+// serving/native_read.py (same BatchMeta discipline: one static_assert
+// here, one sizeof assert there, one ABI twin below).
+#pragma pack(push, 1)
+struct ReadStats {
+  uint64_t conns;            // currently open reader connections
+  uint64_t accepted_total;   // connections accepted over the lifetime
+  uint64_t pending;          // admitted replies not yet fully drained
+  uint64_t reads_total;      // answered reads (full+delta+not_modified)
+  uint64_t reads_full;
+  uint64_t reads_delta;
+  uint64_t reads_not_modified;
+  uint64_t reads_shed;       // admission-control retry replies
+  uint64_t reads_error;      // error replies (unknown tenant, bad magic)
+  uint64_t rejected_frames;  // bad-magic/op requests (connection closed)
+  uint64_t eof_mid_request;  // peer EOF with a torn request buffered
+  uint64_t coalesce_hits;    // delta replies riding an already-served encode
+  uint64_t delta_bytes_saved;
+  uint64_t bytes_sent;
+  uint64_t pump_calls;
+  uint64_t pump_ns;
+};
+#pragma pack(pop)
+static_assert(sizeof(ReadStats) == 128, "ReadStats must be 128 bytes");
+
+// epoll data.ptr sentinel for the wake eventfd (nullptr = listener,
+// like the TPS1 server above; any other value = an RConn*).
+static char g_wake_sentinel;
+
+struct ReadServer {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  int epfd = -1;
+  int wake_fd = -1;
+  std::mutex mu;
+  std::vector<RConn*> conns;
+  std::unordered_map<std::string, RTenant*> tenants;
+  std::deque<uint64_t> released;  // drained+retired tokens for Python
+  uint64_t admission_depth = 64;
+  double retry_after_s = 0.05;
+  uint64_t pending = 0;  // admitted replies queued/in flight (backlog)
+  // an empty request tenant maps here (net.py: `tenant or default`)
+  std::string default_tenant;
+  ReadStats st{};
+};
+
+// Buffer fully drained AND superseded: surface the token. Buffers still
+// installed in a tenant map outlive any number of drains.
+void rbuf_unref(ReadServer* s, RBuf* b) {
+  if (b == nullptr) return;
+  --b->inflight;
+  if (b->retired && b->inflight == 0) {
+    s->released.push_back(b->token);
+    delete b;
+  }
+}
+
+void rbuf_retire(ReadServer* s, RBuf* b) {
+  if (b == nullptr) return;
+  b->retired = true;
+  if (b->inflight == 0) {
+    s->released.push_back(b->token);
+    delete b;
+  }
+}
+
+void rconn_close(ReadServer* s, RConn* c) {
+  // un-reference every queued payload view: pinned snapshots must be
+  // released even when the reader disappeared mid-send (net.py _drop)
+  while (!c->tx.empty()) {
+    TxItem& it = c->tx.front();
+    if (it.counted_pending && s->pending > 0) --s->pending;
+    rbuf_unref(s, it.view);
+    c->tx.pop_front();
+  }
+  if (c->fd >= 0) {
+    if (s->epfd >= 0) epoll_ctl(s->epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+  }
+  for (size_t i = 0; i < s->conns.size(); ++i) {
+    if (s->conns[i] == c) {
+      s->conns.erase(s->conns.begin() + i);
+      break;
+    }
+  }
+  delete c;
+}
+
+void rconn_interest(ReadServer* s, RConn* c, bool want_write) {
+  if (c->want_write == want_write) return;
+  c->want_write = want_write;
+  epoll_event ev{};
+  ev.events = EPOLLIN | (want_write ? EPOLLOUT : 0);
+  ev.data.ptr = c;
+  epoll_ctl(s->epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+// Flush the conn's tx queue with writev (header + zero-copy payload view
+// in one syscall). Returns false when the connection died.
+bool rconn_flush(ReadServer* s, RConn* c) {
+  while (!c->tx.empty()) {
+    TxItem& it = c->tx.front();
+    iovec iov[2];
+    int niov = 0;
+    if (it.head_off < it.head.size()) {
+      iov[niov].iov_base = it.head.data() + it.head_off;
+      iov[niov].iov_len = it.head.size() - it.head_off;
+      ++niov;
+    }
+    if (it.view != nullptr && it.view_off < it.view->len) {
+      iov[niov].iov_base = const_cast<uint8_t*>(it.view->data) + it.view_off;
+      iov[niov].iov_len = (size_t)(it.view->len - it.view_off);
+      ++niov;
+    }
+    if (niov == 0) {  // zero-length payload edge: item already complete
+      if (it.counted_pending && s->pending > 0) --s->pending;
+      rbuf_unref(s, it.view);
+      c->tx.pop_front();
+      continue;
+    }
+    ssize_t w = writev(c->fd, iov, niov);
+    if (w < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+        rconn_interest(s, c, true);
+        return true;
+      }
+      return false;  // peer reset mid-send
+    }
+    s->st.bytes_sent += (uint64_t)w;
+    size_t left = (size_t)w;
+    size_t head_left = it.head.size() - it.head_off;
+    size_t adv = left < head_left ? left : head_left;
+    it.head_off += adv;
+    left -= adv;
+    it.view_off += left;
+    bool done = it.head_off == it.head.size() &&
+                (it.view == nullptr || it.view_off >= it.view->len);
+    if (!done) {
+      rconn_interest(s, c, true);
+      return true;
+    }
+    if (it.counted_pending && s->pending > 0) --s->pending;
+    rbuf_unref(s, it.view);
+    c->tx.pop_front();
+  }
+  rconn_interest(s, c, false);
+  if (c->closing) return false;
+  return true;
+}
+
+// Queue one PSR1 reply (net.py _reply byte layout: retry_after_s packed
+// only on retry replies, 0.0 otherwise).
+void rqueue_reply(ReadServer* s, RConn* c, uint8_t kind, uint64_t version,
+                  uint64_t base, double retry_after,
+                  const uint8_t* inline_payload, uint64_t inline_len,
+                  RBuf* view, bool admitted) {
+  PsrRep h{};
+  h.magic = kPsrMagic;
+  h.kind = kind;
+  h.version = version;
+  h.base_version = base;
+  h.retry_after_s = retry_after;
+  h.payload_len = view != nullptr ? view->len : inline_len;
+  TxItem it;
+  const uint8_t* hp = reinterpret_cast<const uint8_t*>(&h);
+  it.head.assign(hp, hp + sizeof(h));
+  if (inline_payload != nullptr && inline_len > 0)
+    it.head.insert(it.head.end(), inline_payload, inline_payload + inline_len);
+  if (view != nullptr) ++view->inflight;
+  it.view = view;
+  it.counted_pending = admitted;
+  if (admitted) ++s->pending;
+  c->tx.push_back(std::move(it));
+}
+
+void rqueue_error(ReadServer* s, RConn* c, const char* msg) {
+  ++s->st.reads_error;
+  rqueue_reply(s, c, PSR_ERROR, 0, 0, 0.0,
+               reinterpret_cast<const uint8_t*>(msg), strlen(msg), nullptr,
+               false);
+}
+
+// Parse + answer every complete request buffered on the conn — the
+// net.py _parse_one / shed-at-parse / handle_read sequence, inline.
+void rconn_handle(ReadServer* s, RConn* c) {
+  size_t off = 0;
+  while (c->rx.size() - off >= sizeof(PsrReq)) {
+    PsrReq req;
+    std::memcpy(&req, c->rx.data() + off, sizeof(req));
+    if (req.magic != kPsrMagic || req.op != kPsrOpRead) {
+      // net.py: clear the torn buffer, answer, close after the flush
+      c->rx.clear();
+      off = 0;
+      ++s->st.rejected_frames;
+      rqueue_error(s, c, "bad request magic/op");
+      c->closing = true;
+      break;
+    }
+    size_t total = sizeof(PsrReq) + req.tenant_len;
+    if (c->rx.size() - off < total) break;  // partial tenant bytes
+    std::string tenant(reinterpret_cast<const char*>(c->rx.data() + off +
+                                                     sizeof(PsrReq)),
+                       req.tenant_len);
+    if (tenant.empty()) tenant = s->default_tenant;
+    off += total;
+    bool want_delta = (req.flags & kPsrFlagWantDelta) != 0;
+    uint64_t have = req.have_version;
+    RTenant* t = nullptr;
+    auto ti = s->tenants.find(tenant);
+    if (ti != s->tenants.end()) t = ti->second;
+    // admission control, BEFORE tenant validation (net.py sheds at
+    // parse time): past the depth the reply is an immediate retry
+    // carrying the tenant's latest version + the suggested backoff
+    if (s->pending >= s->admission_depth) {
+      ++s->st.reads_shed;
+      rqueue_reply(s, c, PSR_RETRY, t != nullptr ? t->latest : 0, 0,
+                   s->retry_after_s, nullptr, 0, nullptr, false);
+      continue;
+    }
+    if (t == nullptr) {
+      std::string msg = "unknown tenant '" + tenant + "'";
+      rqueue_error(s, c, msg.c_str());
+      continue;
+    }
+    if (t->full == nullptr || t->latest == 0) {
+      // nothing published yet: ask the reader to come back (retry with
+      // version 0 — distinguishable from a shed, which echoes latest)
+      rqueue_reply(s, c, PSR_RETRY, 0, 0, s->retry_after_s, nullptr, 0,
+                   nullptr, false);
+      continue;
+    }
+    ++s->st.reads_total;
+    if (have == t->latest) {
+      ++s->st.reads_not_modified;
+      rqueue_reply(s, c, PSR_NOT_MODIFIED, t->latest, have, 0.0, nullptr,
+                   0, nullptr, true);
+      continue;
+    }
+    if (want_delta && have > 0) {
+      auto di = t->deltas.find(have);
+      if (di != t->deltas.end()) {
+        RBuf* d = di->second;
+        ++s->st.reads_delta;
+        if (d->served > 0) ++s->st.coalesce_hits;
+        ++d->served;
+        if (t->full->len > d->len)
+          s->st.delta_bytes_saved += t->full->len - d->len;
+        rqueue_reply(s, c, PSR_DELTA, t->latest, have, 0.0, nullptr, 0, d,
+                     true);
+        continue;
+      }
+      // base aged out of the window / encode declined: full fallback,
+      // identical to the Python ring_ageout / delta_full_fallback path
+    }
+    ++s->st.reads_full;
+    ++t->full->served;
+    rqueue_reply(s, c, PSR_FULL, t->latest, 0, 0.0, nullptr, 0, t->full,
+                 true);
+  }
+  if (off > 0) c->rx.erase(c->rx.begin(), c->rx.begin() + off);
+}
+
+// Drain one reader socket; returns false when the conn should close.
+bool rconn_read(ReadServer* s, RConn* c) {
+  for (;;) {
+    uint8_t buf[65536];
+    ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+    if (r > 0) {
+      c->rx.insert(c->rx.end(), buf, buf + r);
+      continue;
+    }
+    if (r == 0) {  // EOF: a torn request still buffered is accounted
+      if (!c->rx.empty()) ++s->st.eof_mid_request;
+      return false;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) break;
+    if (!c->rx.empty()) ++s->st.eof_mid_request;
+    return false;
+  }
+  rconn_handle(s, c);
+  return rconn_flush(s, c);
+}
+
+int raccept_all(ReadServer* s) {
+  int events = 0;
+  for (;;) {
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) break;
+    set_nonblock(fd);
+    set_nodelay(fd);
+    RConn* c = new RConn();
+    c->fd = fd;
+    s->conns.push_back(c);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = c;
+    epoll_ctl(s->epfd, EPOLL_CTL_ADD, fd, &ev);
+    ++s->st.accepted_total;
+    ++events;
+  }
+  return events;
+}
+
+RTenant* rtenant_get(ReadServer* s, const char* tenant) {
+  std::string key(tenant != nullptr ? tenant : "");
+  auto it = s->tenants.find(key);
+  if (it != s->tenants.end()) return it->second;
+  RTenant* t = new RTenant();
+  s->tenants.emplace(std::move(key), t);
+  return t;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- native read tier -----------------------------------------------------
+
+// Listen on host:port (0 = auto; read back with tps_read_port). Returns
+// NULL on failure (no socket / no epoll / no eventfd — Python falls
+// back to the selectors loop).
+void* tps_read_create(const char* host, uint16_t port,
+                      uint64_t admission_depth, double retry_after_s,
+                      const char* default_tenant) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host != nullptr && *host != '\0' &&
+      inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return nullptr;
+  }
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 1024) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  set_nonblock(fd);
+  int epfd = epoll_create1(0);
+  int wake_fd = eventfd(0, EFD_NONBLOCK);
+  if (epfd < 0 || wake_fd < 0) {
+    close(fd);
+    if (epfd >= 0) close(epfd);
+    if (wake_fd >= 0) close(wake_fd);
+    return nullptr;
+  }
+  ReadServer* s = new ReadServer();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->epfd = epfd;
+  s->wake_fd = wake_fd;
+  s->admission_depth = admission_depth;
+  s->retry_after_s = retry_after_s;
+  s->default_tenant = (default_tenant != nullptr) ? default_tenant : "";
+  // the default tenant exists from construction (mirrors ServingCore's
+  // _ensure_tenant): a pre-publish read is "nothing published yet"
+  // (retry), not "unknown tenant"
+  rtenant_get(s, s->default_tenant.c_str());
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;  // nullptr marks the listener
+  epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+  ev.data.ptr = &g_wake_sentinel;
+  epoll_ctl(epfd, EPOLL_CTL_ADD, wake_fd, &ev);
+  return s;
+}
+
+uint16_t tps_read_port(void* h) { return ((ReadServer*)h)->port; }
+
+// Install the latest full snapshot view for a tenant. `data` must stay
+// valid until `token` comes back through tps_read_released (Python pins
+// the frozen SnapshotStore array). Retires the previous full view AND
+// every delta (their base→latest window ended with this publish).
+void tps_read_publish(void* h, const char* tenant, uint64_t version,
+                      const uint8_t* data, uint64_t len, uint64_t token) {
+  ReadServer* s = (ReadServer*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  RTenant* t = rtenant_get(s, tenant);
+  rbuf_retire(s, t->full);
+  for (auto& kv : t->deltas) rbuf_retire(s, kv.second);
+  t->deltas.clear();
+  RBuf* b = new RBuf();
+  b->token = token;
+  b->data = data;
+  b->len = len;
+  t->full = b;
+  t->latest = version;
+}
+
+// Install one pre-encoded delta (base → current latest) for a tenant.
+// Same lifetime contract as tps_read_publish.
+void tps_read_add_delta(void* h, const char* tenant, uint64_t base,
+                        const uint8_t* data, uint64_t len, uint64_t token) {
+  ReadServer* s = (ReadServer*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  RTenant* t = rtenant_get(s, tenant);
+  auto it = t->deltas.find(base);
+  if (it != t->deltas.end()) rbuf_retire(s, it->second);
+  RBuf* b = new RBuf();
+  b->token = token;
+  b->data = data;
+  b->len = len;
+  t->deltas[base] = b;
+}
+
+// One pump pass: epoll_wait (OUTSIDE the lock, up to timeout_ms — the
+// ctypes call releases the GIL so Python's pump thread blocks here
+// cheaply), then accept/read/parse/reply/flush under the lock. Returns
+// progress events (0 = idle).
+int tps_read_pump(void* h, int timeout_ms) {
+  ReadServer* s = (ReadServer*)h;
+  timespec t0;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  epoll_event evs[128];
+  int ne = epoll_wait(s->epfd, evs, 128, timeout_ms);
+  int events = 0;
+  if (ne > 0) {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (int e = 0; e < ne; ++e) {
+      void* p = evs[e].data.ptr;
+      if (p == nullptr) {
+        events += raccept_all(s);
+        continue;
+      }
+      if (p == &g_wake_sentinel) {
+        uint64_t v;
+        while (read(s->wake_fd, &v, sizeof(v)) > 0) {
+        }
+        ++events;
+        continue;
+      }
+      RConn* c = (RConn*)p;
+      // the conn may already have been closed by an earlier event in
+      // this same batch (e.g. EPOLLIN+EPOLLOUT with a dead peer)
+      bool live = false;
+      for (RConn* q : s->conns)
+        if (q == c) {
+          live = true;
+          break;
+        }
+      if (!live) continue;
+      bool ok = true;
+      if (evs[e].events & (EPOLLIN | EPOLLHUP | EPOLLERR))
+        ok = rconn_read(s, c);
+      if (ok && (evs[e].events & EPOLLOUT)) ok = rconn_flush(s, c);
+      if (!ok) rconn_close(s, c);
+      ++events;
+    }
+  }
+  timespec t1;
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    ++s->st.pump_calls;
+    s->st.pump_ns += (uint64_t)(t1.tv_sec - t0.tv_sec) * 1000000000ull +
+                     (uint64_t)(t1.tv_nsec - t0.tv_nsec);
+  }
+  return events;
+}
+
+// Pop up to `cap` drained+retired tokens. Python runs the release hooks
+// (snapshot ring unpin / delta buffer drop) for each.
+int tps_read_released(void* h, uint64_t* out, int cap) {
+  ReadServer* s = (ReadServer*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  int n = 0;
+  while (n < cap && !s->released.empty()) {
+    out[n++] = s->released.front();
+    s->released.pop_front();
+  }
+  return n;
+}
+
+void tps_read_stats(void* h, ReadStats* out) {
+  ReadServer* s = (ReadServer*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->st.conns = s->conns.size();
+  s->st.pending = s->pending;
+  *out = s->st;
+}
+
+// Live admission retuning (the control plane's set_admission_depth).
+void tps_read_set_admission(void* h, uint64_t depth, double retry_after_s) {
+  ReadServer* s = (ReadServer*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->admission_depth = depth;
+  s->retry_after_s = retry_after_s;
+}
+
+// Wake a pump blocked in epoll_wait (publish visibility / shutdown).
+void tps_read_wake(void* h) {
+  ReadServer* s = (ReadServer*)h;
+  uint64_t one = 1;
+  ssize_t r = write(s->wake_fd, &one, sizeof(one));
+  (void)r;
+}
+
+// Tear down. The pump thread must have exited (Python joins it first).
+// Remaining tokens are NOT surfaced — Python releases everything it
+// still tracks after this call.
+void tps_read_close(void* h) {
+  ReadServer* s = (ReadServer*)h;
+  if (s == nullptr) return;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    while (!s->conns.empty()) rconn_close(s, s->conns.back());
+    for (auto& kv : s->tenants) {
+      RTenant* t = kv.second;
+      if (t->full != nullptr) delete t->full;
+      for (auto& dv : t->deltas) delete dv.second;
+      delete t;
+    }
+    s->tenants.clear();
+  }
+  if (s->listen_fd >= 0) close(s->listen_fd);
+  if (s->epfd >= 0) close(s->epfd);
+  if (s->wake_fd >= 0) close(s->wake_fd);
+  delete s;
+}
+
+// ---- read-tier ABI self-description ---------------------------------------
+// Runtime twin of the abi-drift rule for the read plane: native_read.py
+// re-reads these at bind time and refuses the library on any mismatch
+// with serving/net.py's struct layouts.
+
+uint32_t tps_abi_psr_magic(void) { return kPsrMagic; }
+
+uint32_t tps_abi_psr_req_bytes(void) { return (uint32_t)sizeof(PsrReq); }
+
+uint32_t tps_abi_psr_rep_bytes(void) { return (uint32_t)sizeof(PsrRep); }
+
+uint32_t tps_abi_read_stats_bytes(void) {
+  return (uint32_t)sizeof(ReadStats);
+}
+
+}  // extern "C"

@@ -24,6 +24,7 @@ from pytorch_ps_mpi_tpu.serving.core import (
     ServingCore,
 )
 from pytorch_ps_mpi_tpu.serving.delta import DELTA_KNOBS, DeltaCodec
+from pytorch_ps_mpi_tpu.serving.follower import FollowerLoop
 from pytorch_ps_mpi_tpu.serving.net import (
     ReadClient,
     ReadTierServer,
@@ -37,6 +38,7 @@ __all__ = [
     "ServingCore",
     "DELTA_KNOBS",
     "DeltaCodec",
+    "FollowerLoop",
     "ReadClient",
     "ReadTierServer",
     "ServingReader",

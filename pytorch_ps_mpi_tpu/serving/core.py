@@ -149,11 +149,36 @@ class ServingCore:
 
         self.read_server = None
         self.read_port: Optional[int] = None
+        self.read_native = False
+        # follower-tier accounting (set by serving.follower.FollowerLoop)
+        self.replica_lag_versions = 0
+        self.follower_bytes_relayed = 0
         if self.armed and cfg.get("read_port") is not None:
-            from pytorch_ps_mpi_tpu.serving.net import ReadTierServer
+            rn = cfg.get("read_native", "auto")
+            if rn not in (False, "off", 0):
+                from pytorch_ps_mpi_tpu.utils.native import (
+                    fast_path_disabled,
+                )
 
-            self.read_server = ReadTierServer(
-                self, port=int(cfg["read_port"]), host=read_host)
+                if not fast_path_disabled():
+                    from pytorch_ps_mpi_tpu.serving.native_read import (
+                        NativeReadServer,
+                        get_read_lib,
+                    )
+
+                    if get_read_lib() is not None:
+                        try:
+                            self.read_server = NativeReadServer(
+                                self, port=int(cfg["read_port"]),
+                                host=read_host)
+                            self.read_native = True
+                        except RuntimeError:
+                            self.read_server = None  # port taken etc.
+            if self.read_server is None:
+                from pytorch_ps_mpi_tpu.serving.net import ReadTierServer
+
+                self.read_server = ReadTierServer(
+                    self, port=int(cfg["read_port"]), host=read_host)
             self.read_port = self.read_server.port
 
         # standalone core (no transport server): serve /metrics + /health
@@ -199,7 +224,8 @@ class ServingCore:
                         _fleet.register_endpoint(
                             cfg["fleet_dir"], fname,
                             self._own_http.port,
-                            role=cfg.get("fleet_role", "read"))
+                            role=cfg.get("fleet_role", "read"),
+                            **(cfg.get("fleet_meta") or {}))
                         self._fleet_registration = (cfg["fleet_dir"],
                                                     fname)
         self._register_scrape()
@@ -340,6 +366,11 @@ class ServingCore:
             # against the previous latest can never be served again
             for k in [k for k in self._encode_cache if k[0] == tenant]:
                 del self._encode_cache[k]
+        if self.read_native:
+            # version-window boundary: hand the frozen snapshot + the
+            # ring's pre-encoded deltas to the native tier — the ONLY
+            # Python the native read path ever runs
+            self.read_server.on_publish(tenant, version, store)
         return version
 
     # -- read path --------------------------------------------------------
@@ -374,6 +405,20 @@ class ServingCore:
         if depth < 1:
             raise ValueError(f"admission depth must be >= 1, got {depth}")
         self.admission_depth = int(depth)
+        if self.read_native:
+            self.read_server.set_admission(self.admission_depth,
+                                           self.retry_after_s)
+
+    # -- follower-tier accounting (serving.follower.FollowerLoop) ---------
+    def set_replica_lag(self, lag: int) -> None:
+        """Versions this replica is behind its upstream (0 = current)."""
+        with self._lock:
+            self.replica_lag_versions = max(0, int(lag))
+
+    def note_relayed(self, nbytes: int) -> None:
+        """Bytes this follower pulled from upstream and re-served."""
+        with self._lock:
+            self.follower_bytes_relayed += max(0, int(nbytes))
 
     def set_ring(self, ring: int) -> None:
         """Live snapshot-ring resize across every tenant store (and for
@@ -511,8 +556,19 @@ class ServingCore:
         v = self._read_hist.approx_quantile(q)
         return 0.0 if math.isnan(v) else v * 1e3
 
+    def _native_stats(self) -> Optional[Dict[str, int]]:
+        """The native tier's counter block, or None on the Python loop."""
+        if not self.read_native or self.read_server is None:
+            return None
+        try:
+            return self.read_server.stats()
+        except Exception:
+            return None  # torn down mid-scrape
+
     def read_metrics(self) -> Dict[str, float]:
-        """The canonical serving keys (all float; zeros before traffic)."""
+        """The canonical serving keys (all float; zeros before traffic).
+        With the native tier armed its C++ counters merge in here — one
+        schema whichever loop served the bytes."""
         with self._lock:
             out = {
                 "reads_total": float(self.reads_total),
@@ -520,7 +576,19 @@ class ServingCore:
                 "reads_shed": float(self.reads_shed),
                 "coalesce_hits": float(self.coalesce_hits),
                 "reads_not_modified": float(self.reads_not_modified),
+                "replica_lag_versions": float(self.replica_lag_versions),
+                "follower_bytes_relayed": float(
+                    self.follower_bytes_relayed),
             }
+        nat = self._native_stats()
+        out["native_read_conns"] = float(nat["conns"]) if nat else 0.0
+        if nat is not None:
+            for src, dst in (("reads_total", "reads_total"),
+                             ("reads_shed", "reads_shed"),
+                             ("coalesce_hits", "coalesce_hits"),
+                             ("reads_not_modified", "reads_not_modified"),
+                             ("delta_bytes_saved", "delta_bytes_saved")):
+                out[dst] += float(nat[src])
         out["read_p50_ms"] = self._quantile_ms(0.50)
         out["read_p95_ms"] = self._quantile_ms(0.95)
         return out
@@ -569,6 +637,23 @@ class ServingCore:
             # + cheap not-modified replies, counted natively
             out["native_reads"] = {"total": int(nat[0]),
                                    "not_modified": int(nat[1])}
+        out["read_native"] = self.read_native
+        nrs = self._native_stats()
+        if nrs is not None:
+            # the native PSR1 tier's full counter block — its serves
+            # also fold into the canonical counters above
+            out["native_read"] = nrs
+            for k in ("reads_total", "reads_full", "reads_delta",
+                      "reads_not_modified", "reads_shed",
+                      "coalesce_hits", "delta_bytes_saved"):
+                out[k] += nrs[k]
+        elif self.read_server is not None and not self.read_native:
+            # torn-frame accounting on the Python loop (the native tier
+            # reports the same fields inside native_read)
+            out["rejected_frames"] = self.read_server.rejected_frames
+            out["eof_mid_request"] = self.read_server.eof_mid_request
+        out["replica_lag_versions"] = self.replica_lag_versions
+        out["follower_bytes_relayed"] = self.follower_bytes_relayed
         return out
 
     def _register_scrape(self) -> None:
@@ -610,6 +695,16 @@ class ServingCore:
                     "read requests awaiting service").set(
                         float(self.read_server.queue_depth()
                               if self.read_server is not None else 0))
+            r.gauge("ps_native_read_conns",
+                    "reader connections open on the native PSR1 "
+                    "tier").set(m["native_read_conns"])
+            r.gauge("ps_replica_lag_versions",
+                    "versions this replica trails its upstream "
+                    "(follower tier; 0 standalone)").set(
+                        m["replica_lag_versions"])
+            r.counter("ps_follower_bytes_relayed_total",
+                      "bytes pulled from upstream and re-served by "
+                      "this follower").set(m["follower_bytes_relayed"])
             with self._lock:
                 occ = sum(len(s._order) for s in self._stores.values())
                 tenants = len(self._stores)
@@ -632,6 +727,18 @@ class ServingCore:
         numerics/lineage exactly as before the extraction)."""
         if self.read_server is not None:
             self.read_server.close()
+            # the native tier's counters die with its C++ handle: fold
+            # the final block (captured at teardown) into the core's own
+            # counters so post-close accounting — server.metrics() after
+            # server.close() — reads the same whichever loop served
+            nrs = self._native_stats()
+            if nrs is not None:
+                with self._lock:
+                    for k in ("reads_total", "reads_full", "reads_delta",
+                              "reads_not_modified", "reads_shed",
+                              "coalesce_hits", "delta_bytes_saved"):
+                        setattr(self, k, getattr(self, k) + nrs[k])
+                self.read_native = False
             self.read_server = None
         reg, self._fleet_registration = self._fleet_registration, None
         if reg is not None:

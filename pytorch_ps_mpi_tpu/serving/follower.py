@@ -1,0 +1,201 @@
+"""Follower tier: a read-only replica that subscribes to an upstream
+read tier's delta stream and re-serves it.
+
+:class:`FollowerLoop` is the subscription side of the distribution
+tree: it runs a :class:`~.net.ServingReader` against the upstream
+(root or intermediate replica) read port and republishes every new
+version — pinned to the UPSTREAM's version number — into a local
+:class:`~.core.ServingCore`, whose own read server (native or Python)
+then serves downstream readers or further replicas.  Chaining
+follower → follower builds the tree: the trainer-side core serves N
+replicas instead of N×10⁴ readers, and every hop re-serves deltas from
+its own ring, so "I have v → latest" stays cheap at every level.
+
+Pacing is demand-driven, tpu_watch-style: each ``not_modified`` poll
+doubles the sleep up to ``max_poll_s`` (an idle follower stops burning
+a core); any new version snaps it back to ``poll_s``.  Upstream loss
+(root restart, network partition) is survived by the resilient
+reconnect path — the reader is torn down and re-dialed with the same
+exponential backoff, and the replica keeps serving its last published
+version the whole time (readers see a stale-but-consistent tree, never
+an error).
+
+Accounting flows into the canonical metrics surface through the local
+core: ``replica_lag_versions`` (how far this replica trails the latest
+upstream version it has observed) and ``follower_bytes_relayed``
+(bytes pulled from upstream and re-served), plus optional
+``kind="reader_round"`` anatomy rows so the replica's pull cadence is
+visible next to the server rounds that produced the versions.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class FollowerLoop:
+    """Subscribe to an upstream read tier; republish into ``core``.
+
+    Parameters
+    ----------
+    core:
+        The local :class:`~.core.ServingCore` to republish into (armed
+        with its own ``read_port`` so downstream readers can connect).
+    host, port:
+        Upstream read-tier endpoint (the root's — or another replica's
+        — ``read_port``).
+    template:
+        Parameter pytree template (defaults to ``core.template``);
+        required to decode the upstream payloads.
+    poll_s / max_poll_s:
+        Pull cadence bounds: every ``not_modified`` doubles the sleep
+        from ``poll_s`` up to ``max_poll_s``; a new version resets it.
+    anatomy:
+        Optional :class:`~..telemetry.anatomy.RoundAnatomy`; each poll
+        that lands a new version writes a ``reader_round`` row.
+    """
+
+    def __init__(self, core, host: str, port: int, *,
+                 template=None, tenant: str = "",
+                 poll_s: float = 0.25, max_poll_s: float = 8.0,
+                 timeout: float = 10.0,
+                 serving_kw: Optional[dict] = None,
+                 anatomy=None):
+        self.core = core
+        self.host = str(host)
+        self.port = int(port)
+        self.template = template if template is not None else core.template
+        if self.template is None:
+            raise ValueError("FollowerLoop needs a parameter template "
+                             "(pass template= or arm the core with one)")
+        self.tenant = str(tenant)
+        self.poll_s = float(poll_s)
+        self.max_poll_s = max(float(max_poll_s), self.poll_s)
+        self.timeout = float(timeout)
+        self.serving_kw = dict(serving_kw or {})
+        self.anatomy = anatomy
+        self._reader = None
+        self._sleep_s = self.poll_s
+        self._relayed_mark = 0  # reader.bytes_received already credited
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # accounting (smokes/tests read these)
+        self.polls = 0
+        self.republished = 0
+        self.not_modified = 0
+        self.reconnects = 0
+        self.upstream_version = 0
+        self.last_error: Optional[str] = None
+
+    # -- one pull ---------------------------------------------------------
+    def _connect(self):
+        from pytorch_ps_mpi_tpu.serving.net import ServingReader
+
+        reader = ServingReader(
+            self.host, self.port, self.template, tenant=self.tenant,
+            timeout=self.timeout, serving_kw=self.serving_kw)
+        self._relayed_mark = 0
+        return reader
+
+    def _teardown(self) -> None:
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except Exception:
+                pass
+            self._reader = None
+
+    def step(self) -> Dict[str, Any]:
+        """One poll against upstream.  Returns a status row
+        (``outcome`` is one of ``republished`` / ``not_modified`` /
+        ``retry``); never raises — upstream failures become
+        ``outcome="retry"`` with the reconnect backoff armed."""
+        self.polls += 1
+        t0 = time.perf_counter()
+        try:
+            if self._reader is None:
+                self._reader = self._connect()
+                self.reconnects += 1
+            reader = self._reader
+            before = self.core.latest_version(None)
+            _, version = reader.read_params()
+            self.upstream_version = max(self.upstream_version, int(version))
+            # credit only the NEW bytes this poll pulled off the wire
+            fresh = reader.bytes_received - self._relayed_mark
+            self._relayed_mark = reader.bytes_received
+            if fresh > 0:
+                self.core.note_relayed(fresh)
+            lag = max(0, int(version) - before)
+            if int(version) > before:
+                # lag as observed at pull time: how far downstream was
+                # behind the instant the new version arrived
+                self.core.set_replica_lag(lag)
+                # the store adopts + freezes its input; the reader keeps
+                # applying deltas to _flat, so hand the ring a copy
+                self.core.publish(
+                    flat=np.array(reader._flat, dtype=np.float32),
+                    version=int(version), template=self.template)
+                self.republished += 1
+                self._sleep_s = self.poll_s
+                outcome = "republished"
+                row = {"outcome": outcome, "version": int(version),
+                       "lag": lag, "relayed_bytes": int(max(fresh, 0)),
+                       "pull_s": round(time.perf_counter() - t0, 6),
+                       "upstream": f"{self.host}:{self.port}"}
+                if self.anatomy is not None:
+                    self.anatomy.observe_reader_round(dict(row))
+                self.core.set_replica_lag(0)
+                return row
+            self.not_modified += 1
+            self.core.set_replica_lag(0)
+            # idle: exponential backoff so a quiet upstream costs ~0
+            self._sleep_s = min(self._sleep_s * 2.0, self.max_poll_s)
+            outcome = "not_modified"
+        except (ConnectionError, TimeoutError, OSError, RuntimeError) as e:
+            # resilient reconnect: drop the broken reader, back off, and
+            # re-dial next poll — the local core keeps serving its last
+            # published version throughout (root-restart survival)
+            self.last_error = f"{type(e).__name__}: {e}"
+            self._teardown()
+            self._sleep_s = min(max(self._sleep_s, self.poll_s) * 2.0,
+                                self.max_poll_s)
+            outcome = "retry"
+        return {"outcome": outcome, "version": self.upstream_version,
+                "lag": max(0, self.upstream_version
+                           - self.core.latest_version(None)),
+                "sleep_s": round(self._sleep_s, 3)}
+
+    # -- lifecycle --------------------------------------------------------
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Poll until ``stop`` (or :meth:`close`) is set."""
+        stop = stop or self._stop
+        while not (stop.is_set() or self._stop.is_set()):
+            self.step()
+            stop.wait(self._sleep_s)
+        self._teardown()
+
+    def start(self) -> "FollowerLoop":
+        """Run :meth:`run` on a daemon thread (the serve_readonly
+        ``--follow-endpoint`` path)."""
+        self._thread = threading.Thread(
+            target=self.run, daemon=True,
+            name=f"follower:{self.host}:{self.port}")
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout + 5)
+            self._thread = None
+        self._teardown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,285 @@
+"""Native PSR1 read tier: ctypes bindings + the drop-in server wrapper.
+
+The C++ half lives in ``native/tcpps.cpp`` (``tps_read_*`` exports): an
+epoll event loop that accepts, validates, and answers PSR1 reads
+entirely in C++ — zero syscalls for idle readers, zero-copy ``writev``
+of frozen snapshot/delta views, byte-identical replies to the
+``serving/net.py`` selectors loop (the tested fallback, still armed by
+``PS_NO_NATIVE`` or ``cfg["read_native"] = False``).
+
+:class:`NativeReadServer` is the Python wrapper with the same surface
+:class:`~.net.ReadTierServer` exposes to :class:`~.core.ServingCore`
+(``port`` / ``queue_depth()`` / ``connections()`` / ``close()``), plus
+the publish hook that makes version-window boundaries the ONLY Python
+involvement: on every :meth:`~.core.ServingCore.publish` it pins the
+frozen snapshot, pre-encodes the ring's ``base -> latest`` deltas once
+(the native tier then fans each encode out to every coalesced reader),
+and hands ``(ptr, len, token)`` views to C++. When the last in-flight
+send of a superseded buffer drains, its token surfaces through
+``tps_read_released`` and the pump thread fires the release hook — the
+ring unpin the Python loop ran in ``done()``.
+
+Threading contract (why the ``thread-affinity`` pragmas below are
+sound, unlike the single-threaded TPS1/psqueue handles that rule
+protects): every ``tps_read_*`` entry point locks the server's own
+mutex in C++; the pump thread, the publish thread, and metrics scrape
+threads are all sanctioned callers by design.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+_read_lib: Optional[ctypes.CDLL] = None
+_read_lib_failed = False
+
+
+class _ReadStats(ctypes.Structure):
+    """Mirror of native/tcpps.cpp ReadStats (128 bytes, packed)."""
+
+    _pack_ = 1
+    _fields_ = [
+        ("conns", ctypes.c_uint64),
+        ("accepted_total", ctypes.c_uint64),
+        ("pending", ctypes.c_uint64),
+        ("reads_total", ctypes.c_uint64),
+        ("reads_full", ctypes.c_uint64),
+        ("reads_delta", ctypes.c_uint64),
+        ("reads_not_modified", ctypes.c_uint64),
+        ("reads_shed", ctypes.c_uint64),
+        ("reads_error", ctypes.c_uint64),
+        ("rejected_frames", ctypes.c_uint64),
+        ("eof_mid_request", ctypes.c_uint64),
+        ("coalesce_hits", ctypes.c_uint64),
+        ("delta_bytes_saved", ctypes.c_uint64),
+        ("bytes_sent", ctypes.c_uint64),
+        ("pump_calls", ctypes.c_uint64),
+        ("pump_ns", ctypes.c_uint64),
+    ]
+
+
+assert ctypes.sizeof(_ReadStats) == 128
+
+
+def get_read_lib() -> Optional[ctypes.CDLL]:
+    """Build (once) and load the ``tps_read_*`` entry points from
+    native/tcpps.cpp; None without a toolchain or when the cached
+    library predates the read tier (the mtime rebuild makes that a
+    hand-copied-library corner case)."""
+    global _read_lib, _read_lib_failed
+    if _read_lib is not None:
+        return _read_lib
+    if _read_lib_failed:
+        return None
+    from pytorch_ps_mpi_tpu.utils.native import build_and_load
+
+    lib = build_and_load("tcpps.cpp")
+    if lib is None:
+        _read_lib_failed = True
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    try:
+        lib.tps_read_create.restype = ctypes.c_void_p
+        lib.tps_read_create.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                                        ctypes.c_uint64, ctypes.c_double,
+                                        ctypes.c_char_p]
+        lib.tps_read_port.restype = ctypes.c_uint16
+        lib.tps_read_port.argtypes = [ctypes.c_void_p]
+        lib.tps_read_publish.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, u8p,
+            ctypes.c_uint64, ctypes.c_uint64]
+        lib.tps_read_add_delta.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, u8p,
+            ctypes.c_uint64, ctypes.c_uint64]
+        lib.tps_read_pump.restype = ctypes.c_int
+        lib.tps_read_pump.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.tps_read_released.restype = ctypes.c_int
+        lib.tps_read_released.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
+        lib.tps_read_stats.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(_ReadStats)]
+        lib.tps_read_set_admission.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double]
+        lib.tps_read_wake.argtypes = [ctypes.c_void_p]
+        lib.tps_read_close.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        _read_lib_failed = True
+        return None
+    _verify_read_abi(lib)
+    _read_lib = lib
+    return _read_lib
+
+
+def _verify_read_abi(lib: ctypes.CDLL) -> None:
+    """Load-time twin of the abi-drift rule for the read plane: re-read
+    the PSR1 struct sizes/magic from the loaded library and refuse it on
+    any mismatch with serving/net.py."""
+    from pytorch_ps_mpi_tpu.serving import net as _net
+
+    lib.tps_abi_psr_magic.restype = ctypes.c_uint32
+    lib.tps_abi_psr_req_bytes.restype = ctypes.c_uint32
+    lib.tps_abi_psr_rep_bytes.restype = ctypes.c_uint32
+    lib.tps_abi_read_stats_bytes.restype = ctypes.c_uint32
+    checks = (
+        ("PSR1 magic", int(lib.tps_abi_psr_magic()), _net.MAGIC),
+        ("PSR1 request bytes", int(lib.tps_abi_psr_req_bytes()),
+         _net._REQ.size),
+        ("PSR1 reply bytes", int(lib.tps_abi_psr_rep_bytes()),
+         _net._REP.size),
+        ("ReadStats bytes", int(lib.tps_abi_read_stats_bytes()),
+         ctypes.sizeof(_ReadStats)),
+    )
+    for what, native_v, py_v in checks:
+        if native_v != py_v:
+            raise RuntimeError(
+                f"native/tcpps.cpp read-tier ABI drift: {what} is "
+                f"{native_v} in the loaded library but {py_v} on the "
+                "Python side — rebuild native/_build or reconcile")
+
+
+class NativeReadServer:
+    """The C++ read tier behind :class:`~.core.ServingCore`.
+
+    Same construction/teardown surface as
+    :class:`~.net.ReadTierServer`; the pump runs on a daemon thread that
+    blocks in ``tps_read_pump`` (GIL released) and drains release
+    tokens. Raises ``RuntimeError`` when the native listener cannot be
+    created — the core then falls back to the Python loop.
+    """
+
+    native = True
+
+    def __init__(self, core, port: int = 0, host: str = "0.0.0.0"):
+        lib = get_read_lib()
+        if lib is None:
+            raise RuntimeError("native read tier unavailable")
+        self.core = core
+        self._lib = lib
+        self._handle = lib.tps_read_create(  # psanalyze: ok thread-affinity
+            host.encode(), int(port), int(core.admission_depth),
+            float(core.retry_after_s), core.default_tenant.encode())
+        if not self._handle:
+            raise RuntimeError(
+                f"tps_read_create failed (host {host!r} port {port})")
+        self.port = int(lib.tps_read_port(self._handle))  # psanalyze: ok thread-affinity
+        # token -> release hook (ring unpin / delta-buffer drop); shared
+        # between the publish thread (insert) and the pump thread (pop)
+        self._pins: Dict[int, Callable[[], None]] = {}
+        self._pins_lock = threading.Lock()
+        self._next_token = 1
+        self._final_stats: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump_loop, daemon=True,
+            name=f"read-native:{self.port}")
+        self._thread.start()
+
+    # -- pump thread ------------------------------------------------------
+    def _pump_loop(self) -> None:
+        toks = (ctypes.c_uint64 * 64)()
+        while not self._stop.is_set():
+            self._lib.tps_read_pump(self._handle, 50)  # psanalyze: ok thread-affinity
+            while True:
+                n = self._lib.tps_read_released(  # psanalyze: ok thread-affinity
+                    self._handle, toks, 64)
+                if n <= 0:
+                    break
+                for i in range(n):
+                    self._release(int(toks[i]))
+
+    def _release(self, token: int) -> None:
+        with self._pins_lock:
+            hook = self._pins.pop(token, None)
+        if hook is not None:
+            hook()
+
+    def _token(self, hook: Callable[[], None]) -> int:
+        with self._pins_lock:
+            tok = self._next_token
+            self._next_token += 1
+            self._pins[tok] = hook
+        return tok
+
+    # -- publish boundary -------------------------------------------------
+    def on_publish(self, tenant: str, version: int, store) -> None:
+        """Version-window boundary: pin the new latest, pre-encode the
+        ring's deltas, install everything natively. Called from the
+        publish path right after ``store.put``."""
+        latest = store.acquire(int(version))
+        if latest is None:
+            return  # evicted already (ring 1 races) — nothing to serve
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        flat_u8 = latest.flat.view(np.uint8)
+        tok = self._token(lambda s=latest, st=store: st.release(s))
+        self._lib.tps_read_publish(  # psanalyze: ok thread-affinity
+            self._handle, tenant.encode(), int(version),
+            flat_u8.ctypes.data_as(u8p), flat_u8.nbytes, tok)
+        # pre-encode base -> latest for every ring-resident base: the
+        # one encode per (base, latest) pair the Python path coalesces
+        # lazily happens HERE, once, so serving it never touches Python
+        try:
+            codec = self.core._delta(tenant)
+        except ValueError:
+            return  # no template recorded: full reads only
+        for base_version in store.versions():
+            if base_version >= int(version):
+                continue
+            base = store.acquire(base_version)
+            if base is None:
+                continue
+            try:
+                payload = codec.encode(base.flat, latest.flat)
+            except Exception:
+                payload = None  # size drift etc: full fallback
+            finally:
+                store.release(base)
+            if payload is None:
+                continue  # delta not worth it: native serves full
+            pay_u8 = payload.view(np.uint8)
+            dtok = self._token(lambda p=payload: None)  # keepalive ref
+            self._lib.tps_read_add_delta(  # psanalyze: ok thread-affinity
+                self._handle, tenant.encode(), int(base_version),
+                pay_u8.ctypes.data_as(u8p), pay_u8.nbytes, dtok)
+
+    # -- ReadTierServer surface -------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        # after close() the C++ counters are gone — serve the final block
+        # captured at teardown so post-run accounting (server.metrics()
+        # after server.close()) matches the Python loop, whose counters
+        # live on the core object and survive teardown
+        if self._handle is None:
+            return dict(self._final_stats)
+        st = _ReadStats()
+        self._lib.tps_read_stats(self._handle, ctypes.byref(st))  # psanalyze: ok thread-affinity
+        return {name: int(getattr(st, name)) for name, _ in st._fields_}
+
+    def queue_depth(self) -> int:
+        return self.stats()["pending"]
+
+    def connections(self) -> int:
+        return self.stats()["conns"]
+
+    def set_admission(self, depth: int, retry_after_s: float) -> None:
+        self._lib.tps_read_set_admission(  # psanalyze: ok thread-affinity
+            self._handle, int(depth), float(retry_after_s))
+
+    def close(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._lib.tps_read_wake(self._handle)  # psanalyze: ok thread-affinity
+        self._thread.join(timeout=5)
+        self._final_stats = self.stats()
+        self._lib.tps_read_close(self._handle)  # psanalyze: ok thread-affinity
+        self._handle = None
+        # every pin the released queue never surfaced is dropped now —
+        # the C++ side is gone, so no view can still be in flight
+        with self._pins_lock:
+            hooks = list(self._pins.values())
+            self._pins.clear()
+        for hook in hooks:
+            hook()

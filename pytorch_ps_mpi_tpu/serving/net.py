@@ -136,6 +136,11 @@ class ReadTierServer:
         # admission backlog: parsed-but-unanswered requests. Depth past
         # the core's admission_depth is shed at PARSE time.
         self._backlog: collections.deque = collections.deque()
+        # torn-frame accounting (same fields as the native tier's
+        # ReadStats): bad-magic/op requests and peers that vanished with
+        # a partial request still buffered
+        self.rejected_frames = 0
+        self.eof_mid_request = 0
         self._conns: Dict[socket.socket, _Conn] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -215,9 +220,14 @@ class ReadTierServer:
         except (BlockingIOError, InterruptedError):
             return
         except OSError:
+            if conn.rx:
+                self.eof_mid_request += 1
             self._drop(conn)
             return
         if not chunk:
+            if conn.rx:
+                # peer hung up mid-frame: a partial request was buffered
+                self.eof_mid_request += 1
             self._drop(conn)
             return
         conn.rx += chunk
@@ -243,6 +253,7 @@ class ReadTierServer:
             return None
         magic, op, flags, tlen, have = _REQ.unpack_from(conn.rx, 0)
         if magic != MAGIC or op != OP_READ:
+            self.rejected_frames += 1
             conn.rx.clear()
             self._reply(conn, KIND_ERROR, 0, 0, b"bad request magic/op")
             conn.closing = True

@@ -237,6 +237,21 @@ class RoundAnatomy:
             old = self._hop_trace_order.popleft()
             self._hop_trace.pop(old, None)
 
+    def observe_reader_round(self, row: Dict[str, Any]) -> Dict[str, Any]:
+        """One reader/follower poll cycle (the read-plane counterpart of
+        a training round): written as a ``kind="reader_round"`` row into
+        the same ``anatomy-<name>.jsonl`` sidecar.  The offline loaders
+        filter on ``kind == "round"``, so reader rounds ride the file
+        without perturbing round reconstruction — ``ps_report``/greppers
+        see the replica's pull cadence, lag, and relay volume next to
+        the server rounds that produced the versions it relays."""
+        out = dict(row)
+        out["kind"] = "reader_round"
+        out.setdefault("t", time.time())
+        out["name"] = self.name
+        self._write_row(out)
+        return out
+
     def observe_publish(self, row: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         """Decompose one publish row into its round anatomy.  Returns the
         anatomy round row (also written to ``anatomy-<name>.jsonl`` when

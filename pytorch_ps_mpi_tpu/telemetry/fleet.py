@@ -54,6 +54,10 @@ _SUM_KEYS: Dict[str, str] = {
     "stale_drops": "ps_stale_drops_total",
     "reads_total": "ps_reads_total",
     "reads_shed": "ps_reads_shed_total",
+    # read plane: open native reader conns and follower relay volume sum
+    # across the tree (the tree-wide serving capacity actually in use)
+    "native_read_conns": "ps_native_read_conns",
+    "follower_bytes_relayed": "ps_follower_bytes_relayed_total",
     "slo_breaches": "ps_slo_breaches_all_total",
     "tree_composed": "ps_tree_composed_total",
     "control_actions": "ps_control_actions_total",
@@ -71,6 +75,9 @@ _MAX_KEYS: Dict[str, str] = {
     # looks healthy (per-hop cost attribution, DynamiQ's lesson)
     "anatomy_wire_share": "ps_anatomy_wire_share",
     "anatomy_top_saving_frac": "ps_anatomy_top_saving_frac",
+    # the WORST replica's staleness: a distribution tree is only as
+    # fresh as its laggiest hop, so the rollup takes the fleet max
+    "replica_lag_versions": "ps_replica_lag_versions",
 }
 
 #: per-member gauges the skew detector compares across shards
@@ -215,6 +222,11 @@ class FleetMonitor:
             # aggregation-tree cards carry their group id + leaf members
             row["group"] = member["group"]
             row["members"] = member.get("members")
+        if member.get("upstream") is not None:
+            # replica cards carry their tree edge: who they follow and
+            # how many downstream replicas they are provisioned to feed
+            row["upstream"] = member["upstream"]
+            row["fanout"] = member.get("fanout")
         text = self._fetch(url, "/metrics")
         if text is None:
             row["error"] = "unreachable"

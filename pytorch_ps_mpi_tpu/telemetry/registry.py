@@ -379,6 +379,16 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "reads_shed",
     "coalesce_hits",
     "reads_not_modified",
+    # native read plane + follower tier (serving.native_read /
+    # serving.follower): native_read_conns is the reader connections
+    # currently open on the C++ epoll tier (0.0 on the Python loop);
+    # replica_lag_versions is how many versions this replica trailed its
+    # upstream at the last pull (0.0 standalone/current);
+    # follower_bytes_relayed counts bytes pulled from upstream and
+    # re-served by this follower (0.0 when not following)
+    "native_read_conns",
+    "replica_lag_versions",
+    "follower_bytes_relayed",
     # self-driving control plane (control.Controller): all 0.0 when the
     # controller is unarmed. control_actions counts executed controller
     # actions (codec renegotiations, LR re-weights, evict/readmit,
@@ -411,6 +421,9 @@ HEALTH_FLEET_ROLLUP_KEYS: Tuple[str, ...] = (
     "agg_fallbacks",
     "control_actions",
     "control_epoch",
+    "native_read_conns",
+    "replica_lag_versions",
+    "follower_bytes_relayed",
 )
 assert set(HEALTH_FLEET_ROLLUP_KEYS) <= set(PS_SERVER_METRIC_KEYS)
 
@@ -517,6 +530,9 @@ def ps_server_metrics(server) -> Dict[str, float]:
         "coalesce_hits": rm.get("coalesce_hits", 0.0),
         "reads_not_modified": (rm.get("reads_not_modified", 0.0)
                                + float(nat_nm)),
+        "native_read_conns": rm.get("native_read_conns", 0.0),
+        "replica_lag_versions": rm.get("replica_lag_versions", 0.0),
+        "follower_bytes_relayed": rm.get("follower_bytes_relayed", 0.0),
         "control_actions": float(
             cl.actions_total if cl is not None else 0.0),
         "control_epoch": float(cl.epoch if cl is not None else 0.0),

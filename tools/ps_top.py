@@ -166,11 +166,20 @@ def render_fleet(snap: Dict[str, Any],
             f"grads={int(row.get('grads_received', 0))}  "
             f"composed={int(row.get('tree_composed', 0))}  "
             f"worst={row.get('worst_verdict') or '-'}")
-    cols = ["member", "role", "grp", "ok", "verdict", "grads", "version",
-            "stale-p95", "e2e-p95", "reads", "up", "age"]
-    rows = []
     members = sorted((snap.get("members") or {}).values(),
                      key=lambda m: m.get("name", ""))
+    replicas = [m for m in members if m.get("role") == "replica"]
+    if replicas:
+        # follower-tree rollup: tree freshness is its laggiest hop
+        lag_max = fleet.get("replica_lag_versions_max", 0.0)
+        relayed = fleet.get("follower_bytes_relayed", 0.0)
+        lines.append(
+            f"  replicas: {len(replicas)}  lag_max={lag_max:.0f}v  "
+            f"relayed={int(relayed)}B  "
+            f"conns={int(fleet.get('native_read_conns', 0))}")
+    cols = ["member", "role", "grp", "ok", "verdict", "grads", "version",
+            "lag", "stale-p95", "e2e-p95", "reads", "up", "age"]
+    rows = []
     for m in members:
         mm = m.get("metrics") or {}
         rows.append([
@@ -180,6 +189,8 @@ def render_fleet(snap: Dict[str, Any],
             m.get("verdict") or "-",
             str(int(mm.get("grads_received", 0))),
             str(int(mm.get("publish_version", 0))),
+            (f"{mm.get('replica_lag_versions', 0):.0f}"
+             if m.get("role") == "replica" else "-"),
             f"{mm.get('staleness_p95', 0):.1f}",
             f"{mm.get('push_e2e_p95_ms', 0):.1f}",
             str(int(mm.get("reads_total", 0))),
@@ -194,6 +205,8 @@ def render_fleet(snap: Dict[str, Any],
     lines.append("  ".join("-" * w for w in widths))
     for m, r in zip(members, rows):
         line = fmt.format(*r)
+        if m.get("upstream"):
+            line += f"  <- {m['upstream']}"
         if color and (m.get("verdict") in _COLOR):
             line = _COLOR[m["verdict"]] + line + _RESET
         lines.append(line)

@@ -237,4 +237,19 @@ CFG_KEYS = {
                          "ServingCore knobs (ring, admission_depth, ...)"),
     "read_port": CfgKey("int", "cli",
                         "read-tier listener port (0 = auto)"),
+    "read_native": CfgKey("str|bool", "cli",
+                          "C++ epoll read tier: 'auto' (default; "
+                          "Python-loop fallback), False/'off' to pin "
+                          "the Python loop (PS_NO_NATIVE also disarms)",
+                          cli="examples/serve_readonly.py"),
+    "follow_endpoint": CfgKey("str", "cli",
+                              "replica mode: upstream read-tier "
+                              "host:port this node subscribes to and "
+                              "re-serves (the distribution tree edge)",
+                              cli="examples/serve_readonly.py"),
+    "follow_fanout": CfgKey("int", "cli",
+                            "replica mode: downstream replicas this "
+                            "node is provisioned to feed (advertised "
+                            "on its fleet card for tree planning)",
+                            cli="examples/serve_readonly.py"),
 }

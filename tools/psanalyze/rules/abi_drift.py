@@ -36,10 +36,15 @@ BINDING_MODULES = {
     "pytorch_ps_mpi_tpu/parallel/tcp.py": "native/tcpps.cpp",
     "pytorch_ps_mpi_tpu/parallel/dcn.py": "native/psqueue.cpp",
     "pytorch_ps_mpi_tpu/utils/native.py": "native/wirecodec.cpp",
+    # read-plane entry points (tps_read_* / tps_abi_psr_*) live in the
+    # same library as the TPS1 wire but bind from the serving package
+    "pytorch_ps_mpi_tpu/serving/native_read.py": "native/tcpps.cpp",
 }
 FRAMES_PY = "pytorch_ps_mpi_tpu/resilience/frames.py"
 TCPPS_CPP = "native/tcpps.cpp"
 TCP_PY = "pytorch_ps_mpi_tpu/parallel/tcp.py"
+NET_PY = "pytorch_ps_mpi_tpu/serving/net.py"
+NATIVE_READ_PY = "pytorch_ps_mpi_tpu/serving/native_read.py"
 
 _NATIVE_RE = re.compile(r"\b(?:wc|tps|psq)_[A-Za-z0-9_]+")
 
@@ -287,6 +292,7 @@ class AbiDriftRule(Rule):
         findings.extend(self._check_bindings(ctx))
         findings.extend(self._check_frame_constants(ctx))
         findings.extend(self._check_batch_meta(ctx))
+        findings.extend(self._check_read_stats(ctx))
         findings.extend(self._check_reason_enum(ctx))
         return findings
 
@@ -416,6 +422,52 @@ class AbiDriftRule(Rule):
                 self.name, TCP_PY, 1,
                 f"BatchMeta packs to {size} bytes but {TCPPS_CPP} "
                 f"asserts {asserted}"))
+        return findings
+
+    # -- ReadStats struct + PSR1 magic (read plane) ------------------------
+    def _check_read_stats(self, ctx: AnalysisContext) -> List[Finding]:
+        """The read-plane twin of :meth:`_check_batch_meta`: the native
+        ``ReadStats`` counter block is mirrored field-for-field by
+        ``native_read.py``'s ``_ReadStats`` ctypes struct, and the PSR1
+        wire magic is defined once per side (``serving/net.py`` vs
+        ``kPsrMagic``). The runtime twin re-checks magic and struct
+        sizes through the ``tps_abi_*`` exports at library load."""
+        findings: List[Finding] = []
+        tree = ctx.tree(NATIVE_READ_PY)
+        cpp = ctx.source(TCPPS_CPP)
+        if tree is None or cpp is None:
+            return findings
+        c_fields = parse_c_struct(cpp, "ReadStats")
+        py_fields = _ctypes_fields(tree, "_ReadStats")
+        if c_fields is None or py_fields is None:
+            findings.append(Finding(
+                self.name, NATIVE_READ_PY, 1,
+                "ReadStats (C) or _ReadStats (ctypes) struct not found "
+                "— the read-plane stats mirror is gone"))
+            return findings
+        if [(n, t) for n, t in c_fields] != [(n, t) for n, t in py_fields]:
+            findings.append(Finding(
+                self.name, NATIVE_READ_PY, 1,
+                f"ReadStats layout drifted: C has {c_fields}, ctypes "
+                f"mirror has {py_fields}"))
+        size = sum(_SIZES.get(t, 0) for _n, t in c_fields)
+        m = re.search(r"sizeof\(ReadStats\)\s*==\s*(\d+)", cpp)
+        asserted = int(m.group(1)) if m else None
+        if asserted is not None and size != asserted:
+            findings.append(Finding(
+                self.name, NATIVE_READ_PY, 1,
+                f"ReadStats packs to {size} bytes but {TCPPS_CPP} "
+                f"asserts {asserted}"))
+        net_tree = ctx.tree(NET_PY)
+        if net_tree is not None:
+            py_magic = _module_const(net_tree, "MAGIC")
+            c_magic = parse_c_const(cpp, "kPsrMagic")
+            if (py_magic is not None and c_magic is not None
+                    and py_magic != c_magic):
+                findings.append(Finding(
+                    self.name, NET_PY, 1,
+                    f"PSR1 magic is 0x{py_magic:08x} in net.py but "
+                    f"kPsrMagic is 0x{c_magic:08x} in {TCPPS_CPP}"))
         return findings
 
     # -- FrameStatus reason enum ------------------------------------------

@@ -77,6 +77,9 @@ INSTRUMENT_MAP: Dict[str, Optional[str]] = {
     "reads_shed": "ps_reads_shed_total",
     "coalesce_hits": "ps_coalesce_hits_total",
     "reads_not_modified": "ps_reads_not_modified_total",
+    "native_read_conns": "ps_native_read_conns",
+    "replica_lag_versions": "ps_replica_lag_versions",
+    "follower_bytes_relayed": "ps_follower_bytes_relayed_total",
     "control_actions": "ps_control_actions_total",
     "control_epoch": "ps_control_epoch",
     "control_evicted": "ps_control_evicted",

@@ -1,0 +1,283 @@
+"""Native read-plane smoke gate (make read-native-smoke, in the default
+`make test` path).
+
+Proves the C++ epoll read tier end to end against the Python selectors
+loop it replaces, plus one follower hop — each a hard assert:
+
+1. **build + arm** — native/tcpps.cpp builds, the ``tps_read_*`` ABI
+   twin check passes, and a core with ``read_native`` on actually serves
+   from the C++ tier (``serving_snapshot()["read_native"]``);
+2. **wire parity** — raw PSR1 reply byte streams (header AND payload)
+   from the native tier match the Python loop bit-for-bit across the
+   full / delta / not-modified kinds;
+3. **served latency** — the same concurrent full-read workload through
+   both tiers; the native p99 must not regress (the ratio is a
+   bench_gate trajectory metric, so CI flags drift, not noise);
+4. **admission shedding** — a depth-1 storm through the native tier
+   sheds, every reader still completes via retry-after, and the shed
+   fraction rides the trajectory gate;
+5. **replica hop** — a ``FollowerLoop`` replica pulled off the native
+   root re-serves bit-exact bytes with lag 0 and nonzero
+   ``follower_bytes_relayed``.
+
+Skips (exit 0, with a notice) when the toolchain is missing or
+``PS_NO_NATIVE`` is set — the Python loop is the tested fallback and
+the rest of `make test` already covers it.
+
+Appends a trajectory row to
+``benchmarks/results/read_native_smoke.jsonl`` and gates it with
+``tools/bench_gate.py --trajectory``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "benchmarks", "results",
+                       "read_native_smoke.jsonl")
+
+N_ELEMS = 49_000
+TEMPLATE_SHAPE = {"w0": (40_000,), "w1": (9_000,)}
+SERVING_KW = {"ring": 4, "admission_depth": 64, "retry_after_s": 0.005,
+              "delta_bucket_mb": 0.05}
+
+
+def check(name: str, cond: bool, detail: str = "") -> None:
+    status = "ok" if cond else "FAIL"
+    print(f"  [{status}] {name}" + (f" — {detail}" if detail else ""))
+    if not cond:
+        raise SystemExit(f"read_native_smoke: {name} failed ({detail})")
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("server closed connection")
+        out += chunk
+    return bytes(out)
+
+
+def raw_reply(port: int, have_version: int = 0) -> bytes:
+    from pytorch_ps_mpi_tpu.serving import net
+
+    with socket.create_connection(("127.0.0.1", port), timeout=20) as s:
+        s.sendall(net.pack_request(have_version, True, ""))
+        hdr = _recv_exact(s, net._REP.size)
+        return hdr + _recv_exact(s, net._REP.unpack(hdr)[7])
+
+
+def served_quantile(port: int, n_readers: int, reads_each: int,
+                    q: float = 0.99) -> float:
+    """p-quantile served latency (ms) of concurrent full reads — every
+    request does real work (have_version=0), so this times the serve
+    path, not the not-modified fast exit."""
+    from pytorch_ps_mpi_tpu.serving.net import ReadClient
+
+    lats: list = [None] * n_readers
+    barrier = threading.Barrier(n_readers)
+
+    def body(i: int) -> None:
+        c = ReadClient("127.0.0.1", port, timeout=30)
+        mine = []
+        barrier.wait()
+        for _ in range(reads_each):
+            t0 = time.perf_counter()
+            kind, _, _, retry_after, _ = c.request(have_version=0)
+            if kind == "retry":
+                time.sleep(retry_after)
+                continue
+            mine.append(time.perf_counter() - t0)
+        lats[i] = mine
+        c.close()
+
+    threads = [threading.Thread(target=body, args=(i,))
+               for i in range(n_readers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    flat = [v for sub in lats if sub for v in sub]
+    assert flat, "no reads completed"
+    return float(np.quantile(np.array(flat), q) * 1e3)
+
+
+def main() -> int:
+    from pytorch_ps_mpi_tpu.serving import (
+        FollowerLoop,
+        ServingCore,
+        ServingReader,
+    )
+    from pytorch_ps_mpi_tpu.serving.native_read import get_read_lib
+    from pytorch_ps_mpi_tpu.utils.native import fast_path_disabled
+
+    t_wall0 = time.perf_counter()
+    if fast_path_disabled():
+        print("read_native_smoke: SKIP (PS_NO_NATIVE set; the Python "
+              "loop is covered by make read-smoke)")
+        return 0
+    if get_read_lib() is None:
+        print("read_native_smoke: SKIP (no C++ toolchain; the Python "
+              "loop is covered by make read-smoke)")
+        return 0
+
+    template = {k: np.zeros(s, np.float32)
+                for k, s in TEMPLATE_SHAPE.items()}
+    rng = np.random.RandomState(0)
+    flat_v1 = rng.randn(N_ELEMS).astype(np.float32)
+    flat_v2 = flat_v1.copy()
+    flat_v2[rng.choice(N_ELEMS, 120, replace=False)] += 0.5
+
+    # -- 1. build + arm ----------------------------------------------------
+    nat = ServingCore(None, {"read_port": 0, "read_native": True,
+                             "serving_kw": SERVING_KW}, template=template)
+    py = ServingCore(None, {"read_port": 0, "read_native": False,
+                            "serving_kw": SERVING_KW}, template=template)
+    check("native tier armed",
+          nat.serving_snapshot()["read_native"] is True
+          and py.serving_snapshot()["read_native"] is False)
+
+    # -- 2. wire parity: raw reply streams bit-for-bit ---------------------
+    for core in (nat, py):
+        core.publish(flat=flat_v1.copy())
+        core.publish(flat=flat_v2.copy())
+    for label, have in (("full", 0), ("delta", 1), ("not_modified", 2)):
+        a, b = raw_reply(nat.read_port, have), raw_reply(py.read_port, have)
+        check(f"reply parity: {label}", a == b,
+              f"{len(a)}B native vs {len(b)}B python")
+
+    # -- 3. served p99, same workload through both tiers -------------------
+    n_readers, reads_each = 24, 15
+    nat_p99 = served_quantile(nat.read_port, n_readers, reads_each)
+    py_p99 = served_quantile(py.read_port, n_readers, reads_each)
+    ratio = nat_p99 / max(py_p99, 1e-9)
+    print(f"  served p99: native {nat_p99:.2f} ms, python {py_p99:.2f} ms "
+          f"(ratio {ratio:.2f})")
+    st = nat.read_server.stats()
+    check("native tier answered the workload",
+          st["reads_full"] >= n_readers * reads_each,
+          f"reads_full={st['reads_full']}")
+    check("native zero-copy sends drained",
+          st["bytes_sent"] >= n_readers * reads_each * N_ELEMS * 4,
+          f"bytes_sent={st['bytes_sent']}")
+    py.close()
+
+    # -- 4. admission shedding on the native tier --------------------------
+    # the C++ tier sheds on PENDING replies (admitted but not yet
+    # drained), and parses a pipelined burst in one pass before any
+    # flush: at depth 1, request #1 of a back-to-back burst is admitted
+    # and the rest MUST come back as retry-after — deterministically
+    from pytorch_ps_mpi_tpu.serving import net as _net
+
+    nat.read_server.set_admission(1, 0.002)
+    n_burst = 8
+    with socket.create_connection(("127.0.0.1", nat.read_port),
+                                  timeout=20) as s:
+        s.sendall(_net.pack_request(0, True, "") * n_burst)
+        kinds = []
+        retry_after = 0.0
+        for _ in range(n_burst):
+            hdr = _recv_exact(s, _net._REP.size)
+            _, kind, _, _, _, _, ra, plen = _net._REP.unpack(hdr)
+            _recv_exact(s, plen)
+            kinds.append(kind)
+            if kind == _net.KIND_RETRY:
+                retry_after = ra
+        shed_replies = kinds.count(_net.KIND_RETRY)
+        check("native admission shed fired (depth 1)",
+              kinds[0] == _net.KIND_FULL and shed_replies >= 1,
+              f"kinds={kinds}")
+        check("shed replies carry the retry-after hint",
+              retry_after == 0.002, f"retry_after={retry_after}")
+        # honoring the hint lands: the same connection's retry is served
+        time.sleep(retry_after)
+        s.sendall(_net.pack_request(0, True, ""))
+        hdr = _recv_exact(s, _net._REP.size)
+        _, kind, _, _, _, _, _, plen = _net._REP.unpack(hdr)
+        _recv_exact(s, plen)
+        check("shed reader retried to completion",
+              kind == _net.KIND_FULL, f"kind={kind}")
+    shed_total = nat.read_server.stats()["reads_shed"]
+    check("shed accounting matches the wire",
+          shed_total == shed_replies, f"{shed_total} vs {shed_replies}")
+    shed_frac = shed_replies / float(n_burst)
+    nat.read_server.set_admission(SERVING_KW["admission_depth"],
+                                  SERVING_KW["retry_after_s"])
+
+    # -- 5. follower replica hop off the native root -----------------------
+    rep = ServingCore(None, {"read_port": 0, "serving_kw": SERVING_KW},
+                      template=template)
+    follower = FollowerLoop(rep, "127.0.0.1", nat.read_port,
+                            template=template, poll_s=0.01,
+                            serving_kw=SERVING_KW)
+    out = follower.step()
+    check("replica republished the root's latest",
+          out["outcome"] == "republished" and out["version"] == 2,
+          f"{out}")
+    r = ServingReader("127.0.0.1", rep.read_port, template,
+                      serving_kw=SERVING_KW)
+    r.read_params()
+    check("replica serves bit-exact bytes",
+          np.array_equal(r._flat.view(np.uint32),
+                         flat_v2.view(np.uint32)))
+    flat_v3 = flat_v2.copy()
+    flat_v3[:64] -= 0.25
+    nat.publish(flat=flat_v3.copy())
+    follower.step()
+    _, ver = r.read_params()
+    m = rep.read_metrics()
+    check("delta hop through the replica is current",
+          ver == 3 and np.array_equal(r._flat.view(np.uint32),
+                                      flat_v3.view(np.uint32)))
+    check("replica lag settled at 0",
+          m["replica_lag_versions"] == 0.0,
+          f"lag={m['replica_lag_versions']}")
+    check("relay accounting is nonzero",
+          m["follower_bytes_relayed"] > 0,
+          f"relayed={m['follower_bytes_relayed']}")
+    relayed = int(m["follower_bytes_relayed"])
+    r.close()
+    follower.close()
+    rep.close()
+    nat.close()
+
+    wall = time.perf_counter() - t_wall0
+    row = {
+        "bench": "read_native_smoke", "t": time.time(),
+        "wall_s": round(wall, 3),
+        "native_p99_ms": round(nat_p99, 3),
+        "python_p99_ms": round(py_p99, 3),
+        "p99_ratio": round(ratio, 3),
+        "shed_frac": round(shed_frac, 4),
+        "relayed_bytes": relayed,
+    }
+    os.makedirs(os.path.dirname(RESULTS), exist_ok=True)
+    with open(RESULTS, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(f"read_native_smoke: all checks green in {wall:.1f}s — {row}")
+
+    rc = subprocess.call([
+        sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
+        "--trajectory", RESULTS,
+        "--metric", "read_native_smoke.wall_s:lower:1.5",
+        "--metric", "read_native_smoke.native_p99_ms:lower:3.0",
+        "--metric", "read_native_smoke.p99_ratio:lower:1.0",
+        "--metric", "read_native_smoke.shed_frac:lower:2.0",
+    ])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
