@@ -18,6 +18,7 @@ test:
 	$(MAKE) obs-smoke
 	$(MAKE) tree-smoke
 	$(MAKE) control-smoke
+	$(MAKE) topo-smoke
 	$(MAKE) whatif-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
@@ -210,6 +211,26 @@ control-smoke:
 		--metric 'control_smoke.wall_total_s:lower:1.5' \
 		--metric 'control_smoke.loss_ratio:lower:0.5'
 
+# Structural-control gate (in the default `make test` path): topology
+# as a control action, live. A slow_leader fold hotspot must be
+# attributed (anatomy advisor + hot_hop), healed by a latched
+# group_replan through run_tree's supervision lists (moved leaf
+# repoints via control-topo.json, composed accounting exact across the
+# transition), and the controlled round cadence must beat the same
+# scenario left static. A seeded reader_storm against a pinned tiny
+# admission depth must scale a serve_readonly replica OUT (fleet card
+# registered, model served through the replica's own read port) and
+# back IN once idle (card deregistered, verdict tier_idle). Zero
+# flaps; Controller.replay re-derives the actions byte-identically.
+# Gated below via bench_gate (wall + span-ratio trajectory rows in
+# benchmarks/results/topo_smoke.jsonl).
+topo-smoke:
+	JAX_PLATFORMS=cpu python tools/topo_smoke.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/topo_smoke.jsonl \
+		--metric 'topo_smoke.wall_total_s:lower:1.5' \
+		--metric 'topo_smoke.span_ratio:lower:0.5'
+
 # Round-anatomy what-if gate (in the default `make test` path): a
 # 3-worker sync run with 200 ms injected into worker 1's WIRE stage
 # (fault kind wire_delay — the sleep sits between the frame's
@@ -301,4 +322,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-native-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke whatif-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-native-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke topo-smoke whatif-smoke

@@ -125,6 +125,11 @@ def main(argv=None):
                          "(anatomy-<fleet name>.jsonl) here")
     ap.add_argument("--duration", type=float, default=0.0,
                     help="exit after this many seconds (0 = forever)")
+    ap.add_argument("--control-dir", default=None,
+                    help="replica mode: poll control-topo.json here and "
+                         "re-parent the subscription when its "
+                         "replica_upstream map names this replica "
+                         "(structural control's elastic read tier)")
     args = ap.parse_args(argv)
     if not args.checkpoint_dir and not args.follow_endpoint:
         ap.error("--checkpoint-dir is required unless --follow-endpoint")
@@ -197,6 +202,30 @@ def main(argv=None):
     print(json.dumps(hello), flush=True)
 
     deadline = time.time() + args.duration if args.duration else None
+    topo_state = {"seq": 0, "mtime": 0}
+    replica_name = str(cfg.get("fleet_name") or f"replica-{os.getpid()}")
+
+    def _poll_reparent():
+        # structural control: a scale event can rebuild the replica
+        # tree — control-topo.json's replica_upstream map names each
+        # replica's (possibly new) parent; repoint is idempotent
+        if not (args.control_dir and follower is not None):
+            return
+        from pytorch_ps_mpi_tpu.control.topo import poll_topo
+
+        doc = poll_topo(args.control_dir, topo_state)
+        if doc is None:
+            return
+        up = (doc.get("replica_upstream") or {}).get(replica_name)
+        if not up:
+            return
+        host, _, port = str(up).rpartition(":")
+        try:
+            if follower.repoint(host or "127.0.0.1", int(port)):
+                print(json.dumps({"reparented": up}), flush=True)
+        except (TypeError, ValueError):
+            pass
+
     last_step = step
     # idle-backoff pacing (tpu_watch-style): a fresh checkpoint snaps the
     # poll back to the base cadence; every empty poll doubles it
@@ -207,6 +236,7 @@ def main(argv=None):
         while deadline is None or time.time() < deadline:
             time.sleep(sleep_s if deadline is None
                        else min(sleep_s, max(deadline - time.time(), 0)))
+            _poll_reparent()
             if args.follow and args.checkpoint_dir:
                 try:
                     params, version, step, _ = restore_latest(

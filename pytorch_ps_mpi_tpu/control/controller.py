@@ -44,6 +44,22 @@ ever touches a native transport handle):
 4. **read-tier tuning**: admission depth follows the shed rate (raised
    under shed pressure while the read p95 holds its target, halved when
    the p95 burns), and the snapshot ring grows on ring-ageout pressure.
+5. **structural actions** (rule ``topo``, armed by
+   ``cfg["topo_actions"]``): the TOPOLOGY itself becomes an actuator.
+   When the PR 15 anatomy advisor ranks ``leader_fold`` as the top
+   debottleneck (or one tree leader churns past its respawn latch) the
+   hot group is SPLIT — members migrate to a freshly promoted leader
+   through ``run_tree``'s pinned-port respawn machinery, every
+   in-flight push exactly accounted by the existing degraded-round
+   fold (see :mod:`pytorch_ps_mpi_tpu.control.topo`). Shed-rate burn
+   scales the PR 17 follower read tier OUT (spawn
+   ``serve_readonly --follow-endpoint`` replicas); replica-lag burn or
+   a sustained-idle tier scales it back IN. The PR 10 fleet skew
+   verdict becomes a recorded shard split/merge PLAN
+   (``control-topo.json``) applied at the next generation. Structural
+   actions are latched, flap-counted, reversible rows like every other
+   rule — ``group_replan`` has ``group_merge``, ``shard_split`` has
+   ``shard_merge``, a scale-out has its scale-in.
 
 Every decision is an event row in ``control-<name>.jsonl`` carrying the
 **triggering verdict**, the old/new setting, and the worker (when
@@ -64,9 +80,15 @@ verdicts. Setpoints come calibrated from the committed perf trajectory
 via :func:`telemetry.slo.derive_targets`; explicit
 ``cfg["control_kw"]["read_p95_target_ms"]`` wins.
 
+Every action row carries its **triggering verdict** with a
+monotonically increasing ``id`` and the owning ``rule`` name injected
+by the engine itself — the audit join key ``telemetry_report`` uses to
+show actions next to the verdicts that caused them (and, being pure
+engine state, byte-identical under replay).
+
 Opt-outs: ``control_kw["pin"]`` lists rule names
-(``codec``/``lr_scale``/``evict``/``read_tier``) whose settings are
-pinned — the controller observes but never acts on them.
+(``codec``/``lr_scale``/``evict``/``read_tier``/``topo``) whose
+settings are pinned — the controller observes but never acts on them.
 """
 
 from __future__ import annotations
@@ -117,10 +139,24 @@ CONTROL_KNOBS: Dict[str, Any] = {
     "ring_grow_per_s": 0.5,    # ring ageouts/s above => grow the ring
     "ring_max": 64,
     "read_p95_target_ms": None,  # None => slo.derive_targets()
+    # -- structural actions (rule "topo"; cfg["topo_actions"] arms) -----
+    "topo_actions": False,       # master switch (mirrors cfg key)
+    "replan_max": 1,             # group splits per run (spare wid slots)
+    "replan_cooldown_s": 20.0,   # min gap between structural replans
+    "leader_fold_hot_frac": 0.2,  # advisor saving_frac flagging a hop hot
+    "leader_churn_replan": 2.0,  # leader respawns before a churn replan
+    "replica_min": 0,            # read-tier floor (scale-out bootstraps)
+    "replica_max": 4,            # read-tier ceiling
+    "replica_cooldown_s": 10.0,  # min gap between replica scale steps
+    "replica_shed_per_s": 2.0,   # root sheds/s that scale the tier OUT
+    "replica_lag_hi": 8.0,       # worst replica lag (versions) => IN
+    "shard_cooldown_s": 30.0,    # min gap between shard plan changes
+    "shard_split_skew": 0.5,     # fleet skew spread_frac that splits
+    "shard_merge_skew": 0.1,     # spread below which a split merges back
 }
 
 #: rule names ``control_kw["pin"]`` accepts
-RULES = ("codec", "lr_scale", "evict", "read_tier")
+RULES = ("codec", "lr_scale", "evict", "read_tier", "topo")
 
 
 def epoch_path(control_dir: str) -> str:
@@ -300,6 +336,13 @@ class ControlEngine:
             self.read_p95_target_ms = float(
                 derive_targets("benchmarks/results",
                                "BENCH_r*.json")["read_p95_ms"])
+        # structural-action state (rule "topo"): the engine's intended
+        # shape — the executors chase it, never the other way round
+        self.replans = 0           # tree group splits in force
+        self.replicas = 0          # intended read-tier replica count
+        self.shard_extra = 0       # planned shard-count delta (+1/0)
+        self._replica_idle_since: Optional[float] = None
+        self.topo_actions = 0      # structural action rows emitted
         self.actions: List[Dict[str, Any]] = []
         self.flaps = 0
         self.t0: Optional[float] = None
@@ -339,12 +382,17 @@ class ControlEngine:
                 and new == hist[-1][1] and hist[-1][2] == hist[-2][1]):
             self.flaps += 1
         hist.append((t, old, new))
+        # audit join key: every verdict carries a monotone id + the
+        # owning rule — engine state, so replay reproduces both
+        verdict = {"id": len(self.actions), "rule": rule, **verdict}
         row: Dict[str, Any] = {
             "t": _r(t, 4), "rule": rule, "action": action,
             "old": old, "new": new, "verdict": verdict,
         }
         if worker is not None:
             row["worker"] = int(worker)
+        if rule == "topo":
+            self.topo_actions += 1
         self.actions.append(row)
         self._last_action[key] = t
         return row
@@ -366,6 +414,7 @@ class ControlEngine:
             self._step_lr(row, t)
             self._step_evict(row, t)
             self._step_read_tier(row, t)
+            self._step_topo(row, t)
         return self.actions[n0:]
 
     # -- rule: codec / bucket_mb / agg renegotiation ----------------------
@@ -594,6 +643,124 @@ class ControlEngine:
                        "ageouts_per_s": _r(ageout_rate)},
                       latch=("read_tier", "ring"))
 
+    # -- rule: structural actions (topology as an actuator) ---------------
+    def _step_topo(self, row: Dict[str, Any], t: float) -> None:
+        if not self.knobs.get("topo_actions") or "topo" in self.pin:
+            return
+        k = self.knobs
+        # (a) tree re-plan: the advisor's ranked debottleneck decides —
+        # a replan only fires when leader_fold is the TOP stage and its
+        # projected saving clears the hot threshold (or a leader churns
+        # past the respawn latch: respawn loops are structural too)
+        if row.get("tree_groups", 0.0) > 0:
+            hot = int(row.get("hot_group", -1.0))
+            churn_grp = int(row.get("hot_churn_group", -1.0))
+            saving = float(row.get("lf_saving_frac", 0.0))
+            fold_hot = (row.get("lf_top", 0.0) > 0 and hot >= 0
+                        and saving >= float(k["leader_fold_hot_frac"]))
+            churn_hot = (churn_grp >= 0
+                         and float(row.get("leader_respawns", 0.0))
+                         >= float(k["leader_churn_replan"]))
+            if (self.replans < int(k["replan_max"])
+                    and (fold_hot or churn_hot)
+                    and self._cooled(("topo", "replan"), t,
+                                     float(k["replan_cooldown_s"]))):
+                self.replans += 1
+                if fold_hot:
+                    verdict = {"kind": "leader_fold_hot", "group": hot,
+                               "saving_frac": _r(saving)}
+                else:
+                    verdict = {"kind": "leader_churn",
+                               "group": churn_grp,
+                               "respawns": _r(row.get(
+                                   "leader_respawns", 0.0))}
+                self._act(t, "topo", "group_replan",
+                          self.replans - 1, self.replans, verdict,
+                          latch=("topo", "replan"))
+            elif (self.replans > 0 and not fold_hot and not churn_hot
+                  # merge hysteresis: the hop must be COLD (saving well
+                  # under the split threshold) for a doubled cooldown —
+                  # a split that merges back on one quiet window would
+                  # be the replan-storm failure mode
+                  and saving < 0.5 * float(k["leader_fold_hot_frac"])
+                  and self._cooled(("topo", "replan"), t,
+                                   2.0 * float(k["replan_cooldown_s"]))):
+                self.replans -= 1
+                self._act(t, "topo", "group_merge",
+                          self.replans + 1, self.replans,
+                          {"kind": "hotspot_cleared",
+                           "saving_frac": _r(saving)},
+                          latch=("topo", "replan"))
+        # (b) elastic read tier: shed burn scales OUT, replica-lag burn
+        # or a sustained-idle tier scales IN — replicas are actuators,
+        # not hand-sized cfg
+        if row.get("serving", 0.0) > 0 and int(k["replica_max"]) > 0:
+            shed_rate = self._rate("topo_reads_shed", t,
+                                   float(row.get("reads_shed", 0.0)))
+            lag = float(row.get("replica_lag_max", 0.0))
+            if shed_rate > 0 or self.replicas <= int(k["replica_min"]):
+                self._replica_idle_since = None
+            elif self._replica_idle_since is None:
+                self._replica_idle_since = t
+            idle = (self._replica_idle_since is not None
+                    and t - self._replica_idle_since
+                    >= 2.0 * float(k["replica_cooldown_s"]))
+            if (self.replicas < int(k["replica_max"])
+                    and (shed_rate >= float(k["replica_shed_per_s"])
+                         or self.replicas < int(k["replica_min"]))
+                    and self._cooled(("topo", "replica"), t,
+                                     float(k["replica_cooldown_s"]))):
+                old = self.replicas
+                self.replicas += 1
+                if shed_rate >= float(k["replica_shed_per_s"]):
+                    verdict = {"kind": "shed_pressure",
+                               "sheds_per_s": _r(shed_rate)}
+                else:
+                    verdict = {"kind": "tier_floor",
+                               "replica_min": int(k["replica_min"])}
+                self._act(t, "topo", "replica", old, self.replicas,
+                          verdict, latch=("topo", "replica"))
+            elif (self.replicas > int(k["replica_min"])
+                  and (lag >= float(k["replica_lag_hi"]) or idle)
+                  and self._cooled(("topo", "replica"), t,
+                                   float(k["replica_cooldown_s"]))):
+                old = self.replicas
+                self.replicas -= 1
+                if lag >= float(k["replica_lag_hi"]):
+                    verdict = {"kind": "replica_lag_burn",
+                               "lag_versions": _r(lag)}
+                else:
+                    verdict = {"kind": "tier_idle",
+                               "idle_s": _r(t - self._replica_idle_since)}
+                self._act(t, "topo", "replica", old, self.replicas,
+                          verdict, latch=("topo", "replica"))
+        # (c) shard split/merge: the PR 10 fleet skew verdict becomes a
+        # recorded PLAN (control-topo.json; applied at the next
+        # generation through sharded.planned_shards) — never a live
+        # migration
+        shards = int(row.get("shards_n", 0.0))
+        if shards >= 2:
+            skew = float(row.get("shard_skew", 0.0))
+            if (self.shard_extra == 0
+                    and row.get("shard_skew_hot", 0.0) > 0
+                    and skew >= float(k["shard_split_skew"])
+                    and self._cooled(("topo", "shard"), t,
+                                     float(k["shard_cooldown_s"]))):
+                self.shard_extra = 1
+                self._act(t, "topo", "shard_split", shards, shards + 1,
+                          {"kind": "shard_skew",
+                           "spread_frac": _r(skew)},
+                          latch=("topo", "shard"))
+            elif (self.shard_extra > 0
+                  and skew <= float(k["shard_merge_skew"])
+                  and self._cooled(("topo", "shard"), t,
+                                   2.0 * float(k["shard_cooldown_s"]))):
+                self.shard_extra = 0
+                self._act(t, "topo", "shard_merge", shards + 1, shards,
+                          {"kind": "skew_cleared",
+                           "spread_frac": _r(skew)},
+                          latch=("topo", "shard"))
+
     # -- surfaces ---------------------------------------------------------
     def lr_scale_min(self) -> float:
         return min(self.lr_scale.values()) if self.lr_scale else 1.0
@@ -616,6 +783,11 @@ class ControlEngine:
             "ring": self.ring,
             "read_p95_target_ms": _r(self.read_p95_target_ms, 3),
             "pinned": sorted(self.pin),
+            "topo_armed": bool(self.knobs.get("topo_actions")),
+            "topo_actions": self.topo_actions,
+            "group_replans": self.replans,
+            "replicas": self.replicas,
+            "shard_extra": self.shard_extra,
             "recent_actions": self.actions[-8:],
         }
 
@@ -638,6 +810,11 @@ class Controller:
         self.knobs = dict(CONTROL_KNOBS)
         self.knobs.update(cfg.get("control_kw") or {})
         self.knobs.update(overrides)
+        # the structural-action switch is a TOP-LEVEL cfg key (callers
+        # arm it like cfg["control"]); the knob mirrors it so the pure
+        # engine sees one boolean — replay() derives it the same way
+        if cfg.get("topo_actions"):
+            self.knobs["topo_actions"] = True
         self.server = server
         self.core = core if core is not None else getattr(
             server, "serving_core", None)
@@ -686,6 +863,10 @@ class Controller:
             depth=depth, ring=ring,
             agg_ok=ladder_agg_ok(self.knobs.get("ladder"),
                                  str(cfg.get("agg", "auto"))))
+        # elastic read tier: the replica scaler is built lazily at the
+        # first scale action (the core's read listener may bind after
+        # construction) — see _replica_scaler()
+        self._replicas = None
         # per-worker staleness EWMAs — the lineage-off fallback input
         # (exact per-push staleness windows win when lineage is armed)
         self._stale_ewma: Dict[int, Optional[float]] = {}
@@ -762,6 +943,20 @@ class Controller:
     @property
     def evicted(self):
         return self.engine.evicted
+
+    @property
+    def topo_actions_total(self) -> int:
+        return self.engine.topo_actions
+
+    @property
+    def group_replans(self) -> int:
+        return self.engine.replans
+
+    @property
+    def replicas_live(self) -> int:
+        """REAL live replica processes (the scaler's truth), not the
+        engine's intent — a failed spawn shows up as the gap."""
+        return self._replicas.live if self._replicas is not None else 0
 
     def lr_scale_min(self) -> float:
         return self.engine.lr_scale_min()
@@ -898,7 +1093,63 @@ class Controller:
             row[f"w{w}_churn"] = churn
             row[f"w{w}_grads"] = float(
                 hm._w[w].grads if hm is not None else 0.0)
+        if self.knobs.get("topo_actions"):
+            row.update(self._topo_inputs(an))
         return row
+
+    def _topo_inputs(self, an) -> Dict[str, float]:
+        """Structural-rule inputs, flattened into the persisted row —
+        the topo rule replays from THESE numbers, never from live state.
+        ``topo_state`` is the run_tree supervisor's shape bulletin
+        (groups in force, leader respawn churn); the advisor supplies
+        the ranked leader_fold saving; the fleet poller supplies shard
+        skew and the worst replica's lag."""
+        server = self.server
+        ts = getattr(server, "topo_state", None) or {}
+        out: Dict[str, float] = {
+            "tree_groups": float(ts.get("groups", 0.0)),
+            "leader_respawns": float(ts.get("leader_respawns", 0.0)),
+            "hot_churn_group": float(ts.get("hot_churn_group", -1.0)),
+        }
+        lf_top, lf_saving, hot_group = 0.0, 0.0, -1.0
+        if an is not None:
+            adv = an.advisor()
+            if adv and adv[0].get("stage") == "leader_fold":
+                lf_top = 1.0
+            lf = next((a for a in adv
+                       if a.get("stage") == "leader_fold"), None)
+            if lf is not None:
+                lf_saving = float((lf.get("debottleneck") or {}).get(
+                    "saving_frac", 0.0))
+            hot = an.hot_hop()
+            if hot is not None:
+                hot_group = float(hot)
+        out["lf_top"] = lf_top
+        out["lf_saving_frac"] = lf_saving
+        out["hot_group"] = hot_group
+        out["replicas_live"] = float(self.replicas_live)
+        lag = skew = skew_hot = shards = 0.0
+        fm = getattr(server, "fleet_monitor", None)
+        if fm is not None:
+            try:
+                snap = fm.poll()
+            except Exception:
+                snap = None
+            if snap and snap.get("armed"):
+                lag = float((snap.get("fleet") or {}).get(
+                    "replica_lag_versions_max", 0.0))
+                shards = float(sum(
+                    1 for m in (snap.get("members") or {}).values()
+                    if m.get("ok") and m.get("role") == "shard"))
+                for v in (snap.get("skew") or {}).values():
+                    skew = max(skew, float(v.get("spread_frac", 0.0)))
+                    if v.get("flagged"):
+                        skew_hot = 1.0
+        out["replica_lag_max"] = lag
+        out["shard_skew"] = skew
+        out["shard_skew_hot"] = skew_hot
+        out["shards_n"] = shards
+        return out
 
     def _epoch_pending(self) -> int:
         """Live workers still pushing an older epoch (0 outside a
@@ -979,6 +1230,47 @@ class Controller:
                 self.core.set_admission_depth(int(action["new"]))
             elif act == "ring":
                 self.core.set_ring(int(action["new"]))
+        elif rule == "topo":
+            if act in ("group_replan", "group_merge"):
+                # the run_tree supervisor installed the actuator: it
+                # owns the leader processes and the pinned ports
+                ta = getattr(self.server, "topo_actuator", None)
+                if ta is not None:
+                    if act == "group_replan":
+                        ta.request_replan(action["verdict"])
+                    else:
+                        ta.request_merge(action["verdict"])
+            elif act == "replica":
+                sc = self._replica_scaler()
+                if sc is not None:
+                    sc.scale_to(int(action["new"]), action["verdict"])
+            elif act in ("shard_split", "shard_merge"):
+                if self.dir:
+                    from pytorch_ps_mpi_tpu.control.topo import (
+                        write_shard_plan,
+                    )
+
+                    write_shard_plan(self.dir, int(action["new"]),
+                                     action["verdict"])
+
+    def _replica_scaler(self):
+        """Build the replica scaler on first use: the read tier must be
+        live (core with a bound read listener) and a control/telemetry
+        dir armed — else replica actions record but cannot execute
+        (counted in ``exec_errors`` by the caller's raise)."""
+        if self._replicas is not None:
+            return self._replicas
+        rp = getattr(self.core, "read_port", None)
+        if not rp or not self.dir:
+            raise RuntimeError(
+                "replica scale action needs a live read tier "
+                "(cfg['read_port']) and a control/telemetry dir")
+        from pytorch_ps_mpi_tpu.control.topo import ReplicaScaler
+
+        self._replicas = ReplicaScaler(
+            "127.0.0.1", int(rp), dir=self.dir,
+            fleet_dir=self.cfg.get("fleet_dir"))
+        return self._replicas
 
     def _restore_epoch(self) -> None:
         """A restarted server generation must rejoin the fleet's current
@@ -1058,12 +1350,25 @@ class Controller:
                     "smallest per-worker staleness LR weight in force "
                     "(1 = no de-weighting)").set(
                         float(self.lr_scale_min()))
+            r.counter("ps_topo_actions_total",
+                      "structural (topology) actions: group replans, "
+                      "replica scale steps, shard plan changes").set(
+                          float(self.topo_actions_total))
+            r.gauge("ps_replicas_live",
+                    "read-tier replica processes currently live "
+                    "(controller-spawned)").set(float(self.replicas_live))
+            r.counter("ps_group_replans_total",
+                      "tree group splits currently in force (a merge "
+                      "reverts one)").set(float(self.group_replans))
 
         registry.add_collector(collect)
 
     def close(self) -> None:
         if self.history is not None:
             self.history.close()
+        sc, self._replicas = self._replicas, None
+        if sc is not None:
+            sc.close()
         f, self._actions_f = self._actions_f, None
         if f is not None:
             f.close()
@@ -1088,6 +1393,10 @@ class Controller:
         ``ladder_idx``/``epoch`` and ``seed_transition=True``."""
         knobs = dict((cfg or {}).get("control_kw") or {})
         knobs.update(overrides)
+        # same derivation as the live __init__: the top-level cfg switch
+        # arms the topo rule — replay must see the identical knob
+        if (cfg or {}).get("topo_actions"):
+            knobs["topo_actions"] = True
         eng = ControlEngine(
             knobs, num_workers, agg_capable=agg_capable,
             depth=depth, ring=ring, ladder_idx=ladder_idx, epoch=epoch,

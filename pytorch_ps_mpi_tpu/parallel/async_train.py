@@ -282,8 +282,13 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
     # scaling and evict/readmit are applied entirely server-side.
     control_dir = cfg.get("control_dir") or (
         cfg.get("telemetry_dir")
-        if (cfg.get("control") or cfg.get("control_kw")) else None)
+        if (cfg.get("control") or cfg.get("control_kw")
+            or cfg.get("topo_actions")) else None)
     epoch_state: Dict[str, Any] = {"epoch": 0, "mtime": 0}
+    # structural-control half: control-topo.json carries the leader
+    # re-assignment map (group split/merge); a tree leaf repoints its
+    # leader connection when the map names it
+    topo_state: Dict[str, Any] = {"seq": 0, "mtime": 0}
     # monotonic push seq — the third leg of the (worker, step, seq)
     # trace ID stamped into every framed push at THIS encode site;
     # duplicates get their own seq (both frames really travel)
@@ -341,6 +346,19 @@ def worker_main(name: str, worker_id: int, cfg: Dict[str, Any]) -> int:
                         _control.apply_epoch(w, doc)
                     except Exception:
                         pass  # a bad epoch doc must never kill a worker
+                if hasattr(w, "repoint"):
+                    from pytorch_ps_mpi_tpu.control.topo import poll_topo
+
+                    tdoc = poll_topo(control_dir, topo_state)
+                    if tdoc is not None:
+                        addr = (tdoc.get("assign") or {}).get(
+                            str(worker_id))
+                        if addr:
+                            try:
+                                w.repoint(addr)
+                            except Exception:
+                                pass  # failover owns recovery; a bad
+                                # repoint must never kill a worker
             drop = duplicate = poison = False
             if inj is not None:
                 for f in inj.faults_at(step):

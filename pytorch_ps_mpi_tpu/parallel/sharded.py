@@ -59,6 +59,18 @@ def shard_plan(n_total: int, n_shards: int) -> List[Tuple[int, int]]:
     return plan
 
 
+def planned_shards(control_dir: Optional[str], default: int) -> int:
+    """The shard count the NEXT server generation should boot with:
+    the structural controller's shard split/merge verdict is recorded
+    as a PLAN in ``control-topo.json`` (never applied to a live
+    generation — a shard move rehashes the whole key space), and every
+    sharded driver consults this at spawn time.  Falls back to
+    ``default`` (the cfg value) when no plan exists."""
+    from pytorch_ps_mpi_tpu.control.topo import planned_shards as _planned
+
+    return _planned(control_dir, default)
+
+
 def _slice_template(n: int) -> PyTree:
     return {"flat": np.zeros((n,), np.float32)}
 

@@ -274,6 +274,13 @@ def leader_main(upstream: Sequence[str], group_id: int,
 
     log = _HopLog(cfg.get("lineage_dir") or cfg.get("telemetry_dir"),
                   group_id)
+    # seeded fault injection, role-addressed: a "slow_leader" fault for
+    # "leader<g>" arms a per-folded-payload delay from its at_step
+    # round on — the structural controller's injected hot hop
+    from pytorch_ps_mpi_tpu.resilience.faults import FaultInjector
+
+    inj = FaultInjector.from_cfg(cfg, role=f"leader{group_id}")
+    slow_fold_s = 0.0
     hello = {"leader": int(group_id), "addr": addr, "wid": lid}
     if http_port is not None:
         hello["health_port"] = http_port
@@ -285,6 +292,14 @@ def leader_main(upstream: Sequence[str], group_id: int,
     pending: Dict[int, Any] = collections.defaultdict(collections.deque)
     v_map: Dict[int, List[int]] = {}
     dead: set = set()
+    #: members the topology document reassigned AWAY from this leader
+    #: (structural split): they stop gating rounds IMMEDIATELY — no
+    #: degrade_after stall — but anything they already pushed here
+    #: stays queued and folds exactly (acked pushes are never dropped)
+    departed: set = set()
+    topo_state = {"seq": 0, "mtime": 0}
+    topo_dir = (cfg.get("control_dir") or cfg.get("telemetry_dir")) \
+        if cfg.get("topo_actions") else None
     crash_at = kw.get("crash_at_round")
     if isinstance(crash_at, dict):
         crash_at = crash_at.get(str(group_id), crash_at.get(int(group_id)))
@@ -389,7 +404,14 @@ def leader_main(upstream: Sequence[str], group_id: int,
     def _hop_push(active: List[int]) -> None:
         """Fold one queued payload per listed worker, EF re-encode, push
         ONE frame upstream (per shard path), log the hop row."""
-        nonlocal rounds, up_seq, round_t0
+        nonlocal rounds, up_seq, round_t0, slow_fold_s
+        if inj is not None and slow_fold_s == 0.0:
+            # fires once (one deterministic event row); the delay then
+            # persists — a sustained hotspot, not a one-round blip
+            for f in inj.faults_between(-1, rounds):
+                if f["kind"] == "slow_leader":
+                    inj.fire(f)
+                    slow_fold_s = float(f.get("slow_ms", 20.0)) / 1e3
         t_fold0 = time.monotonic()
         agg = gwire.agg_begin()
         entries: List[Dict[str, Any]] = []
@@ -397,6 +419,10 @@ def leader_main(upstream: Sequence[str], group_id: int,
         for w in active:
             payload, meta, vs = pending[w].popleft()
             agg.fold(payload)
+            if slow_fold_s:
+                # inside the fold window by design: the slowdown lands
+                # in fold_s -> the anatomy advisor's leader_fold stage
+                time.sleep(slow_fold_s)
             entries.append({"worker": int(meta.get("worker", w)),
                             "step": int(meta.get("step", 0)),
                             "seq": int(meta.get("seq", 0)),
@@ -480,6 +506,27 @@ def leader_main(upstream: Sequence[str], group_id: int,
                 next_tick = now + float(cfg.get("tick_interval", 0.2))
                 if server.timeseries_db is not None:
                     server.observability_tick()
+                if topo_dir is not None:
+                    # structural control: the SAME document the moved
+                    # leaves repoint from tells this leader they left —
+                    # without it every post-split round would stall a
+                    # full degrade_after window waiting on a member
+                    # that now pushes elsewhere
+                    from pytorch_ps_mpi_tpu.control.topo import poll_topo
+
+                    tdoc = poll_topo(topo_dir, topo_state)
+                    if tdoc is not None:
+                        for w_s, a in (tdoc.get("assign") or {}).items():
+                            try:
+                                wi = int(w_s)
+                            except (TypeError, ValueError):
+                                continue
+                            if wi not in group:
+                                continue
+                            if a == addr:
+                                departed.discard(wi)  # merged back
+                            else:
+                                departed.add(wi)
             if now >= next_read:
                 next_read = now + float(kw["read_poll_s"])
                 _republish()
@@ -512,7 +559,12 @@ def leader_main(upstream: Sequence[str], group_id: int,
                                  "seq": int(meta.get("seq", 0))})
                 log.close()
                 os._exit(77)  # resilience.faults.CRASH_EXIT_CODE
-            active = [w for w in group if w not in dead]
+            # a departed (reassigned-away) member stops gating rounds
+            # the moment the topo document says so, but anything it
+            # already pushed here still folds — one payload per round,
+            # exactly like a live member, until its queue drains
+            active = [w for w in group if w not in dead
+                      and (w not in departed or pending[w])]
             if active and all(pending[w] for w in active):
                 _hop_push(active)
                 continue
@@ -520,7 +572,8 @@ def leader_main(upstream: Sequence[str], group_id: int,
             queued = [w for w in group if pending[w]]
             if queued and waited > float(kw["degrade_after"]):
                 _mark_dead()
-                active = [w for w in group if w not in dead]
+                active = [w for w in group if w not in dead
+                          and (w not in departed or pending[w])]
                 if active and all(pending[w] for w in active):
                     _hop_push(active)
                     continue
@@ -622,6 +675,33 @@ class TreeWorkerConn:
         fold. The leaf keeps its epoch; the root consumes it until the
         old epoch retires (the controller disables the codec rule in
         tree mode for exactly this reason)."""
+        return False
+
+    def repoint(self, addr: str) -> bool:
+        """Structural re-parent (controller group split/merge): switch
+        this leaf's leader to ``addr`` — the control-topo.json poll's
+        actuation.  Idempotent when already attached there.  On connect
+        failure it takes the STANDARD failover path (root fallback /
+        pinned-address retry) instead of returning with a half-open
+        state: ``AttributeError`` on a ``None`` leader is not in
+        ``_TRANSPORT_ERRORS``, so leaving ``_mode == "leader"`` with no
+        connection would crash the next read.  The rejoin probe — now
+        aimed at the NEW pinned address — retries from fallback."""
+        addr = str(addr)
+        if addr == self.leader_addr and self._mode == "leader" \
+                and self._leader is not None:
+            return True
+        self.leader_addr = addr
+        old, self._leader = self._leader, None
+        if old is not None:
+            try:
+                old.close()
+            except Exception:
+                pass
+        if self._connect_leader(timeout=float(self.kw["probe_timeout"])):
+            self.reconnects += 1
+            return True
+        self._failover()
         return False
 
     def _connect_leader(self, timeout: float, initial: bool = False) -> bool:
@@ -866,7 +946,11 @@ def run_tree(cfg: Dict[str, Any], *, total_pushes: Optional[int] = None,
         raise ValueError("run_tree needs cfg['codec'] (the compressed "
                          "DCN hop); use 'identity' to ship raw bytes")
     _, params0, _, _ = make_problem(cfg)
-    root = TcpPSServer(0, num_workers=n_workers + len(groups),
+    # structural control needs spare wid headroom: each group replan
+    # promotes one NEW leader wid, up to replan_max concurrent splits
+    spare_wids = (int((cfg.get("control_kw") or {}).get("replan_max", 1))
+                  if cfg.get("topo_actions") else 0)
+    root = TcpPSServer(0, num_workers=n_workers + len(groups) + spare_wids,
                        template=params0,
                        max_staleness=int(cfg.get("max_staleness", 4)),
                        code=code, bucket_mb=float(cfg.get("bucket_mb", 0.0)),
@@ -895,6 +979,37 @@ def run_tree(cfg: Dict[str, Any], *, total_pushes: Optional[int] = None,
                 workers.append(spawn_worker(root_addr, w, wcfg,
                                             env=worker_env))
 
+        # structural control (cfg["topo_actions"]): the actuator owns
+        # group split/merge through THESE supervision lists, so a
+        # promoted leader is pinned-port respawned like a boot one;
+        # the hop tailer feeds the leaders' lineage rows to the live
+        # anatomy advisor (the engine's hot_group input)
+        actuator = None
+        tailer = None
+        if cfg.get("topo_actions"):
+            from pytorch_ps_mpi_tpu.control.topo import (
+                HopTailer,
+                TreeTopoActuator,
+            )
+
+            actuator = TreeTopoActuator(
+                cfg=cfg, groups=groups, leaders=leaders,
+                leader_ports=leader_ports, leader_addrs=leader_addrs,
+                respawns=respawns, root_addr=root_addr,
+                leader_env=leader_env)
+            root.topo_actuator = actuator
+            hop_dir = cfg.get("lineage_dir") or cfg.get("telemetry_dir")
+            if hop_dir:
+                tailer = HopTailer(
+                    hop_dir,
+                    lambda row: (root.anatomy.observe_hop(row)
+                                 if getattr(root, "anatomy", None)
+                                 is not None else None))
+            root.topo_state = {
+                "groups": len(groups), "leader_respawns": 0,
+                "hot_churn_group": -1,
+            }
+
         def on_tick():
             # leader supervision: a crashed leader is respawned on its
             # PINNED port so fallen-back workers can rejoin it. The
@@ -915,6 +1030,17 @@ def run_tree(cfg: Dict[str, Any], *, total_pushes: Optional[int] = None,
                     leaders[g] = spawn_leader(
                         [root_addr], g, groups[g], rcfg,
                         port=leader_ports[g], env=leader_env)
+            if actuator is not None:
+                actuator.pump()  # non-blocking: reap split-leader hello
+                root.topo_state = {
+                    "groups": actuator.active_groups,
+                    "leader_respawns": max(respawns) if respawns else 0,
+                    "hot_churn_group": (
+                        max(range(len(respawns)), key=respawns.__getitem__)
+                        if respawns and max(respawns) > 0 else -1),
+                }
+            if tailer is not None:
+                tailer.poll()
 
         def stop_when():
             if total_pushes is not None and root.tree_composed >= total_pushes:
@@ -931,12 +1057,15 @@ def run_tree(cfg: Dict[str, Any], *, total_pushes: Optional[int] = None,
         leader_codes = join_workers(leaders, timeout=60.0)
         m["tree"] = {
             "groups": [list(g) for g in groups],
-            "leader_wids": lids,
+            "leader_wids": [leader_wid(n_workers, g)
+                            for g in range(len(groups))],
             "tree_slots": slots,
             "leader_respawns": sum(respawns),
             "leader_codes": leader_codes,
             "worker_codes": worker_codes,
         }
+        if actuator is not None:
+            m["tree"]["topo_events"] = list(actuator.events)
         return params, m
     finally:
         for p in workers + leaders:

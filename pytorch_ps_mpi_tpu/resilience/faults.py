@@ -33,7 +33,29 @@ A **fault plan** is a JSON-able list of entries::
                    closest a test can get to SIGKILL from inside)
   ``crash_server`` raise :class:`InjectedServerCrash` out of the serve
                    loop after the matching applied update
+  ``slow_leader``  per-push fold delay at ONE tree leader (worker
+                   ``"leader<g>"``, matched against the leader's round
+                   counter): every payload folded from ``at_step`` on
+                   costs an extra ``slow_ms`` (default 20) inside the
+                   fold window, so the slowdown lands in the hop row's
+                   ``fold_s`` and the anatomy advisor attributes it to
+                   the ``leader_fold`` stage — the injection vector the
+                   structural controller's group split heals (half the
+                   members → half the per-push fold work)
+  ``reader_storm`` burst open-loop read load at one serving endpoint
+                   (worker ``"reader<j>"``, matched against the storm
+                   driver's burst counter): the driver issues
+                   ``storm_reads`` (default 200) extra reads in a burst
+                   — the shed-pressure vector the elastic read tier
+                   absorbs by scaling replicas out. Client-side by
+                   construction: the injector only *decides*; the
+                   driver (``tools/topo_smoke.py``) issues the reads.
   ===============  ========================================================
+
+  Role-addressed kinds (``slow_leader``/``reader_storm``) target string
+  workers — ``"leader<g>"`` / ``"reader<j>"`` — which
+  :func:`normalize_plan` keeps verbatim (like ``"server"``) instead of
+  coercing to a worker id.
 
 Determinism is the contract: the plan is explicit (no sampled fault
 times), the only randomness — corrupt byte positions — derives from
@@ -65,7 +87,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
 import numpy as np
 
 FAULT_KINDS = ("drop", "delay", "wire_delay", "duplicate", "corrupt",
-               "nan", "crash_worker", "crash_server")
+               "nan", "crash_worker", "crash_server",
+               "slow_leader", "reader_storm")
 
 #: Exit code of an injected worker crash (``os._exit``) — distinguishable
 #: from a clean exit (0) and from real crashes in logs, treated like any
@@ -100,10 +123,23 @@ def normalize_plan(plan: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
             raise ValueError(f"fault {i}: missing worker")
         if kind == "crash_server" and worker != "server":
             raise ValueError(f"fault {i}: crash_server must target 'server'")
+        if kind == "slow_leader" and not (
+                isinstance(worker, str) and worker.startswith("leader")):
+            raise ValueError(f"fault {i}: slow_leader must target a "
+                             f"'leader<g>' role, got {worker!r}")
+        if kind == "reader_storm" and not (
+                isinstance(worker, str) and worker.startswith("reader")):
+            raise ValueError(f"fault {i}: reader_storm must target a "
+                             f"'reader<j>' role, got {worker!r}")
         entry = dict(f)
         entry["id"] = int(f.get("id", i))
         entry["at_step"] = int(f["at_step"])
-        entry["worker"] = worker if worker == "server" else int(worker)
+        # role-addressed workers ("server", "leader<g>", "reader<j>")
+        # stay verbatim strings; everything else is a worker id
+        if isinstance(worker, str) and not worker.lstrip("-").isdigit():
+            entry["worker"] = worker
+        else:
+            entry["worker"] = int(worker)
         entry["kind"] = kind
         out.append(entry)
     if len({f["id"] for f in out}) != len(out):

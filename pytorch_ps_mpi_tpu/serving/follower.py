@@ -109,6 +109,24 @@ class FollowerLoop:
                 pass
             self._reader = None
 
+    def repoint(self, host: str, port: int) -> bool:
+        """Re-parent the subscription (structural control: the replica
+        tree reshapes under scale-out/in): tear down the current reader
+        and aim the next poll at ``host:port``.  Idempotent; safe to
+        call from another thread — the poll loop only ever sees a
+        ``None`` reader and re-dials the (atomically updated) endpoint.
+        The local core keeps serving its last published version across
+        the switch, and version pinning is upstream-global (the root's
+        counter), so a re-parented replica never goes backwards."""
+        host, port = str(host), int(port)
+        if (host, port) == (self.host, self.port) \
+                and self._reader is not None:
+            return False
+        self.host, self.port = host, port
+        self._teardown()
+        self._sleep_s = self.poll_s  # re-dial promptly on the new parent
+        return True
+
     def step(self) -> Dict[str, Any]:
         """One poll against upstream.  Returns a status row
         (``outcome`` is one of ``republished`` / ``not_modified`` /

@@ -208,6 +208,9 @@ class RoundAnatomy:
         #: the composed trace IDs are the one key both sides share)
         self._hop_trace: Dict[Tuple[int, int, int], Tuple[float, float]] = {}
         self._hop_trace_order: deque = deque()
+        #: group id → recent per-hop fold walls (the structural
+        #: controller's hot-hop attribution input — see :meth:`hot_hop`)
+        self._group_fold: Dict[int, deque] = {}
         self.overhead_s = 0.0
         self._f = None
         self._rows_since_flush = 0
@@ -226,6 +229,11 @@ class RoundAnatomy:
         root's composed pushes by the trailer trace IDs."""
         fold = float(row.get("fold_s") or 0.0)
         enc = float(row.get("encode_s") or 0.0)
+        if "leader" in row:
+            g = int(row["leader"])
+            if g not in self._group_fold:
+                self._group_fold[g] = deque(maxlen=8)
+            self._group_fold[g].append(fold)
         cap = 4 * int(self.knobs["stage_window"])
         for e in row.get("composed") or ():
             key = (int(e.get("worker", -1)), int(e.get("step", 0)),
@@ -236,6 +244,18 @@ class RoundAnatomy:
         while len(self._hop_trace_order) > cap:
             old = self._hop_trace_order.popleft()
             self._hop_trace.pop(old, None)
+
+    def hot_hop(self) -> Optional[int]:
+        """The group whose recent hops fold slowest (mean over the last
+        8 observed hop rows per group) — the structural controller's
+        ``hot_group`` input: WHICH leader to split when the advisor
+        names ``leader_fold`` the top stage.  ``None`` until at least
+        two groups have reported (a single group has no 'hotter')."""
+        means = {g: sum(w) / len(w)
+                 for g, w in self._group_fold.items() if w}
+        if len(means) < 2:
+            return None
+        return max(means, key=means.get)
 
     def observe_reader_round(self, row: Dict[str, Any]) -> Dict[str, Any]:
         """One reader/follower poll cycle (the read-plane counterpart of

@@ -62,6 +62,12 @@ _SUM_KEYS: Dict[str, str] = {
     "tree_composed": "ps_tree_composed_total",
     "control_actions": "ps_control_actions_total",
     "anatomy_rounds": "ps_anatomy_rounds_total",
+    # structural control: fleet-wide action volume, live replica count,
+    # and splits currently in force — sums because each member's
+    # controller only counts its OWN actuations
+    "topo_actions": "ps_topo_actions_total",
+    "replicas_live": "ps_replicas_live",
+    "group_replans": "ps_group_replans_total",
 }
 
 #: gauges rolled up as the fleet max (worst member)

@@ -402,6 +402,15 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "control_epoch",
     "control_evicted",
     "control_lr_scale_min",
+    # structural control (the controller's topo rule): all 0.0 when
+    # topo_actions is unarmed. topo_actions counts structural actions
+    # (group replans/merges, replica scale, shard plans); replicas_live
+    # is the read replicas the elastic tier currently runs;
+    # group_replans is the tree splits currently in force (a merge
+    # decrements — 0.0 means the boot topology)
+    "topo_actions",
+    "replicas_live",
+    "group_replans",
 )
 
 #: The canonical-key subset the ``/health`` fleet rollup republishes
@@ -540,6 +549,12 @@ def ps_server_metrics(server) -> Dict[str, float]:
             len(cl.evicted) if cl is not None else 0.0),
         "control_lr_scale_min": float(
             cl.lr_scale_min() if cl is not None else 0.0),
+        "topo_actions": float(
+            cl.topo_actions_total if cl is not None else 0.0),
+        "replicas_live": float(
+            cl.replicas_live if cl is not None else 0.0),
+        "group_replans": float(
+            cl.group_replans if cl is not None else 0.0),
     }
 
 

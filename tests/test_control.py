@@ -1043,3 +1043,308 @@ def test_serve_controller_deweights_stale_worker(tmp_path):
     finally:
         server.close()
         join_workers(procs, timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# structural control: the topo rule (group replan / elastic replicas /
+# shard plans), its actuator plumbing, and replay identity
+# ---------------------------------------------------------------------------
+
+def _topo_knobs(**over):
+    base = _knobs(ladder=None, topo_actions=True,
+                  replan_max=1, replan_cooldown_s=2.0,
+                  leader_fold_hot_frac=0.2, leader_churn_replan=2.0,
+                  replica_min=0, replica_max=2, replica_cooldown_s=1.0,
+                  replica_shed_per_s=2.0, replica_lag_hi=4.0,
+                  shard_cooldown_s=1.0, shard_split_skew=0.5,
+                  shard_merge_skew=0.1)
+    base.update(over)
+    return base
+
+
+def _topo_row(t, **over):
+    row = _row(t, tree_groups=2.0, hot_group=-1.0, hot_churn_group=-1.0,
+               leader_respawns=0.0, lf_top=0.0, lf_saving_frac=0.0,
+               replicas_live=0.0, replica_lag_max=0.0,
+               shards_n=0.0, shard_skew=0.0, shard_skew_hot=0.0)
+    row.update(over)
+    return row
+
+
+def test_engine_topo_disabled_by_default():
+    eng = ControlEngine(_knobs(ladder=None), 2)
+    for i in range(12):
+        eng.step(_topo_row(100.0 + 0.5 * i, lf_top=1.0, hot_group=1.0,
+                           lf_saving_frac=0.6, shards_n=2.0,
+                           shard_skew=0.9, shard_skew_hot=1.0,
+                           reads_shed=float(10 * i)))
+    assert not [a for a in eng.actions if a["rule"] == "topo"]
+    assert eng.topo_actions == 0
+
+
+def test_engine_topo_group_replan_latched_then_merge_reverts():
+    eng = ControlEngine(_topo_knobs(), 4)
+    acts = []
+    # sustained hot leader_fold hop at group 1: exactly ONE replan
+    for i in range(10):
+        acts += eng.step(_topo_row(100.0 + 0.5 * i, lf_top=1.0,
+                                   hot_group=1.0, lf_saving_frac=0.4))
+    replans = [a for a in acts if a["action"] == "group_replan"]
+    assert len(replans) == 1 and eng.replans == 1
+    a = replans[0]
+    assert a["verdict"]["kind"] == "leader_fold_hot"
+    assert a["verdict"]["rule"] == "topo" and a["verdict"]["group"] == 1
+    # hotspot clears: the merge needs a COLD hop for 2x the cooldown
+    acts2 = []
+    for i in range(14):
+        acts2 += eng.step(_topo_row(110.0 + 0.5 * i))
+    merges = [a for a in acts2 if a["action"] == "group_merge"]
+    assert len(merges) == 1 and eng.replans == 0
+    assert merges[0]["verdict"]["kind"] == "hotspot_cleared"
+    assert eng.flaps == 0
+
+
+def test_engine_topo_replan_on_leader_churn():
+    eng = ControlEngine(_topo_knobs(), 4)
+    acts = []
+    for i in range(8):
+        acts += eng.step(_topo_row(100.0 + 0.5 * i, hot_churn_group=0.0,
+                                   leader_respawns=3.0))
+    replans = [a for a in acts if a["action"] == "group_replan"]
+    assert len(replans) == 1
+    assert replans[0]["verdict"]["kind"] == "leader_churn"
+    assert replans[0]["verdict"]["group"] == 0
+
+
+def test_engine_topo_replica_scale_out_in_no_flap():
+    eng = ControlEngine(_topo_knobs(), 2)
+    acts = []
+    # shed burn: reads_shed ramps 5 per 0.5s row -> 10/s >> 2/s
+    for i in range(10):
+        acts += eng.step(_topo_row(100.0 + 0.5 * i,
+                                   reads_shed=float(5 * i)))
+    outs = [a for a in acts if a["action"] == "replica"]
+    assert outs and all(a["verdict"]["kind"] == "shed_pressure"
+                        for a in outs)
+    assert eng.replicas == 2  # clamped at replica_max
+    # burn stops, lag burns instead: scale back in
+    shed_final = 45.0
+    acts2 = []
+    for i in range(16):
+        acts2 += eng.step(_topo_row(110.0 + 0.5 * i,
+                                    reads_shed=shed_final,
+                                    replica_lag_max=6.0))
+    ins = [a for a in acts2 if a["action"] == "replica"
+           and a["new"] < a["old"]]
+    assert ins and all(a["verdict"]["kind"] == "replica_lag_burn"
+                       for a in ins)
+    assert eng.replicas == 0
+    assert eng.flaps == 0
+
+
+def test_engine_topo_replica_floor_and_idle_retire():
+    eng = ControlEngine(_topo_knobs(replica_min=1), 2)
+    acts = []
+    for i in range(6):
+        acts += eng.step(_topo_row(100.0 + 0.5 * i))
+    floors = [a for a in acts if a["action"] == "replica"]
+    assert floors and floors[0]["verdict"]["kind"] == "tier_floor"
+    assert eng.replicas == 1
+
+
+def test_engine_topo_shard_split_then_merge():
+    eng = ControlEngine(_topo_knobs(), 2)
+    acts = []
+    for i in range(8):
+        acts += eng.step(_topo_row(100.0 + 0.5 * i, shards_n=2.0,
+                                   shard_skew=0.7, shard_skew_hot=1.0))
+    splits = [a for a in acts if a["action"] == "shard_split"]
+    assert len(splits) == 1 and eng.shard_extra == 1
+    assert splits[0]["old"] == 2 and splits[0]["new"] == 3
+    assert splits[0]["verdict"]["kind"] == "shard_skew"
+    acts2 = []
+    for i in range(10):
+        acts2 += eng.step(_topo_row(108.0 + 0.5 * i, shards_n=2.0,
+                                    shard_skew=0.05))
+    merges = [a for a in acts2 if a["action"] == "shard_merge"]
+    assert len(merges) == 1 and eng.shard_extra == 0
+    assert merges[0]["verdict"]["kind"] == "skew_cleared"
+    assert eng.flaps == 0
+
+
+def test_engine_every_action_carries_verdict_id_and_rule():
+    eng = ControlEngine(_topo_knobs(), 3)
+    for i in range(20):
+        eng.step(_topo_row(100.0 + 0.5 * i, lf_top=1.0, hot_group=0.0,
+                           lf_saving_frac=0.5, w1_stale=6.0,
+                           reads_shed=float(5 * i), shards_n=2.0,
+                           shard_skew=0.7, shard_skew_hot=1.0))
+    assert eng.actions  # mixed rules actually fired
+    assert len({a["rule"] for a in eng.actions}) >= 2
+    for i, a in enumerate(eng.actions):
+        assert a["verdict"]["id"] == i
+        assert a["verdict"]["rule"] == a["rule"]
+
+
+def test_topo_replay_byte_identical():
+    rows = []
+    for i in range(24):
+        m = _topo_row(100.0 + 0.5 * i, lf_top=1.0, hot_group=1.0,
+                      lf_saving_frac=0.4, reads_shed=float(5 * i),
+                      shards_n=2.0, shard_skew=0.7, shard_skew_hot=1.0,
+                      w1_stale=6.0)
+        rows.append({"t": m["ts"], "m": m})
+    knobs = _topo_knobs()
+    live = ControlEngine(knobs, 3)
+    live_actions = []
+    for r in rows:
+        live_actions += live.step(r["m"])
+    assert [a for a in live_actions if a["rule"] == "topo"]
+    # knob-armed replay
+    replayed = Controller.replay(rows, num_workers=3,
+                                 cfg={"control_kw": knobs})
+    assert json.dumps(replayed) == json.dumps(live_actions)
+    # TOP-LEVEL cfg["topo_actions"] arming must replay identically too
+    # (construction and replay derive the switch the same way)
+    k2 = dict(knobs)
+    k2.pop("topo_actions")
+    replayed2 = Controller.replay(rows, num_workers=3,
+                                  cfg={"topo_actions": True,
+                                       "control_kw": k2})
+    assert json.dumps(replayed2) == json.dumps(live_actions)
+
+
+def test_topo_doc_poll_gated_and_assign_merges(tmp_path):
+    from pytorch_ps_mpi_tpu.control.topo import (
+        poll_topo,
+        update_topo,
+        write_shard_plan,
+    )
+
+    d = str(tmp_path)
+    state = {"seq": 0, "mtime": 0}
+    assert poll_topo(d, state) is None  # no doc yet
+    update_topo(d, assign={"2": "127.0.0.1:7001"})
+    doc = poll_topo(d, state)
+    assert doc["seq"] == 1 and doc["assign"]["2"] == "127.0.0.1:7001"
+    assert poll_topo(d, state) is None  # mtime+seq gated
+    # a shard plan MERGES with (never clobbers) the standing assign map
+    write_shard_plan(d, 3, {"kind": "shard_skew", "id": 7})
+    doc = poll_topo(d, state)
+    assert doc["shards"] == 3 and doc["assign"]["2"] == "127.0.0.1:7001"
+    assert doc["seq"] == 2
+    from pytorch_ps_mpi_tpu.parallel.sharded import planned_shards
+
+    assert planned_shards(d, 2) == 3
+    assert planned_shards(None, 2) == 2
+
+
+def test_replica_scaler_cards_and_lifo_retire(tmp_path):
+    from pytorch_ps_mpi_tpu.control.topo import ReplicaScaler
+    from pytorch_ps_mpi_tpu.telemetry.fleet import (
+        list_endpoints,
+        register_endpoint,
+    )
+
+    fleet = str(tmp_path / "fleet")
+
+    class FakeProc:
+        _next = [1000]
+
+        def __init__(self):
+            FakeProc._next[0] += 1
+            self.pid = FakeProc._next[0]
+            self.terminated = False
+            self.stdout = None
+            # the real replica registers its own card at boot
+            register_endpoint(fleet, f"replica-{self.pid}", 9000,
+                              role="replica")
+
+        def poll(self):
+            return 1 if self.terminated else None
+
+        def terminate(self):
+            self.terminated = True
+
+    sc = ReplicaScaler("127.0.0.1", 7000, dir=str(tmp_path),
+                       fleet_dir=fleet)
+    sc._spawn_replica = FakeProc
+    assert sc.scale_to(2, {"kind": "shed_pressure", "id": 0}) == 2
+    assert sc.live == 2
+    cards = {e["name"] for e in list_endpoints(fleet)}
+    assert len(cards) == 2 and all(c.startswith("replica-")
+                                   for c in cards)
+    # scale in deregisters the NEWEST replica's card, then terminates
+    assert sc.scale_to(1, {"kind": "replica_lag_burn", "id": 1}) == 1
+    assert sc.live == 1
+    assert {e["name"] for e in list_endpoints(fleet)} < cards
+    assert [e["act"] for e in sc.events] == ["spawn", "spawn", "retire"]
+    assert all(e["verdict"]["kind"] for e in sc.events)
+    sc.close()
+    assert sc.live == 0
+    assert list_endpoints(fleet) == []
+
+
+def test_follower_repoint_reparents_subscription():
+    from pytorch_ps_mpi_tpu.serving.follower import FollowerLoop
+
+    class CoreStub:
+        template = {"a": np.zeros((4,), np.float32)}
+
+    fl = FollowerLoop(CoreStub(), "127.0.0.1", 7001,
+                      template=CoreStub.template)
+    assert fl.repoint("127.0.0.1", 7002) is True
+    assert (fl.host, fl.port) == ("127.0.0.1", 7002)
+    assert fl._reader is None
+    # idempotent once attached nowhere: same endpoint with no live
+    # reader still re-arms the prompt re-dial (returns True)
+    assert fl.repoint("127.0.0.1", 7002) is True
+    fl.close()
+
+
+def test_anatomy_hot_hop_names_the_slow_group():
+    from pytorch_ps_mpi_tpu.telemetry.anatomy import RoundAnatomy
+
+    an = RoundAnatomy(None, {}, num_workers=4)
+    assert an.hot_hop() is None  # one group has no "hotter"
+    for r in range(4):
+        an.observe_hop({"kind": "hop", "leader": 0, "fold_s": 0.002,
+                        "encode_s": 0.001, "composed": []})
+        an.observe_hop({"kind": "hop", "leader": 1, "fold_s": 0.150,
+                        "encode_s": 0.001, "composed": []})
+    assert an.hot_hop() == 1
+
+
+def test_report_joins_actions_to_verdicts(tmp_path):
+    from tools.telemetry_report import _summarize_actions
+
+    rows = [
+        {"t": 1.0, "rule": "topo", "action": "group_replan", "old": 0,
+         "new": 1, "verdict": {"id": 0, "rule": "topo",
+                               "kind": "leader_fold_hot", "group": 1}},
+        {"t": 2.0, "rule": "read_tier", "action": "depth", "old": 64,
+         "new": 128, "verdict": {"id": 1, "rule": "read_tier",
+                                 "kind": "shed"}},
+        {"t": 3.0, "rule": "topo", "action": "replica", "old": 0,
+         "new": 1, "verdict": {"id": 2, "rule": "topo",
+                               "kind": "shed_pressure"}},
+    ]
+    s = _summarize_actions(rows)
+    assert s["actions"] == 3 and not s["flap_suspects"]
+    join = {(j["rule"], j["action"], j["verdict"]): j["actions"]
+            for j in s["verdict_join"]}
+    assert join[("topo", "group_replan", "leader_fold_hot")] == 1
+    assert join[("topo", "replica", "shed_pressure")] == 1
+    assert join[("read_tier", "depth", "shed")] == 1
+
+
+def test_ps_top_renders_topo_line():
+    from tools.ps_top import render_control
+
+    lines = render_control({
+        "actions_total": 3, "flaps": 0, "epoch": 0, "ladder": [],
+        "ladder_idx": 0, "topo_armed": True, "topo_actions": 2,
+        "group_replans": 1, "replicas": 2, "shard_extra": 0,
+    })
+    topo = [ln for ln in lines if "topo" in ln]
+    assert topo and "replans=1" in topo[0] and "replicas=2" in topo[0]

@@ -477,6 +477,186 @@ def test_tree_composes_with_key_sharding(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# structural control: group split / merge through the supervisor lists
+# ---------------------------------------------------------------------------
+
+class _FakeLeader:
+    """Stands in for a spawn_leader Popen: stdout wraps the read end of
+    a REAL pipe so the actuator's select()-based pump sees the hello
+    exactly the way it would from a subprocess."""
+
+    def __init__(self):
+        r, w = os.pipe()
+        self.stdout = os.fdopen(r, "r")
+        self._w = w
+        self.pid = 4242
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def hello(self, gid, addr, wid):
+        os.write(self._w, (json.dumps(
+            {"leader": gid, "addr": addr, "wid": wid}) + "\n").encode())
+
+    def terminate(self):
+        self.returncode = -15
+
+    def close(self):
+        self.stdout.close()
+        try:
+            os.close(self._w)
+        except OSError:
+            pass
+
+
+def test_topo_actuator_split_commit_merge_recycles_slot(tmp_path):
+    """The tentpole actuator protocol, process-free: request_replan
+    parks a pending spawn; pump() commits ONLY after the hello (lists
+    mutated, re-assignment published); merge reassigns back and frees
+    the slot; the next split recycles the freed gid so the root's
+    spare-wid headroom never grows past replan_max."""
+    from pytorch_ps_mpi_tpu.control import topo as topo_mod
+
+    spawned = []
+
+    def fake_spawn(upstreams, gid, members, cfg, port=0, env=None):
+        p = _FakeLeader()
+        spawned.append((gid, list(members), p))
+        return p
+
+    groups = [[0, 1, 2, 3], [4, 5]]
+    leaders = [object(), object()]
+    ports = [7001, 7002]
+    addrs = ["127.0.0.1:7001", "127.0.0.1:7002"]
+    respawns = [0, 0]
+    act = topo_mod.TreeTopoActuator(
+        cfg={}, groups=groups, leaders=leaders, leader_ports=ports,
+        leader_addrs=addrs, respawns=respawns,
+        root_addr="127.0.0.1:7000", control_dir=str(tmp_path),
+        spawn_fn=fake_spawn)
+    try:
+        assert act.request_replan({"kind": "leader_fold_hot", "group": 0})
+        assert act.split_active
+        act.pump()  # no hello yet: nothing committed
+        assert groups == [[0, 1, 2, 3], [4, 5]]
+        # a concurrent replan is refused (recorded), never queued
+        assert not act.request_replan({"kind": "leader_fold_hot",
+                                       "group": 1})
+        assert act.events[-1]["reason"] == "split_active"
+
+        gid, moved, proc = spawned[0]
+        assert (gid, moved) == (2, [2, 3])
+        proc.hello(2, "127.0.0.1:7171", 6)
+        act.pump()  # hello arrived: commit
+        assert groups == [[0, 1], [4, 5], [2, 3]]
+        assert leaders[2] is proc and ports[2] == 7171
+        assert respawns == [0, 0, 0]  # supervised like a boot leader
+        doc = topo_mod.read_topo(str(tmp_path))
+        assert doc["assign"] == {"2": "127.0.0.1:7171",
+                                 "3": "127.0.0.1:7171"}
+        assert act.events[-1]["act"] == "replanned"
+        assert act.events[-1]["verdict"]["kind"] == "leader_fold_hot"
+        assert act.active_groups == 3
+
+        # merge: members repoint back, the split slot empties + frees
+        assert act.request_merge({"kind": "hotspot_cleared"})
+        assert groups == [[0, 1, 2, 3], [4, 5], []]
+        assert act.active_groups == 2 and not act.split_active
+        doc = topo_mod.read_topo(str(tmp_path))
+        assert doc["assign"]["2"] == addrs[0] == doc["assign"]["3"]
+        assert doc["seq"] == 2  # every publish bumped the poll gate
+
+        # the next split RECYCLES gid 2 — replaced in place, not grown
+        assert act.request_replan({"kind": "leader_churn", "group": 0})
+        gid2, moved2, proc2 = spawned[1]
+        assert gid2 == 2 and moved2 == [2, 3]
+        proc2.hello(2, "127.0.0.1:7272", 6)
+        act.pump()
+        assert groups == [[0, 1], [4, 5], [2, 3]]
+        assert leaders[2] is proc2 and ports[2] == 7272
+        assert len(leaders) == 3 and len(groups) == 3
+    finally:
+        for _, _, p in spawned:
+            p.close()
+
+
+@pytest.mark.slow
+def test_tree_e2e_slow_leader_heals_by_group_replan(tmp_path):
+    """The live tentpole loop: an injected slow_leader hotspot (every
+    fold on leader0 sleeps) is attributed by the anatomy advisor
+    (leader_fold top stage + hot_hop naming group 0), the engine's topo
+    rule emits a latched group_replan carrying that verdict, the
+    actuator promotes a new leader through the supervisor lists, and
+    the moved leaf repoints via control-topo.json — all mid-run, no
+    restart, exact composed accounting, zero flaps."""
+    steps, n_workers = 16, 4
+    cfg = dict(TREE_CFG)
+    cfg.update(
+        steps=steps, n_workers=n_workers, group_size=2,
+        lineage=True, lineage_dir=str(tmp_path),
+        control_dir=str(tmp_path),
+        topo_actions=True,
+        control_kw={
+            # isolate the topo rule: everything else pinned, engine
+            # cadence tightened so the split lands within the run
+            "pin": ("codec", "lr_scale", "evict", "read_tier"),
+            "eval_every_s": 0.2, "warmup_s": 0.5,
+            "replan_cooldown_s": 0.5,
+            "leader_fold_hot_frac": 0.05,
+            "leader_churn_replan": 10 ** 9,  # fold-heat path only
+            "replica_max": 0,
+        },
+        # paced leaves: keep pushes FLOWING past the split commit so
+        # the promoted leader has traffic to carry (free-running
+        # leaves would queue all 16 steps at the slow leader in the
+        # first second)
+        slow_ms={str(w): 450.0 for w in range(4)},
+        fault_plan=[{"at_step": 0, "worker": "leader0",
+                     "kind": "slow_leader", "slow_ms": 400}],
+    )
+    params, m = tree.run_tree(cfg, timeout=280.0)
+    assert m["tree"]["worker_codes"] == [0] * n_workers
+    # the split fired, carrying the hot-fold verdict for group 0
+    events = m["tree"]["topo_events"]
+    replans = [e for e in events if e["act"] == "replanned"]
+    assert replans, f"no replan committed: {events}"
+    assert replans[0]["group"] == 0
+    assert replans[0]["verdict"]["kind"] == "leader_fold_hot"
+    assert m["control"]["group_replans"] >= 1
+    assert m["control"]["topo_actions"] >= 1
+    assert m["control"]["flaps"] == 0
+    # membership actually changed: three live groups, leaf 1 moved
+    groups = m["tree"]["groups"]
+    assert len(groups) == 3 and groups[2] == [1] and groups[0] == [0]
+    # exact composed accounting across the transition: every worker
+    # push is composed at the root or positively logged lost — never
+    # silently dropped, never double-counted
+    lost = set()
+    for g in range(3):
+        p = tmp_path / f"lineage-leader{g}.jsonl"
+        if not p.exists():
+            continue
+        for line in open(p):
+            r = json.loads(line)
+            if r.get("kind") == "leader_consume" and r.get("lost"):
+                lost.add((r["worker"], r["step"], r["seq"]))
+    ids = _root_composed_ids(str(tmp_path))
+    expect = {(w, s, s) for w in range(n_workers) for s in range(steps)}
+    assert ids | lost == expect
+    assert not (ids & lost)
+    # the promoted leader carried traffic: the moved leaf's later
+    # pushes composed through lineage-leader2, not vacuously via the
+    # old leader's backlog
+    p2 = tmp_path / "lineage-leader2.jsonl"
+    assert p2.exists()
+    hops2 = [json.loads(line) for line in open(p2)]
+    assert any(r.get("kind") == "hop"
+               and any(e["worker"] == 1 for e in r["composed"])
+               for r in hops2)
+
+
+# ---------------------------------------------------------------------------
 # observability surfaces
 # ---------------------------------------------------------------------------
 

@@ -296,6 +296,12 @@ def render_control(control: Dict[str, Any]) -> List[str]:
             f"w{w}" for w in control["probation"]))
     if bits:
         lines.append("  " + "  ".join(bits))
+    if control.get("topo_armed"):
+        lines.append(
+            f"  topo  actions={control.get('topo_actions', 0)}  "
+            f"replans={control.get('group_replans', 0)}  "
+            f"replicas={control.get('replicas', 0)}  "
+            f"shard_extra={control.get('shard_extra', 0)}")
     for a in (control.get("recent_actions") or [])[-3:]:
         who = "" if a.get("worker") is None else f" w{a['worker']}"
         lines.append(

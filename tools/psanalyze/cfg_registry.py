@@ -229,6 +229,15 @@ CFG_KEYS = {
                           "(timeseries-control-<name>.jsonl) and the "
                           "worker-polled control-epoch.json (falls "
                           "back to telemetry_dir)"),
+    "topo_actions": CfgKey("bool", "caller",
+                           "arm STRUCTURAL control actions (the "
+                           "controller's topo rule): tree group "
+                           "split/merge, elastic read-replica "
+                           "scale-out/in, shard split/merge plans — "
+                           "knobs (replan_max, replica_min/max, "
+                           "shard_split_skew, cooldowns) ride "
+                           "control_kw; actions publish through the "
+                           "worker-polled control-topo.json"),
     # -- parameter-serving read tier --------------------------------------
     "serving": CfgKey("bool", "caller",
                       "arm the snapshot ring/read tier without binding "

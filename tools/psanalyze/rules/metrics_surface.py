@@ -84,6 +84,9 @@ INSTRUMENT_MAP: Dict[str, Optional[str]] = {
     "control_epoch": "ps_control_epoch",
     "control_evicted": "ps_control_evicted",
     "control_lr_scale_min": "ps_control_lr_scale_min",
+    "topo_actions": "ps_topo_actions_total",
+    "replicas_live": "ps_replicas_live",
+    "group_replans": "ps_group_replans_total",
 }
 
 

@@ -379,10 +379,18 @@ def _summarize_actions(rows: List[Dict[str, Any]],
     # control-*.jsonl per shard and the tail must show the NEWEST
     # actions across all of them
     rows = sorted(rows, key=lambda x: float(x.get("t", 0.0)))
+    vjoin: Dict[Tuple[str, str, str], int] = {}
     for r in rows:
         rule = str(r.get("rule"))
         d = per_rule.setdefault(rule, {})
         d[str(r.get("action"))] = d.get(str(r.get("action")), 0) + 1
+        # action↔verdict join: every action row carries its triggering
+        # verdict (id + kind) — the audit question is "which verdict
+        # fired this", answered per (rule, action, verdict kind)
+        vk = str((r.get("verdict") or {}).get("kind") or "")
+        if vk:
+            jk = (rule, str(r.get("action")), vk)
+            vjoin[jk] = vjoin.get(jk, 0) + 1
         key = (rule, r.get("worker"))
         h = hist.setdefault(key, [])
         if (len(h) >= 2
@@ -398,6 +406,9 @@ def _summarize_actions(rows: List[Dict[str, Any]],
     return {
         "actions": len(rows),
         "rules": [{"rule": k, **v} for k, v in sorted(per_rule.items())],
+        "verdict_join": [
+            {"rule": r, "action": a, "verdict": vk, "actions": n}
+            for (r, a, vk), n in sorted(vjoin.items())],
         "flap_suspects": flaps,
         "tail": rows[-16:],
     }
@@ -787,6 +798,9 @@ def format_table(summary: Dict[str, Any]) -> str:
             counts = "  ".join(f"{k}={v}" for k, v in sorted(r.items())
                                if k != "rule")
             lines.append(f"  {r['rule']}: {counts}")
+        for j in act.get("verdict_join") or ():
+            lines.append(f"  {j['rule']}.{j['action']} <- "
+                         f"{j['verdict']} x{j['actions']}")
         for a in act["tail"][-8:]:
             who = ("" if a.get("worker") is None
                    else f" w{a['worker']}")
