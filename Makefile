@@ -20,6 +20,7 @@ test:
 	$(MAKE) control-smoke
 	$(MAKE) topo-smoke
 	$(MAKE) whatif-smoke
+	$(MAKE) fresh-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -231,6 +232,21 @@ topo-smoke:
 		--metric 'topo_smoke.wall_total_s:lower:1.5' \
 		--metric 'topo_smoke.span_ratio:lower:0.5'
 
+# Read-path freshness gate (in the default `make test` path): a star
+# run with a live two-hop replica chain beside it. Healthy-phase edge
+# delivery ages must stay under the gate; the seeded slow-follower
+# fault must ramp the edge's age-of-information until the controller
+# trips exactly ONE latched edge_age_burn scale-out (freshness evidence
+# on the action row, byte-identical replay from TSDB rows), and a
+# worker push trace ID must resolve through the freshness flow events
+# to the wall age at which the edge served the containing version.
+fresh-smoke:
+	JAX_PLATFORMS=cpu python tools/fresh_smoke.py
+	python tools/bench_gate.py \
+		--trajectory benchmarks/results/fresh_smoke.jsonl \
+		--metric 'fresh_smoke.wall_total_s:lower:1.5' \
+		--metric 'fresh_smoke.healthy_age_p95_ms:lower:2.0'
+
 # Round-anatomy what-if gate (in the default `make test` path): a
 # 3-worker sync run with 200 ms injected into worker 1's WIRE stage
 # (fault kind wire_delay — the sleep sits between the frame's
@@ -322,4 +338,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-native-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke topo-smoke whatif-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-native-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke topo-smoke whatif-smoke fresh-smoke

@@ -30,6 +30,11 @@ Four stages:
    serving 3x the reader population of a single endpoint while the
    publisher advances; served p99 per endpoint is reported and the
    replica lag once the publisher stops must settle <= 2 versions.
+5. **freshness propagation** (``--freshness``) — a root -> replica ->
+   replica chain with FRS1 trailers armed: per-depth (1-hop and 2-hop)
+   publish->visible latency and reader delivery age distributions, plus
+   the per-hop relay latency quantiles the trailer's hop records carry.
+   The table RESULTS.md cites comes from this stage.
 (implicit) **coalescing** — identical-version delta asks within one
 version window ride one encode; the hit count is reported.
 
@@ -334,6 +339,105 @@ def run_replica_tree(template, serving_kw, *, readers_per: int,
     }
 
 
+def run_freshness_stage(template, serving_kw, *, duration_s: float,
+                        publish_interval: float, change_frac: float
+                        ) -> Dict[str, float]:
+    """Root -> replica -> replica chain under a live publisher: the
+    freshness plane measured at both depths. Edge readers at hop 1 and
+    hop 2 request FRS1 trailers with every read; a per-core
+    ``FreshnessTracker`` folds the relayed birth records into per-hop
+    relay latency windows. All clocks are one host here, so ages are
+    real wall deltas (accurate to the followers' poll interval — the
+    lower-envelope skew fit absorbs the minimum poll delay)."""
+    from pytorch_ps_mpi_tpu.serving import (
+        FollowerLoop,
+        ServingCore,
+        ServingReader,
+    )
+    from pytorch_ps_mpi_tpu.telemetry.freshness import FreshnessTracker
+
+    root = ServingCore(None, {"read_port": 0, "serving_kw": serving_kw},
+                       template=template)
+    pub = Publisher(root, template, change_frac, publish_interval)
+    pub.publish_once()
+    core_a = ServingCore(None, {"read_port": 0, "serving_kw": serving_kw},
+                         template=template)
+    core_b = ServingCore(None, {"read_port": 0, "serving_kw": serving_kw},
+                         template=template)
+    tr_b = FreshnessTracker(core=core_b, name="bench-hop2")
+    loops = [
+        FollowerLoop(core_a, "127.0.0.1", root.read_port,
+                     template=template, poll_s=publish_interval / 4,
+                     serving_kw=serving_kw).start(),
+        FollowerLoop(core_b, "127.0.0.1", core_a.read_port,
+                     template=template, poll_s=publish_interval / 4,
+                     serving_kw=serving_kw).start(),
+    ]
+    deadline = time.time() + 30
+    while (any(c.latest_version(None) == 0 for c in (core_a, core_b))
+           and time.time() < deadline):
+        time.sleep(0.01)
+    if any(c.latest_version(None) == 0 for c in (core_a, core_b)):
+        raise RuntimeError("freshness chain never caught the snapshot")
+    pub.start()
+
+    ages: Dict[int, List[float]] = {1: [], 2: []}
+    visible: Dict[int, List[float]] = {1: [], 2: []}
+    rejects = [0]
+
+    def drive(depth: int, core) -> None:
+        from pytorch_ps_mpi_tpu.telemetry.freshness import (
+            visible_latency_ms,
+        )
+
+        r = ServingReader("127.0.0.1", core.read_port, template,
+                          serving_kw=serving_kw, timeout=30.0)
+        t_end = time.perf_counter() + duration_s
+        try:
+            while time.perf_counter() < t_end:
+                _, ver = r.read_params()
+                doc = r.fresh
+                if doc is not None and doc["version"] == ver \
+                        and doc["hop_count"] == depth:
+                    ages[depth].append(r.fresh_age_ms())
+                    vis = visible_latency_ms(doc)
+                    if vis is not None:
+                        visible[depth].append(vis)
+                time.sleep(publish_interval * 0.5)
+        finally:
+            rejects[0] += r.fresh_rejects
+            r.close()
+
+    drivers = [threading.Thread(target=drive, args=(d, c))
+               for d, c in ((1, core_a), (2, core_b))]
+    for t in drivers:
+        t.start()
+    for t in drivers:
+        t.join(timeout=duration_s + 60)
+    pub.stop()
+    hopq = tr_b.hop_quantiles_ms()
+    for fl in loops:
+        fl.close()
+    for c in (core_b, core_a, root):
+        c.close()
+    out: Dict[str, float] = {
+        "versions_published": float(pub.published),
+        "fresh_rejects": float(rejects[0]),
+    }
+    for d in (1, 2):
+        a = np.array(ages[d]) if ages[d] else np.array([0.0])
+        v = np.array(visible[d]) if visible[d] else np.array([0.0])
+        out[f"hop{d}_deliveries"] = float(len(ages[d]))
+        out[f"hop{d}_age_p50_ms"] = float(np.percentile(a, 50))
+        out[f"hop{d}_age_p95_ms"] = float(np.percentile(a, 95))
+        out[f"hop{d}_visible_p50_ms"] = float(np.percentile(v, 50))
+        out[f"hop{d}_visible_p95_ms"] = float(np.percentile(v, 95))
+        q = hopq.get(d) or {}
+        out[f"hop{d}_relay_p50_ms"] = float(q.get("p50", 0.0))
+        out[f"hop{d}_relay_p95_ms"] = float(q.get("p95", 0.0))
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--quick", action="store_true",
@@ -343,6 +447,8 @@ def main(argv=None) -> int:
     ap.add_argument("--change-frac", type=float, default=0.005,
                     help="fraction of params changed per version (the "
                          "small-delta regime)")
+    ap.add_argument("--freshness", action="store_true",
+                    help="run the 1/2-hop freshness propagation stage")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
@@ -438,6 +544,19 @@ def main(argv=None) -> int:
                "ms" if k.endswith("_ms") else
                ("bytes" if k.endswith("bytes") else ""))
 
+    # -- stage 5: freshness propagation (1/2-hop) ------------------------
+    fresh: Optional[Dict[str, float]] = None
+    if args.freshness:
+        fresh = run_freshness_stage(
+            template, serving_kw, duration_s=dur,
+            change_frac=args.change_frac, publish_interval=0.1)
+        print("stage 5 — freshness propagation (root -> replica -> "
+              "replica):")
+        for k, v in fresh.items():
+            metric(f"fresh_{k}", v, "ms" if k.endswith("_ms") else "")
+    else:
+        print("stage 5 — SKIPPED (pass --freshness)")
+
     # bounded-past-the-limit check: compare the SERVED p99 at the highest
     # offered load (where shedding is active) against the lowest load's
     p99_lo = curve[0]["p99_ms"]
@@ -497,6 +616,16 @@ def main(argv=None) -> int:
               "versions (> 2) after the publisher stopped",
               file=sys.stderr)
         ok = False
+    if fresh is not None:
+        # sanity, not a latency SLO: both depths must actually deliver
+        # trailers, none may be rejected, and the 2-hop birth records
+        # must carry both relay hops' latencies
+        if (fresh["hop1_deliveries"] < 1 or fresh["hop2_deliveries"] < 1
+                or fresh["fresh_rejects"] > 0
+                or fresh["hop2_relay_p50_ms"] <= 0.0):
+            print("FAIL: freshness stage delivered no usable trailers "
+                  f"({json.dumps(fresh)})", file=sys.stderr)
+            ok = False
 
     os.makedirs(RESULTS_DIR, exist_ok=True)
     day = time.strftime("%Y-%m-%d")
@@ -520,6 +649,10 @@ def main(argv=None) -> int:
                                         else None),
             "tree_p99_ms": round(tree["p99_ms"], 3),
             "tree_lag_final": tree["lag_final"],
+            "fresh_hop1_age_p95_ms": (round(fresh["hop1_age_p95_ms"], 3)
+                                      if fresh is not None else None),
+            "fresh_hop2_age_p95_ms": (round(fresh["hop2_age_p95_ms"], 3)
+                                      if fresh is not None else None),
             "readers": readers, "quick": int(quick),
         }) + "\n")
     print(f"wrote {out}")

@@ -161,6 +161,13 @@ def main(argv=None):
     ap.add_argument("--slo-target", action="append", default=[],
                     help="override one SLO target, KEY=VALUE "
                          "(repeatable; e.g. push_e2e_p95_ms=250)")
+    ap.add_argument("--freshness", action="store_true",
+                    help="arm the read-path freshness tracker: every "
+                         "published version's FRS1 birth record becomes "
+                         "publish->visible latency distributions, the "
+                         "serving_age_ms age-of-information gauge, and "
+                         "freshness-server.jsonl propagation rows in "
+                         "--telemetry-dir")
     ap.add_argument("--control", action="store_true",
                     help="arm the self-driving controller (requires "
                          "--telemetry-dir for its action/replay rows): "
@@ -307,6 +314,8 @@ def main(argv=None):
             cfg["slo_kw"] = {"targets": targets}
     if args.profile:
         cfg["profile"] = True
+    if args.freshness:
+        cfg["freshness"] = True
     if args.control:
         if not args.telemetry_dir:
             ap.error("--control needs --telemetry-dir (action rows, "

@@ -950,6 +950,7 @@ namespace {
 constexpr uint32_t kPsrMagic = 0x31525350;  // "PSR1"
 constexpr uint8_t kPsrOpRead = 1;
 constexpr uint8_t kPsrFlagWantDelta = 1;
+constexpr uint8_t kPsrFlagWantFresh = 2;
 
 enum PsrKind : uint8_t {
   PSR_FULL = 0,
@@ -999,6 +1000,12 @@ struct RTenant {
   uint64_t latest = 0;            // latest published version (0 = none)
   RBuf* full = nullptr;           // latest full snapshot view
   std::map<uint64_t, RBuf*> deltas;  // base version -> delta view
+  // FRS1 freshness trailer for `latest` (copied, owned here; cleared on
+  // every publish so a stale birth record can never ride a new version)
+  std::vector<uint8_t> fresh;
+  double publish_wall = 0.0;      // last tps_read_set_fresh wall clock
+  uint64_t fresh_replies = 0;     // replies that carried the trailer
+  uint64_t min_have = 0;          // oldest nonzero have_version answered
 };
 
 // One queued reply: header (+ any inline error text) in `head`, then an
@@ -1008,6 +1015,8 @@ struct TxItem {
   size_t head_off = 0;
   RBuf* view = nullptr;
   uint64_t view_off = 0;
+  std::vector<uint8_t> tail;  // FRS1 trailer after the payload view
+  size_t tail_off = 0;
   bool counted_pending = false;  // admitted reply (sheds don't count)
 };
 
@@ -1043,6 +1052,21 @@ struct ReadStats {
 };
 #pragma pack(pop)
 static_assert(sizeof(ReadStats) == 128, "ReadStats must be 128 bytes");
+
+// Per-tenant freshness export, packed for the ctypes mirror in
+// serving/native_read.py (same discipline as ReadStats: static_assert
+// here, sizeof assert there, ABI twin below). Folded into core counters
+// at teardown like the conn/shed counters.
+#pragma pack(push, 1)
+struct ReadFreshStats {
+  uint64_t latest_version;    // latest published version for the tenant
+  double last_publish_wall;   // wall clock of the last set_fresh
+  uint64_t fresh_replies;     // replies that carried the FRS1 trailer
+  uint64_t min_have_version;  // oldest nonzero have_version answered
+};
+#pragma pack(pop)
+static_assert(sizeof(ReadFreshStats) == 32,
+              "ReadFreshStats must be 32 bytes");
 
 // epoll data.ptr sentinel for the wake eventfd (nullptr = listener,
 // like the TPS1 server above; any other value = an RConn*).
@@ -1121,7 +1145,7 @@ void rconn_interest(ReadServer* s, RConn* c, bool want_write) {
 bool rconn_flush(ReadServer* s, RConn* c) {
   while (!c->tx.empty()) {
     TxItem& it = c->tx.front();
-    iovec iov[2];
+    iovec iov[3];
     int niov = 0;
     if (it.head_off < it.head.size()) {
       iov[niov].iov_base = it.head.data() + it.head_off;
@@ -1131,6 +1155,11 @@ bool rconn_flush(ReadServer* s, RConn* c) {
     if (it.view != nullptr && it.view_off < it.view->len) {
       iov[niov].iov_base = const_cast<uint8_t*>(it.view->data) + it.view_off;
       iov[niov].iov_len = (size_t)(it.view->len - it.view_off);
+      ++niov;
+    }
+    if (it.tail_off < it.tail.size()) {
+      iov[niov].iov_base = it.tail.data() + it.tail_off;
+      iov[niov].iov_len = it.tail.size() - it.tail_off;
       ++niov;
     }
     if (niov == 0) {  // zero-length payload edge: item already complete
@@ -1153,9 +1182,15 @@ bool rconn_flush(ReadServer* s, RConn* c) {
     size_t adv = left < head_left ? left : head_left;
     it.head_off += adv;
     left -= adv;
-    it.view_off += left;
+    size_t view_left =
+        it.view != nullptr ? (size_t)(it.view->len - it.view_off) : 0;
+    adv = left < view_left ? left : view_left;
+    it.view_off += adv;
+    left -= adv;
+    it.tail_off += left;
     bool done = it.head_off == it.head.size() &&
-                (it.view == nullptr || it.view_off >= it.view->len);
+                (it.view == nullptr || it.view_off >= it.view->len) &&
+                it.tail_off >= it.tail.size();
     if (!done) {
       rconn_interest(s, c, true);
       return true;
@@ -1170,14 +1205,19 @@ bool rconn_flush(ReadServer* s, RConn* c) {
 }
 
 // Queue one PSR1 reply (net.py _reply byte layout: retry_after_s packed
-// only on retry replies, 0.0 otherwise).
+// only on retry replies, 0.0 otherwise). `tail` is the optional FRS1
+// freshness trailer riding after the payload; its length lands in the
+// reply's pad1 byte (0 = none, so non-requesting readers see replies
+// byte-identical to the pre-freshness wire).
 void rqueue_reply(ReadServer* s, RConn* c, uint8_t kind, uint64_t version,
                   uint64_t base, double retry_after,
                   const uint8_t* inline_payload, uint64_t inline_len,
-                  RBuf* view, bool admitted) {
+                  RBuf* view, bool admitted,
+                  const uint8_t* tail = nullptr, uint64_t tail_len = 0) {
   PsrRep h{};
   h.magic = kPsrMagic;
   h.kind = kind;
+  h.pad1 = (uint8_t)(tail_len <= 255 ? tail_len : 0);
   h.version = version;
   h.base_version = base;
   h.retry_after_s = retry_after;
@@ -1189,6 +1229,8 @@ void rqueue_reply(ReadServer* s, RConn* c, uint8_t kind, uint64_t version,
     it.head.insert(it.head.end(), inline_payload, inline_payload + inline_len);
   if (view != nullptr) ++view->inflight;
   it.view = view;
+  if (tail != nullptr && h.pad1 > 0)
+    it.tail.assign(tail, tail + h.pad1);
   it.counted_pending = admitted;
   if (admitted) ++s->pending;
   c->tx.push_back(std::move(it));
@@ -1225,6 +1267,7 @@ void rconn_handle(ReadServer* s, RConn* c) {
     if (tenant.empty()) tenant = s->default_tenant;
     off += total;
     bool want_delta = (req.flags & kPsrFlagWantDelta) != 0;
+    bool want_fresh = (req.flags & kPsrFlagWantFresh) != 0;
     uint64_t have = req.have_version;
     RTenant* t = nullptr;
     auto ti = s->tenants.find(tenant);
@@ -1251,6 +1294,13 @@ void rconn_handle(ReadServer* s, RConn* c) {
       continue;
     }
     ++s->st.reads_total;
+    if (have > 0 && (t->min_have == 0 || have < t->min_have))
+      t->min_have = have;
+    // the trailer describes t->latest by construction (installed under
+    // this same lock at publish time), so version consistency is free
+    const uint8_t* ftail =
+        want_fresh && !t->fresh.empty() ? t->fresh.data() : nullptr;
+    uint64_t ftail_len = ftail != nullptr ? t->fresh.size() : 0;
     if (have == t->latest) {
       ++s->st.reads_not_modified;
       rqueue_reply(s, c, PSR_NOT_MODIFIED, t->latest, have, 0.0, nullptr,
@@ -1266,8 +1316,9 @@ void rconn_handle(ReadServer* s, RConn* c) {
         ++d->served;
         if (t->full->len > d->len)
           s->st.delta_bytes_saved += t->full->len - d->len;
+        if (ftail != nullptr) ++t->fresh_replies;
         rqueue_reply(s, c, PSR_DELTA, t->latest, have, 0.0, nullptr, 0, d,
-                     true);
+                     true, ftail, ftail_len);
         continue;
       }
       // base aged out of the window / encode declined: full fallback,
@@ -1275,8 +1326,9 @@ void rconn_handle(ReadServer* s, RConn* c) {
     }
     ++s->st.reads_full;
     ++t->full->served;
+    if (ftail != nullptr) ++t->fresh_replies;
     rqueue_reply(s, c, PSR_FULL, t->latest, 0, 0.0, nullptr, 0, t->full,
-                 true);
+                 true, ftail, ftail_len);
   }
   if (off > 0) c->rx.erase(c->rx.begin(), c->rx.begin() + off);
 }
@@ -1413,6 +1465,40 @@ void tps_read_publish(void* h, const char* tenant, uint64_t version,
   b->len = len;
   t->full = b;
   t->latest = version;
+  // the old trailer describes the superseded version: never serve it
+  // with the new one (tps_read_set_fresh re-installs right after)
+  t->fresh.clear();
+}
+
+// Install the FRS1 freshness trailer for a tenant's current latest
+// version (copied — no lifetime contract, unlike the payload views).
+// len == 0 clears the trailer; publish_wall > 0 updates the export.
+void tps_read_set_fresh(void* h, const char* tenant, const uint8_t* data,
+                        uint64_t len, double publish_wall) {
+  ReadServer* s = (ReadServer*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  RTenant* t = rtenant_get(s, tenant);
+  if (data != nullptr && len > 0 && len <= 255)
+    t->fresh.assign(data, data + len);
+  else
+    t->fresh.clear();
+  if (publish_wall > 0.0) t->publish_wall = publish_wall;
+}
+
+// Per-tenant freshness export (oldest-served-version / last-publish-wall
+// pair + trailer reply count). Returns 1 when the tenant exists.
+int tps_read_fresh_stats(void* h, const char* tenant, ReadFreshStats* out) {
+  ReadServer* s = (ReadServer*)h;
+  std::lock_guard<std::mutex> lk(s->mu);
+  std::string key(tenant != nullptr ? tenant : "");
+  auto it = s->tenants.find(key);
+  if (it == s->tenants.end()) return 0;
+  RTenant* t = it->second;
+  out->latest_version = t->latest;
+  out->last_publish_wall = t->publish_wall;
+  out->fresh_replies = t->fresh_replies;
+  out->min_have_version = t->min_have;
+  return 1;
 }
 
 // Install one pre-encoded delta (base → current latest) for a tenant.
@@ -1559,6 +1645,10 @@ uint32_t tps_abi_psr_rep_bytes(void) { return (uint32_t)sizeof(PsrRep); }
 
 uint32_t tps_abi_read_stats_bytes(void) {
   return (uint32_t)sizeof(ReadStats);
+}
+
+uint32_t tps_abi_read_fresh_stats_bytes(void) {
+  return (uint32_t)sizeof(ReadFreshStats);
 }
 
 }  // extern "C"

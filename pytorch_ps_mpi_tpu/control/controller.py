@@ -150,6 +150,10 @@ CONTROL_KNOBS: Dict[str, Any] = {
     "replica_cooldown_s": 10.0,  # min gap between replica scale steps
     "replica_shed_per_s": 2.0,   # root sheds/s that scale the tier OUT
     "replica_lag_hi": 8.0,       # worst replica lag (versions) => IN
+    # freshness-burn scale-out: the fleet's worst-edge age (the
+    # freshness plane's serving_age_ms_max rollup) past this wall bound
+    # means readers somewhere see a stale model — add serving capacity
+    "replica_age_hi_ms": 5000.0,
     "shard_cooldown_s": 30.0,    # min gap between shard plan changes
     "shard_split_skew": 0.5,     # fleet skew spread_frac that splits
     "shard_merge_skew": 0.1,     # spread below which a split merges back
@@ -698,7 +702,12 @@ class ControlEngine:
             shed_rate = self._rate("topo_reads_shed", t,
                                    float(row.get("reads_shed", 0.0)))
             lag = float(row.get("replica_lag_max", 0.0))
-            if shed_rate > 0 or self.replicas <= int(k["replica_min"]):
+            # freshness burn: the worst edge's age-of-information (the
+            # fleet serving_age_ms_max rollup, persisted in THIS row)
+            edge_age = float(row.get("edge_age_ms", 0.0))
+            age_hot = edge_age >= float(k["replica_age_hi_ms"])
+            if (shed_rate > 0 or age_hot
+                    or self.replicas <= int(k["replica_min"])):
                 self._replica_idle_since = None
             elif self._replica_idle_since is None:
                 self._replica_idle_since = t
@@ -707,6 +716,7 @@ class ControlEngine:
                     >= 2.0 * float(k["replica_cooldown_s"]))
             if (self.replicas < int(k["replica_max"])
                     and (shed_rate >= float(k["replica_shed_per_s"])
+                         or age_hot
                          or self.replicas < int(k["replica_min"]))
                     and self._cooled(("topo", "replica"), t,
                                      float(k["replica_cooldown_s"]))):
@@ -715,6 +725,9 @@ class ControlEngine:
                 if shed_rate >= float(k["replica_shed_per_s"]):
                     verdict = {"kind": "shed_pressure",
                                "sheds_per_s": _r(shed_rate)}
+                elif age_hot:
+                    verdict = {"kind": "edge_age_burn",
+                               "edge_age_ms": _r(edge_age)}
                 else:
                     verdict = {"kind": "tier_floor",
                                "replica_min": int(k["replica_min"])}
@@ -1128,7 +1141,7 @@ class Controller:
         out["lf_saving_frac"] = lf_saving
         out["hot_group"] = hot_group
         out["replicas_live"] = float(self.replicas_live)
-        lag = skew = skew_hot = shards = 0.0
+        lag = skew = skew_hot = shards = edge_age = 0.0
         fm = getattr(server, "fleet_monitor", None)
         if fm is not None:
             try:
@@ -1136,8 +1149,11 @@ class Controller:
             except Exception:
                 snap = None
             if snap and snap.get("armed"):
-                lag = float((snap.get("fleet") or {}).get(
-                    "replica_lag_versions_max", 0.0))
+                fleet = snap.get("fleet") or {}
+                lag = float(fleet.get("replica_lag_versions_max", 0.0))
+                # worst-edge age-of-information: the freshness plane's
+                # fleet rollup — the evidence behind edge_age_burn
+                edge_age = float(fleet.get("serving_age_ms_max", 0.0))
                 shards = float(sum(
                     1 for m in (snap.get("members") or {}).values()
                     if m.get("ok") and m.get("role") == "shard"))
@@ -1146,6 +1162,7 @@ class Controller:
                     if v.get("flagged"):
                         skew_hot = 1.0
         out["replica_lag_max"] = lag
+        out["edge_age_ms"] = edge_age
         out["shard_skew"] = skew
         out["shard_skew_hot"] = skew_hot
         out["shards_n"] = shards

@@ -36,6 +36,7 @@ and no trainer loop at all.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
@@ -69,6 +70,14 @@ SERVING_KNOBS: Dict[str, Any] = {
 # read-latency buckets: 10 us in-process hits through multi-second stalls
 _READ_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
                  5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def _seq_quantile(sorted_xs, q: float) -> float:
+    """Nearest-rank quantile of an already-sorted sequence; 0.0 empty."""
+    if not sorted_xs:
+        return 0.0
+    return float(sorted_xs[min(len(sorted_xs) - 1,
+                               int(round(q * (len(sorted_xs) - 1))))])
 
 
 class ServingCore:
@@ -137,6 +146,27 @@ class ServingCore:
         self.delta_bytes_saved = 0
         self.ring_ageouts = 0
         self.delta_full_fallbacks = 0
+        # -- freshness plane (telemetry.freshness) ------------------------
+        # tenant -> {"version", "blob", "doc", "birth_local"}: the FRS1
+        # birth record of the version currently being served, stamped at
+        # publish (root) or relayed+extended (follower republish)
+        self._fresh: Dict[str, Dict[str, Any]] = {}
+        # recent publish->visible-here latencies (ms); empty at the root
+        # (a hop-less birth has no propagation to measure)
+        self._fresh_lat: collections.deque = collections.deque(maxlen=512)
+        # smallest nonzero have_version answered per tenant — how stale
+        # the laggiest reader was when it asked (native tier folds its
+        # own pair in at teardown)
+        self._fresh_min_have: Dict[str, int] = {}
+        self.fresh_replies = 0  # replies that carried an FRS1 trailer
+        # distinguishes server generations in birth records: a restarted
+        # root's version numbers restart too, and readers must not join
+        # ages across generations
+        self.fresh_root_gen = int(time.time()) & 0xFFFFFFFF
+        # optional monitor (telemetry.freshness.FreshnessTracker): set
+        # directly on standalone cores, found via the transport server's
+        # attribute otherwise — see arm_observability
+        self.freshness_tracker = None
         self._read_hist = self._reg.histogram(
             "ps_read_seconds", _READ_BUCKETS,
             "read-tier request service time (parse -> reply queued)")
@@ -322,7 +352,8 @@ class ServingCore:
     def publish(self, params: PyTree = None, *, flat: np.ndarray = None,
                 tenant: Optional[str] = None,
                 version: Optional[int] = None,
-                template: PyTree = None) -> int:
+                template: PyTree = None,
+                fresh: Optional[bytes] = None) -> int:
         """Publish one version: through the transport server (primary
         tenant) and/or into the snapshot ring (when the read tier is
         armed). Returns the published version.
@@ -332,6 +363,11 @@ class ServingCore:
         isn't running. Side tenants (``tenant != default``) and
         serverless cores version locally (pass ``version=`` to pin, e.g.
         a restored checkpoint's version).
+
+        ``fresh`` is a relayed FRS1 trailer (a follower republishing an
+        upstream version passes the upstream trailer with its own hop
+        appended, preserving the ROOT's birth record); ``None`` stamps a
+        new birth here — this core IS the root for the version.
         """
         tenant = tenant or self.default_tenant
         primary = (self.server is not None
@@ -366,12 +402,97 @@ class ServingCore:
             # against the previous latest can never be served again
             for k in [k for k in self._encode_cache if k[0] == tenant]:
                 del self._encode_cache[k]
+        blob, doc = self._stamp_fresh(tenant, version, fresh)
         if self.read_native:
             # version-window boundary: hand the frozen snapshot + the
-            # ring's pre-encoded deltas to the native tier — the ONLY
-            # Python the native read path ever runs
-            self.read_server.on_publish(tenant, version, store)
+            # ring's pre-encoded deltas (and the version's freshness
+            # trailer) to the native tier — the ONLY Python the native
+            # read path ever runs
+            self.read_server.on_publish(
+                tenant, version, store, fresh=blob,
+                publish_wall=(doc["publish_wall"] if doc is not None
+                              else 0.0))
         return version
+
+    def _stamp_fresh(self, tenant: str, version: int,
+                     fresh: Optional[bytes]
+                     ) -> Tuple[bytes, Optional[Dict[str, Any]]]:
+        """Install the version's FRS1 birth record: stamp a new one
+        (root publish) or validate and adopt a relayed trailer
+        (follower republish). A malformed relay trailer is REJECTED —
+        the version serves with no trailer rather than a corrupt one."""
+        from pytorch_ps_mpi_tpu.telemetry import freshness as _freshness
+
+        if fresh is None:
+            blob = _freshness.pack_birth(version, time.time(),
+                                         self.fresh_root_gen)
+        elif not fresh:
+            # relay with nothing to relay (upstream sent no trailer):
+            # serve the version untrailered — a birth record is carried
+            # end-to-end or not at all, never re-stamped mid-chain
+            return b"", None
+        else:
+            blob = bytes(fresh)
+        try:
+            doc = _freshness.unpack_trailer(blob)
+        except ValueError:
+            ft = self._fresh_tracker()
+            if ft is not None:
+                ft.note_reject()
+            return b"", None
+        with self._lock:
+            self._fresh[tenant] = {
+                "version": version, "blob": blob, "doc": doc,
+                "birth_local": _freshness.birth_wall_local(doc)}
+            vis = _freshness.visible_latency_ms(doc)
+            if vis is not None:
+                self._fresh_lat.append(vis)
+        ft = self._fresh_tracker()
+        if ft is not None:
+            ft.note_publish(tenant, doc)
+        return blob, doc
+
+    def _fresh_tracker(self):
+        ft = self.freshness_tracker
+        if ft is None and self.server is not None:
+            ft = getattr(self.server, "freshness_tracker", None)
+        return ft
+
+    def fresh_trailer(self, tenant: Optional[str] = None,
+                      version: Optional[int] = None) -> bytes:
+        """The FRS1 trailer to attach to a reply delivering ``version``
+        (b"" when none is installed or a publish raced the reply onto a
+        different version). Counts the reply — the Python twin of the
+        native tier's ``fresh_replies``."""
+        rec = self._fresh.get(tenant or self.default_tenant)
+        if rec is None:
+            return b""
+        if version is not None and rec["version"] != int(version):
+            return b""
+        with self._lock:
+            self.fresh_replies += 1
+        return rec["blob"]
+
+    def fresh_doc(self, tenant: Optional[str] = None
+                  ) -> Optional[Dict[str, Any]]:
+        """Decoded trailer of the version currently served (or None)."""
+        rec = self._fresh.get(tenant or self.default_tenant)
+        return rec["doc"] if rec is not None else None
+
+    def fresh_ages_ms(self, now: Optional[float] = None
+                      ) -> Dict[str, float]:
+        """Age-of-information gauge, per tenant: wall age (local clock)
+        of the version each tenant currently serves. Grows continuously
+        between publishes, snaps down when a fresher version lands."""
+        t = time.time() if now is None else float(now)
+        with self._lock:
+            return {tn: max(0.0, (t - rec["birth_local"]) * 1e3)
+                    for tn, rec in self._fresh.items()}
+
+    def serving_age_ms(self, now: Optional[float] = None) -> float:
+        """Worst-tenant age — the canonical ``serving_age_ms`` key."""
+        ages = self.fresh_ages_ms(now)
+        return max(ages.values()) if ages else 0.0
 
     # -- read path --------------------------------------------------------
     def _delta(self, tenant: str) -> DeltaCodec:
@@ -410,10 +531,14 @@ class ServingCore:
                                            self.retry_after_s)
 
     # -- follower-tier accounting (serving.follower.FollowerLoop) ---------
-    def set_replica_lag(self, lag: int) -> None:
-        """Versions this replica is behind its upstream (0 = current)."""
+    def set_replica_lag(self, lag: float) -> None:
+        """Versions this replica is behind its upstream (0 = current).
+        Fractional values are meaningful: the follower feeds its
+        EWMA-decayed lag here, so a spike fades over a few polls
+        instead of snapping to zero the moment the replica catches
+        up."""
         with self._lock:
-            self.replica_lag_versions = max(0, int(lag))
+            self.replica_lag_versions = max(0.0, float(lag))
 
     def note_relayed(self, nbytes: int) -> None:
         """Bytes this follower pulled from upstream and re-served."""
@@ -479,6 +604,12 @@ class ServingCore:
                 cutoff = now_s - int(self.knobs["rate_window_s"])
                 for sec in [s for s in self._rate if s < cutoff]:
                     del self._rate[sec]
+            if have > 0:
+                # oldest-served-version accounting (freshness plane):
+                # the laggiest base any reader still held when asking
+                mh = self._fresh_min_have.get(tenant)
+                if mh is None or have < mh:
+                    self._fresh_min_have[tenant] = have
         if have == version:
             store.release(latest)
             with self._lock:
@@ -591,6 +722,17 @@ class ServingCore:
                 out[dst] += float(nat[src])
         out["read_p50_ms"] = self._quantile_ms(0.50)
         out["read_p95_ms"] = self._quantile_ms(0.95)
+        # freshness plane: publish->visible latency quantiles (zeros at
+        # the root, which has no propagation hops), worst-tenant age of
+        # the version being served, and this node's hop depth
+        with self._lock:
+            lat = sorted(self._fresh_lat)
+            hops = max((rec["doc"]["hop_count"]
+                        for rec in self._fresh.values()), default=0)
+        out["read_fresh_p50_ms"] = _seq_quantile(lat, 0.50)
+        out["read_fresh_p95_ms"] = _seq_quantile(lat, 0.95)
+        out["serving_age_ms"] = self.serving_age_ms()
+        out["fresh_hop_count"] = float(hops)
         return out
 
     def serving_snapshot(self) -> Dict[str, Any]:
@@ -654,6 +796,37 @@ class ServingCore:
             out["eof_mid_request"] = self.read_server.eof_mid_request
         out["replica_lag_versions"] = self.replica_lag_versions
         out["follower_bytes_relayed"] = self.follower_bytes_relayed
+        out["freshness"] = self.freshness_snapshot()
+        return out
+
+    def freshness_snapshot(self) -> Dict[str, Any]:
+        """The ``/health`` serving section's freshness pane: per-tenant
+        age of information + birth records, the publish->visible
+        quantiles, trailer-reply and laggiest-reader accounting (native
+        tier's live pair included when armed)."""
+        now = time.time()
+        with self._lock:
+            tenants = {
+                tn: {"version": rec["version"],
+                     "age_ms": round(
+                         max(0.0, (now - rec["birth_local"]) * 1e3), 3),
+                     "hop_count": rec["doc"]["hop_count"],
+                     "publish_wall": rec["doc"]["publish_wall"],
+                     "root_gen": rec["doc"]["root_gen"]}
+                for tn, rec in self._fresh.items()
+            }
+            lat = sorted(self._fresh_lat)
+            out = {
+                "tenants": tenants,
+                "read_fresh_p50_ms": round(_seq_quantile(lat, 0.50), 3),
+                "read_fresh_p95_ms": round(_seq_quantile(lat, 0.95), 3),
+                "fresh_replies": self.fresh_replies,
+                "min_have_version": dict(self._fresh_min_have),
+            }
+        if self.read_native and self.read_server is not None:
+            nf = self.read_server.fresh_stats_all()
+            if nf:
+                out["native_fresh"] = nf
         return out
 
     def _register_scrape(self) -> None:
@@ -705,6 +878,23 @@ class ServingCore:
             r.counter("ps_follower_bytes_relayed_total",
                       "bytes pulled from upstream and re-served by "
                       "this follower").set(m["follower_bytes_relayed"])
+            r.gauge("ps_serving_age_ms",
+                    "wall age of the version currently being served "
+                    "(worst tenant; the age-of-information gauge)").set(
+                        m["serving_age_ms"])
+            r.gauge("ps_read_fresh_p50_ms",
+                    "publish->visible-here propagation latency p50 "
+                    "(ms; zero at the root)").set(m["read_fresh_p50_ms"])
+            r.gauge("ps_read_fresh_p95_ms",
+                    "publish->visible-here propagation latency p95 "
+                    "(ms; zero at the root)").set(m["read_fresh_p95_ms"])
+            r.gauge("ps_fresh_hop_count",
+                    "replica hops recorded in the served version's "
+                    "freshness trailer (this node's tree depth)").set(
+                        m["fresh_hop_count"])
+            r.counter("ps_fresh_replies_total",
+                      "replies that carried an FRS1 freshness "
+                      "trailer").set(float(self.fresh_replies))
             with self._lock:
                 occ = sum(len(s._order) for s in self._stores.values())
                 tenants = len(self._stores)
@@ -739,6 +929,18 @@ class ServingCore:
                               "coalesce_hits", "delta_bytes_saved"):
                         setattr(self, k, getattr(self, k) + nrs[k])
                 self.read_native = False
+            # …and the per-tenant freshness pair (trailered replies +
+            # laggiest reader base) folds the same way
+            fs_all = getattr(self.read_server, "fresh_stats_all",
+                             lambda: {})()
+            with self._lock:
+                for tn, fs in (fs_all or {}).items():
+                    self.fresh_replies += int(fs["fresh_replies"])
+                    mh = int(fs["min_have_version"])
+                    if mh:
+                        cur = self._fresh_min_have.get(tn)
+                        self._fresh_min_have[tn] = (
+                            mh if cur is None else min(cur, mh))
             self.read_server = None
         reg, self._fleet_registration = self._fleet_registration, None
         if reg is not None:

@@ -22,10 +22,18 @@ an error).
 
 Accounting flows into the canonical metrics surface through the local
 core: ``replica_lag_versions`` (how far this replica trails the latest
-upstream version it has observed) and ``follower_bytes_relayed``
-(bytes pulled from upstream and re-served), plus optional
-``kind="reader_round"`` anatomy rows so the replica's pull cadence is
-visible next to the server rounds that produced the versions.
+upstream version it has observed — EWMA-decayed on idle polls, never
+snapped to zero, so a lag spike stays visible for a few windows) and
+``follower_bytes_relayed`` (bytes pulled from upstream and re-served),
+plus optional ``kind="reader_round"`` anatomy rows so the replica's
+pull cadence is visible next to the server rounds that produced the
+versions.
+
+Freshness: every republish relays the upstream version's FRS1 birth
+record with this hop's record appended (arrival wall on THIS clock,
+skew vs upstream from the reader's lower-envelope fit), so a version's
+trailer accumulates the whole chain root → … → this replica and the
+local core's age-of-information gauge is meaningful across hosts.
 """
 
 from __future__ import annotations
@@ -78,6 +86,13 @@ class FollowerLoop:
         self.timeout = float(timeout)
         self.serving_kw = dict(serving_kw or {})
         self.anatomy = anatomy
+        from pytorch_ps_mpi_tpu.telemetry.diagnosis import Ewma
+
+        # replica lag decays through an EWMA (the diagnosis.py
+        # discipline) instead of snapping to zero on idle polls: a lag
+        # spike observed at pull time stays visible to the controller
+        # for a few windows instead of vanishing one poll later
+        self._lag_ewma = Ewma(alpha=0.25)
         self._reader = None
         self._sleep_s = self.poll_s
         self._relayed_mark = 0  # reader.bytes_received already credited
@@ -100,6 +115,25 @@ class FollowerLoop:
             timeout=self.timeout, serving_kw=self.serving_kw)
         self._relayed_mark = 0
         return reader
+
+    def _extend_trailer(self, reader, version: int) -> bytes:
+        """The upstream trailer for ``version`` with THIS hop's record
+        appended (arrival wall on this clock, skew vs upstream from the
+        reader's lower-envelope fit). ``b""`` — republish with no
+        trailer — when upstream sent none or it describes a different
+        version (a publish raced the pull): the birth record is
+        relayed exactly or not at all, never re-stamped downstream."""
+        doc = reader.fresh
+        if doc is None or doc["version"] != version:
+            return b""
+        from pytorch_ps_mpi_tpu.telemetry.freshness import append_hop
+
+        try:
+            return append_hop(reader.fresh_raw, doc["hop_count"] + 1,
+                              reader.fresh_recv_wall,
+                              skew_ms=reader.reader_skew_s() * 1e3)
+        except ValueError:
+            return b""
 
     def _teardown(self) -> None:
         if self._reader is not None:
@@ -150,26 +184,37 @@ class FollowerLoop:
             lag = max(0, int(version) - before)
             if int(version) > before:
                 # lag as observed at pull time: how far downstream was
-                # behind the instant the new version arrived
-                self.core.set_replica_lag(lag)
+                # behind the instant the new version arrived — folded
+                # into the EWMA, so it decays over later polls instead
+                # of being clobbered back to zero
+                self._lag_ewma.update(float(lag))
+                self.core.set_replica_lag(self._lag_ewma.value)
                 # the store adopts + freezes its input; the reader keeps
                 # applying deltas to _flat, so hand the ring a copy
                 self.core.publish(
                     flat=np.array(reader._flat, dtype=np.float32),
-                    version=int(version), template=self.template)
+                    version=int(version), template=self.template,
+                    fresh=self._extend_trailer(reader, int(version)))
                 self.republished += 1
                 self._sleep_s = self.poll_s
                 outcome = "republished"
                 row = {"outcome": outcome, "version": int(version),
-                       "lag": lag, "relayed_bytes": int(max(fresh, 0)),
+                       "lag": lag,
+                       # wall age (this clock, skew-corrected) of the
+                       # version at the moment it was pulled
+                       "age_ms": round(reader.fresh_age_ms(), 3),
+                       "relayed_bytes": int(max(fresh, 0)),
                        "pull_s": round(time.perf_counter() - t0, 6),
                        "upstream": f"{self.host}:{self.port}"}
                 if self.anatomy is not None:
                     self.anatomy.observe_reader_round(dict(row))
-                self.core.set_replica_lag(0)
                 return row
             self.not_modified += 1
-            self.core.set_replica_lag(0)
+            # idle: the observed lag DECAYS (EWMA toward zero) — the
+            # replica is provably current, but the spike that preceded
+            # catch-up stays visible for a few windows
+            self._lag_ewma.update(0.0)
+            self.core.set_replica_lag(self._lag_ewma.value)
             # idle: exponential backoff so a quiet upstream costs ~0
             self._sleep_s = min(self._sleep_s * 2.0, self.max_poll_s)
             outcome = "not_modified"

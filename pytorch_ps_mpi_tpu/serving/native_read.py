@@ -65,6 +65,21 @@ class _ReadStats(ctypes.Structure):
 assert ctypes.sizeof(_ReadStats) == 128
 
 
+class _ReadFreshStats(ctypes.Structure):
+    """Mirror of native/tcpps.cpp ReadFreshStats (32 bytes, packed)."""
+
+    _pack_ = 1
+    _fields_ = [
+        ("latest_version", ctypes.c_uint64),
+        ("last_publish_wall", ctypes.c_double),
+        ("fresh_replies", ctypes.c_uint64),
+        ("min_have_version", ctypes.c_uint64),
+    ]
+
+
+assert ctypes.sizeof(_ReadFreshStats) == 32
+
+
 def get_read_lib() -> Optional[ctypes.CDLL]:
     """Build (once) and load the ``tps_read_*`` entry points from
     native/tcpps.cpp; None without a toolchain or when the cached
@@ -102,6 +117,13 @@ def get_read_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int]
         lib.tps_read_stats.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(_ReadStats)]
+        lib.tps_read_set_fresh.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, u8p, ctypes.c_uint64,
+            ctypes.c_double]
+        lib.tps_read_fresh_stats.restype = ctypes.c_int
+        lib.tps_read_fresh_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(_ReadFreshStats)]
         lib.tps_read_set_admission.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_double]
         lib.tps_read_wake.argtypes = [ctypes.c_void_p]
@@ -124,6 +146,7 @@ def _verify_read_abi(lib: ctypes.CDLL) -> None:
     lib.tps_abi_psr_req_bytes.restype = ctypes.c_uint32
     lib.tps_abi_psr_rep_bytes.restype = ctypes.c_uint32
     lib.tps_abi_read_stats_bytes.restype = ctypes.c_uint32
+    lib.tps_abi_read_fresh_stats_bytes.restype = ctypes.c_uint32
     checks = (
         ("PSR1 magic", int(lib.tps_abi_psr_magic()), _net.MAGIC),
         ("PSR1 request bytes", int(lib.tps_abi_psr_req_bytes()),
@@ -132,6 +155,8 @@ def _verify_read_abi(lib: ctypes.CDLL) -> None:
          _net._REP.size),
         ("ReadStats bytes", int(lib.tps_abi_read_stats_bytes()),
          ctypes.sizeof(_ReadStats)),
+        ("ReadFreshStats bytes", int(lib.tps_abi_read_fresh_stats_bytes()),
+         ctypes.sizeof(_ReadFreshStats)),
     )
     for what, native_v, py_v in checks:
         if native_v != py_v:
@@ -172,6 +197,10 @@ class NativeReadServer:
         self._pins_lock = threading.Lock()
         self._next_token = 1
         self._final_stats: Dict[str, int] = {}
+        # tenants this wrapper has published (the C API is per-tenant;
+        # fresh_stats_all iterates this set) + the post-close capture
+        self._tenants: set = {core.default_tenant}
+        self._final_fresh: Dict[str, Dict[str, float]] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._pump_loop, daemon=True,
@@ -205,10 +234,13 @@ class NativeReadServer:
         return tok
 
     # -- publish boundary -------------------------------------------------
-    def on_publish(self, tenant: str, version: int, store) -> None:
+    def on_publish(self, tenant: str, version: int, store,
+                   fresh: bytes = b"", publish_wall: float = 0.0) -> None:
         """Version-window boundary: pin the new latest, pre-encode the
-        ring's deltas, install everything natively. Called from the
-        publish path right after ``store.put``."""
+        ring's deltas, install everything natively (including the FRS1
+        freshness trailer — copied by C++, no pin needed). Called from
+        the publish path right after ``store.put``."""
+        self._tenants.add(tenant)
         latest = store.acquire(int(version))
         if latest is None:
             return  # evicted already (ring 1 races) — nothing to serve
@@ -218,6 +250,7 @@ class NativeReadServer:
         self._lib.tps_read_publish(  # psanalyze: ok thread-affinity
             self._handle, tenant.encode(), int(version),
             flat_u8.ctypes.data_as(u8p), flat_u8.nbytes, tok)
+        self.set_fresh(tenant, fresh, publish_wall)
         # pre-encode base -> latest for every ring-resident base: the
         # one encode per (base, latest) pair the Python path coalesces
         # lazily happens HERE, once, so serving it never touches Python
@@ -257,6 +290,40 @@ class NativeReadServer:
         self._lib.tps_read_stats(self._handle, ctypes.byref(st))  # psanalyze: ok thread-affinity
         return {name: int(getattr(st, name)) for name, _ in st._fields_}
 
+    def set_fresh(self, tenant: str, fresh: bytes,
+                  publish_wall: float = 0.0) -> None:
+        """Install (or clear, ``b""``) the FRS1 trailer the C++ tier
+        attaches to want_fresh FULL/DELTA replies for ``tenant``."""
+        if self._handle is None:
+            return
+        buf = (ctypes.c_uint8 * max(len(fresh), 1)).from_buffer_copy(
+            fresh or b"\x00")
+        self._lib.tps_read_set_fresh(  # psanalyze: ok thread-affinity
+            self._handle, tenant.encode(), buf, len(fresh),
+            float(publish_wall))
+
+    def fresh_stats_all(self) -> Dict[str, Dict[str, float]]:
+        """Per-tenant freshness export: latest_version /
+        last_publish_wall / fresh_replies / min_have_version. Serves the
+        teardown capture after :meth:`close` (same discipline as
+        :meth:`stats`), so post-run accounting still sees it."""
+        if self._handle is None:
+            return {t: dict(v) for t, v in self._final_fresh.items()}
+        out: Dict[str, Dict[str, float]] = {}
+        fs = _ReadFreshStats()
+        for tenant in sorted(self._tenants):
+            ok = self._lib.tps_read_fresh_stats(  # psanalyze: ok thread-affinity
+                self._handle, tenant.encode(), ctypes.byref(fs))
+            if not ok:
+                continue
+            out[tenant] = {
+                "latest_version": int(fs.latest_version),
+                "last_publish_wall": float(fs.last_publish_wall),
+                "fresh_replies": int(fs.fresh_replies),
+                "min_have_version": int(fs.min_have_version),
+            }
+        return out
+
     def queue_depth(self) -> int:
         return self.stats()["pending"]
 
@@ -274,6 +341,7 @@ class NativeReadServer:
         self._lib.tps_read_wake(self._handle)  # psanalyze: ok thread-affinity
         self._thread.join(timeout=5)
         self._final_stats = self.stats()
+        self._final_fresh = self.fresh_stats_all()
         self._lib.tps_read_close(self._handle)  # psanalyze: ok thread-affinity
         self._handle = None
         # every pin the released queue never surfaced is dropped now —

@@ -30,12 +30,21 @@ discipline that keeps the shm/tcp pumps single-threaded.
 Protocol (little-endian)::
 
   request:  u32 magic 'PSR1' | u8 op (1=READ) | u8 flags (bit0
-            want_delta) | u16 tenant_len | u64 have_version
-            | tenant utf-8 bytes
+            want_delta, bit1 want_fresh) | u16 tenant_len
+            | u64 have_version | tenant utf-8 bytes
   reply:    u32 magic | u8 kind (0 full / 1 delta / 2 not-modified /
-            3 retry / 4 error) | u8 pad | u16 pad | u64 version
+            3 retry / 4 error) | u8 fresh_len | u16 pad | u64 version
             | u64 base_version | f64 retry_after_s | u64 payload_len
-            | payload
+            | payload | fresh trailer (fresh_len bytes)
+
+The ``fresh_len`` byte reuses the header's previously-zero pad byte:
+when the reader sets ``FLAG_WANT_FRESH`` and the reply delivers a
+version (full/delta), an FRS1 freshness trailer (see
+:mod:`pytorch_ps_mpi_tpu.telemetry.freshness`) rides AFTER the payload
+and its length rides in ``fresh_len``. Readers that never set the flag
+receive byte-identical replies to the pre-freshness wire — the
+native-vs-Python reply-parity invariant (and every old reader) is
+untouched. The trailer is capped well under 255 bytes by the hop cap.
 
 Client side: :class:`ReadClient` is the one-request/one-reply socket
 primitive; :class:`ServingReader` is the stateful reader the tests and
@@ -61,6 +70,7 @@ PyTree = Any
 MAGIC = 0x31525350  # "PSR1"
 OP_READ = 1
 FLAG_WANT_DELTA = 1
+FLAG_WANT_FRESH = 2
 
 KIND_FULL, KIND_DELTA, KIND_NOT_MODIFIED, KIND_RETRY, KIND_ERROR = range(5)
 KIND_NAMES = {KIND_FULL: "full", KIND_DELTA: "delta",
@@ -72,9 +82,10 @@ _REP = struct.Struct("<IBBHQQdQ")
 
 
 def pack_request(have_version: int = 0, want_delta: bool = True,
-                 tenant: str = "") -> bytes:
+                 tenant: str = "", want_fresh: bool = False) -> bytes:
     t = tenant.encode()
-    flags = FLAG_WANT_DELTA if want_delta else 0
+    flags = ((FLAG_WANT_DELTA if want_delta else 0)
+             | (FLAG_WANT_FRESH if want_fresh else 0))
     return _REQ.pack(MAGIC, OP_READ, flags, len(t), int(have_version)) + t
 
 
@@ -247,7 +258,7 @@ class ReadTierServer:
                 self._backlog.append((conn, req))
 
     def _parse_one(self, conn: _Conn
-                   ) -> Optional[Tuple[int, bool, str]]:
+                   ) -> Optional[Tuple[int, bool, str, bool]]:
         """One complete request off the rx buffer, or None."""
         if len(conn.rx) < _REQ.size:
             return None
@@ -263,18 +274,27 @@ class ReadTierServer:
             return None
         tenant = bytes(conn.rx[_REQ.size:total]).decode(errors="replace")
         del conn.rx[:total]
-        return int(have), bool(flags & FLAG_WANT_DELTA), tenant
+        return (int(have), bool(flags & FLAG_WANT_DELTA), tenant,
+                bool(flags & FLAG_WANT_FRESH))
 
     def _process_backlog(self) -> None:
         for _ in range(min(self.max_per_tick, len(self._backlog))):
-            conn, (have, want_delta, tenant) = self._backlog.popleft()
+            conn, (have, want_delta, tenant, want_fresh) = (
+                self._backlog.popleft())
             if conn.sock not in self._conns:
                 continue  # reader went away while queued
             t0 = time.perf_counter()
+            fresh = b""
             try:
                 kind, version, base, payload, done = self.core.handle_read(
                     have_version=have, want_delta=want_delta,
                     tenant=tenant or None)
+                if want_fresh and kind in (KIND_FULL, KIND_DELTA):
+                    # the trailer must describe exactly the version this
+                    # reply delivers — a publish racing in between
+                    # yields b"" (no trailer) rather than a stale stamp
+                    fresh = self.core.fresh_trailer(tenant or None,
+                                                    version)
             except Exception as e:
                 # one bad request/publish must never kill the loop thread
                 # serving everyone else: answer with an error and move on
@@ -283,19 +303,21 @@ class ReadTierServer:
             self._reply(conn, kind, version, base, payload,
                         done=done,
                         retry_after=(self.core.retry_after_s
-                                     if kind == KIND_RETRY else 0.0))
+                                     if kind == KIND_RETRY else 0.0),
+                        fresh=fresh)
             self.core.observe_read(time.perf_counter() - t0)
 
     def _reply(self, conn: _Conn, kind: int, version: int, base: int,
-               payload, done=None, retry_after: float = 0.0) -> None:
+               payload, done=None, retry_after: float = 0.0,
+               fresh: bytes = b"") -> None:
         if isinstance(payload, (bytes, bytearray)):
             payload = memoryview(payload)
         elif isinstance(payload, np.ndarray):
             payload = memoryview(payload.view(np.uint8))
         plen = payload.nbytes if payload is not None else 0
         hdr = self._pool.get()
-        _REP.pack_into(hdr, 0, MAGIC, kind, 0, 0, int(version), int(base),
-                       float(retry_after), plen)
+        _REP.pack_into(hdr, 0, MAGIC, kind, len(fresh), 0, int(version),
+                       int(base), float(retry_after), plen)
         pool = self._pool
         conn.tx.append((memoryview(hdr), lambda b=hdr: pool.put(b)))
         if payload is not None:
@@ -305,6 +327,10 @@ class ReadTierServer:
             conn.tx.append((payload, done))
         elif done is not None:
             done()
+        if fresh:
+            # freshness trailer after the payload; tiny and immutable,
+            # so it rides as its own bytes object with no drain hook
+            conn.tx.append((memoryview(fresh), None))
         self._want_write(conn)
         self._flush(conn)
 
@@ -352,6 +378,9 @@ class ReadClient:
     def __init__(self, host: str, port: int, timeout: float = 10.0,
                  tenant: str = ""):
         self.tenant = tenant
+        #: raw FRS1 trailer from the last full/delta reply (b"" when the
+        #: server sent none or the request didn't ask)
+        self.last_fresh = b""
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
         try:
@@ -369,19 +398,22 @@ class ReadClient:
             out += chunk
         return bytes(out)
 
-    def request(self, have_version: int = 0, want_delta: bool = True
+    def request(self, have_version: int = 0, want_delta: bool = True,
+                want_fresh: bool = False
                 ) -> Tuple[str, int, int, float, bytes]:
         """Returns ``(kind, version, base_version, retry_after_s,
         payload_bytes)`` — kind is one of full/delta/not_modified/retry/
-        error."""
+        error. A freshness trailer, when requested and sent, lands in
+        :attr:`last_fresh` (return shape stays stable for old callers)."""
         self._sock.sendall(pack_request(have_version, want_delta,
-                                        self.tenant))
+                                        self.tenant, want_fresh))
         hdr = self._recv_exact(_REP.size)
-        magic, kind, _, _, version, base, retry_after, plen = (
+        magic, kind, fresh_len, _, version, base, retry_after, plen = (
             _REP.unpack(hdr))
         if magic != MAGIC:
             raise ConnectionError(f"bad reply magic 0x{magic:08x}")
         payload = self._recv_exact(plen) if plen else b""
+        self.last_fresh = self._recv_exact(fresh_len) if fresh_len else b""
         name = KIND_NAMES.get(kind, "error")
         if name == "error":
             raise RuntimeError(
@@ -415,12 +447,14 @@ class ServingReader:
     def __init__(self, host: str, port: int, template: PyTree,
                  tenant: str = "", timeout: float = 10.0,
                  want_delta: bool = True, max_retries: int = 100,
-                 serving_kw: Optional[dict] = None):
+                 serving_kw: Optional[dict] = None,
+                 want_fresh: bool = True):
         from pytorch_ps_mpi_tpu.serving.delta import DeltaCodec
 
         self.client = ReadClient(host, port, timeout=timeout, tenant=tenant)
         self.template = template
         self.want_delta = bool(want_delta)
+        self.want_fresh = bool(want_fresh)
         self.max_retries = int(max_retries)
         self.delta = DeltaCodec.from_knobs(template, serving_kw or {})
         self.version = 0
@@ -433,6 +467,14 @@ class ServingReader:
         self.not_modified = 0
         self.shed_retries = 0
         self.bytes_received = 0
+        # freshness: the last version delivery's FRS1 trailer (raw +
+        # decoded), its local receive wall, and the (upstream stamp,
+        # local recv) pairs the lower-envelope skew fit consumes
+        self.fresh_raw = b""
+        self.fresh: Optional[Dict[str, Any]] = None
+        self.fresh_recv_wall = 0.0
+        self.fresh_rejects = 0
+        self._skew_pairs: collections.deque = collections.deque(maxlen=64)
 
     def read_params(self) -> Tuple[PyTree, int]:
         from pytorch_ps_mpi_tpu.parallel.dcn import _unflatten
@@ -441,6 +483,7 @@ class ServingReader:
             kind, version, base, retry_after, payload = self.client.request(
                 have_version=self.version if self._flat is not None else 0,
                 want_delta=self.want_delta and self._flat is not None,
+                want_fresh=self.want_fresh,
             )
             self.bytes_received += len(payload)
             if kind == "retry":
@@ -463,10 +506,67 @@ class ServingReader:
                 self.full_reads += 1
             self.version = int(version)
             self._tree = _unflatten(self._flat, self.template)
+            if self.client.last_fresh:
+                self._note_fresh(self.client.last_fresh)
             return self._tree, self.version
         raise TimeoutError(
             f"read shed {self.shed_retries} times; gave up after "
             f"{self.max_retries} attempts")
+
+    # -- freshness --------------------------------------------------------
+    def _note_fresh(self, raw: bytes) -> None:
+        from pytorch_ps_mpi_tpu.telemetry import freshness as _fresh
+
+        try:
+            doc = _fresh.unpack_trailer(raw)
+        except ValueError:
+            # truncated/corrupt trailer: reject, keep the previous one
+            self.fresh_rejects += 1
+            return
+        now = time.time()
+        self.fresh_raw, self.fresh, self.fresh_recv_wall = raw, doc, now
+        # newest upstream-clock stamp in the trailer vs our receive wall
+        stamp = (doc["hops"][-1]["arrival_wall"] if doc["hops"]
+                 else doc["publish_wall"])
+        self._skew_pairs.append((stamp, now))
+
+    def reader_skew_s(self) -> float:
+        """Lower-envelope estimate of (this reader's clock − the served
+        trailer's last-hop clock); 0.0 until a pair exists. Absorbs the
+        minimum poll+transfer delay — see the freshness module
+        docstring's skew caveat."""
+        if not self._skew_pairs:
+            return 0.0
+        from pytorch_ps_mpi_tpu.telemetry.lineage import (
+            estimate_clock_offset,
+        )
+
+        return estimate_clock_offset(list(self._skew_pairs))
+
+    def fresh_age_ms(self, now: Optional[float] = None) -> float:
+        """Wall age (reader clock) of the version this reader currently
+        holds; 0.0 before any trailer arrived."""
+        if self.fresh is None:
+            return 0.0
+        from pytorch_ps_mpi_tpu.telemetry import freshness as _fresh
+
+        t = time.time() if now is None else float(now)
+        birth = _fresh.birth_wall_local(self.fresh) + self.reader_skew_s()
+        return max(0.0, (t - birth) * 1e3)
+
+    def fresh_delivery_row(self, reader: str = "reader") -> Dict[str, Any]:
+        """One reader-delivery row for the freshness plane
+        (:meth:`FreshnessTracker.note_delivery`'s input shape)."""
+        doc = self.fresh
+        return {
+            "reader": reader,
+            "tenant": self.client.tenant or "default",
+            "version": self.version,
+            "age_ms": round(self.fresh_age_ms(), 3),
+            "hop_count": doc["hop_count"] if doc is not None else 0,
+            "root_gen": doc["root_gen"] if doc is not None else 0,
+            "t": time.time(),
+        }
 
     def close(self) -> None:
         self.client.close()

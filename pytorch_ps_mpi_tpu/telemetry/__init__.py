@@ -59,6 +59,12 @@ dicts). One system, three faces:
   :class:`SLOWatchdog`, multi-window burn-rate rules over the TSDB with
   bench-derived targets, latched replayable verdicts, and the
   ``ps_slo_*`` scrape instruments.
+- :mod:`freshness <.freshness>` — the layer that makes the READ path
+  causal: FRS1 birth records ride the PSR1 delta stream from root
+  publish through every follower hop to the edge reader, and
+  :class:`FreshnessTracker` turns them into publish→visible latency
+  distributions, the age-of-information gauge, and flow events joined
+  to write-path lineage.
 - :mod:`fleet <.fleet>` — the layer that merges the PANES:
   :class:`FleetMonitor` polls every registered endpoint (sharded
   servers, supervisor generations, the read tier) into one ``/fleet``
@@ -96,6 +102,7 @@ SIDECAR_PREFIXES: Dict[str, Optional[str]] = {
     "timeseries-": "history",  # retained metric history (TSDB)
     "slo-": "slo",            # SLO verdict events
     "control-": "actions",    # controller action rows
+    "freshness-": "freshness",  # publish→edge propagation + delivery rows
 }
 
 
@@ -190,6 +197,11 @@ from pytorch_ps_mpi_tpu.telemetry.anatomy import (
     anatomy_from_rows,
     load_anatomy_rows,
 )
+from pytorch_ps_mpi_tpu.telemetry.freshness import (
+    FreshnessTracker,
+    freshness_flow_events,
+    load_fresh_rows,
+)
 
 __all__ = [
     "SIDECAR_PREFIXES",
@@ -244,4 +256,7 @@ __all__ = [
     "deregister_endpoint",
     "parse_prometheus_text",
     "register_endpoint",
+    "FreshnessTracker",
+    "freshness_flow_events",
+    "load_fresh_rows",
 ]

@@ -84,6 +84,10 @@ _MAX_KEYS: Dict[str, str] = {
     # the WORST replica's staleness: a distribution tree is only as
     # fresh as its laggiest hop, so the rollup takes the fleet max
     "replica_lag_versions": "ps_replica_lag_versions",
+    # worst-edge-age: the wall age of the stalest served version across
+    # the tree (the freshness plane's fleet rollup — what "how stale is
+    # the model a reader at the edge sees" actually maxes out at)
+    "serving_age_ms": "ps_serving_age_ms",
 }
 
 #: per-member gauges the skew detector compares across shards

@@ -411,6 +411,19 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "topo_actions",
     "replicas_live",
     "group_replans",
+    # read-path freshness plane (telemetry.freshness / serving.core):
+    # all 0.0 until a publish stamps an FRS1 birth record.
+    # read_fresh_p50_ms / read_fresh_p95_ms are publish→visible-here
+    # latency quantiles over the last window of stamped versions (root
+    # clock, skew-corrected per hop); serving_age_ms is the wall age of
+    # the OLDEST tenant's currently-served version (the age-of-
+    # information gauge — grows between publishes, snaps down on each);
+    # fresh_hop_count is the deepest hop chain a served trailer carries
+    # (0.0 at the root, N at an N-hop edge replica)
+    "read_fresh_p50_ms",
+    "read_fresh_p95_ms",
+    "serving_age_ms",
+    "fresh_hop_count",
 )
 
 #: The canonical-key subset the ``/health`` fleet rollup republishes
@@ -555,6 +568,10 @@ def ps_server_metrics(server) -> Dict[str, float]:
             cl.replicas_live if cl is not None else 0.0),
         "group_replans": float(
             cl.group_replans if cl is not None else 0.0),
+        "read_fresh_p50_ms": rm.get("read_fresh_p50_ms", 0.0),
+        "read_fresh_p95_ms": rm.get("read_fresh_p95_ms", 0.0),
+        "serving_age_ms": rm.get("serving_age_ms", 0.0),
+        "fresh_hop_count": rm.get("fresh_hop_count", 0.0),
     }
 
 
@@ -734,6 +751,10 @@ class PSServerTelemetry:
     #: the continuous sampling profiler, set (and started) by
     #: :meth:`arm_observability` — see :mod:`.profiler`
     profiler: Optional[Any] = None
+    #: the read-path freshness tracker (publish→edge propagation rows +
+    #: the age-of-information plane), set by :meth:`arm_observability`
+    #: — see :mod:`.freshness`
+    freshness_tracker: Optional[Any] = None
 
     @property
     def frames_rejected(self) -> Dict[int, int]:
@@ -891,6 +912,14 @@ class PSServerTelemetry:
             # attaches itself to self.slo_watchdog + scrape registry
             SLOWatchdog(self, cfg, history=self.timeseries_db,
                         name=name, dir=out_dir)
+        if cfg.get("freshness") or cfg.get("freshness_kw"):
+            from pytorch_ps_mpi_tpu.telemetry.freshness import (
+                FreshnessTracker,
+            )
+
+            # attaches itself to self.freshness_tracker + scrape
+            # registry; freshness_kw overrides come through the cfg
+            FreshnessTracker(self, cfg, name=name, dir=out_dir)
         if cfg.get("profile") or cfg.get("profile_dir"):
             from pytorch_ps_mpi_tpu.telemetry.profiler import (
                 SamplingProfiler,
@@ -958,6 +987,10 @@ class PSServerTelemetry:
         if wd is not None:
             wd.close()
             out["slo"] = wd.snapshot()
+        ft = self.freshness_tracker
+        if ft is not None:
+            ft.close()
+            out["freshness"] = ft.snapshot()
         return out
 
     def close_observability(self) -> Dict[str, Any]:

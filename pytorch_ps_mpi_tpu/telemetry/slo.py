@@ -68,6 +68,11 @@ DEFAULT_TARGETS: Dict[str, float] = {
     "frames_rejected_per_s": 0.2,  # wire corruption / config drift
     "decodes_per_publish": 16.0,  # decode storm (agg regression)
     "codec_rel_error": 1.5,       # probe fidelity (unbiased codecs ~1)
+    # age-of-information at the serving edge: generous because the age
+    # grows between publishes by construction (a finished training run
+    # serves a correctly-aging snapshot — that is not an incident);
+    # smokes/tests that want a tight edge-staleness gate override this
+    "serving_age_ms": 60000.0,
 }
 
 #: map a measured artifact field -> the SLO target key it calibrates
@@ -169,6 +174,10 @@ def default_rules(targets: Dict[str, float]) -> List[Dict[str, Any]]:
         {"name": "codec_rel_error", "key": "codec_rel_error",
          "mode": "value", "target": t["codec_rel_error"],
          "help": "online codec-fidelity probe rel-error"},
+        {"name": "serving_age", "key": "serving_age_ms",
+         "mode": "value", "target": t["serving_age_ms"],
+         "help": "age-of-information of the served version (freshness "
+                 "plane; worst tenant)"},
     ]
 
 

@@ -193,18 +193,31 @@ def merged_trace_events(
     device_t0_wall: Optional[float] = None,
     lineage_rows: Optional[Iterable[Dict[str, Any]]] = None,
     clock_offsets: Optional[Dict[Any, float]] = None,
+    freshness_rows: Optional[Iterable[Dict[str, Any]]] = None,
 ) -> List[Dict[str, Any]]:
     """FlightRecorder records (+ optional jax trace dir) → Chrome
     ``traceEvents`` list, all timestamps relative to the earliest host
     record. ``clock_offsets`` (per-worker, from lineage) are applied to
     worker records first; ``lineage_rows`` add cross-process flow
-    events linking push spans to consume spans."""
+    events linking push spans to consume spans; ``freshness_rows``
+    (delivery rows from ``freshness-*.jsonl``) add read-path flow
+    arrows from the root publish through each follower hop to the edge
+    reader, joined to write-path lineage when both are given."""
     host_events = apply_clock_offsets(host_events, clock_offsets)
     walls = [e["wall"] for e in host_events if "wall" in e]
     t0_wall = min(walls) if walls else (device_t0_wall or 0.0)
     out, span_index = _host_events(host_events, t0_wall)
     if lineage_rows is not None:
+        lineage_rows = list(lineage_rows)
         out.extend(_flow_events(span_index, lineage_rows))
+    if freshness_rows is not None:
+        from pytorch_ps_mpi_tpu.telemetry.freshness import (
+            freshness_flow_events,
+        )
+
+        out.extend(freshness_flow_events(
+            freshness_rows, lineage_rows, t0_wall=t0_wall
+        ))
     if device_trace_dir is not None:
         out.extend(_device_events(
             device_trace_dir, t0_wall, device_t0_wall, t0_wall
@@ -219,21 +232,27 @@ def export_chrome_trace(
     device_t0_wall: Optional[float] = None,
     lineage_rows: Optional[Iterable[Dict[str, Any]]] = None,
     clock_offsets: Optional[Dict[Any, float]] = None,
+    freshness_rows: Optional[Iterable[Dict[str, Any]]] = None,
 ) -> Tuple[str, Dict[str, int]]:
     """Write the merged timeline to ``path``; returns ``(path, {"host":
-    n, "device": m, "flow": k})`` so callers can assert every side
-    actually landed in the artifact (``flow`` counts the lineage flow
-    START events — each is half of one cross-process arrow)."""
+    n, "device": m, "flow": k, "fresh_flow": j})`` so callers can
+    assert every side actually landed in the artifact (``flow`` counts
+    the lineage flow START events — each is half of one cross-process
+    arrow; ``fresh_flow`` the read-path publish→edge flow starts)."""
     events = merged_trace_events(
         host_events, device_trace_dir, device_t0_wall,
         lineage_rows=lineage_rows, clock_offsets=clock_offsets,
+        freshness_rows=freshness_rows,
     )
     counts = {
         "host": sum(1 for e in events
                     if e.get("cat") == "host" and e["ph"] != "M"),
         "device": sum(1 for e in events
                       if e.get("cat") == "device" and e["ph"] != "M"),
-        "flow": sum(1 for e in events if e.get("ph") == "s"),
+        "flow": sum(1 for e in events if e.get("ph") == "s"
+                    and e.get("cat") != "freshness"),
+        "fresh_flow": sum(1 for e in events if e.get("ph") == "s"
+                          and e.get("cat") == "freshness"),
     }
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,326 @@
+"""Read-path freshness plane: FRS1 trailer codec, skew-corrected clock
+algebra, age-of-information monotonicity, two-hop propagation end to
+end, tracker rows/flow events, and SLO replay identity over the
+persisted freshness history.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.serving import ServingCore, ServingReader
+from pytorch_ps_mpi_tpu.telemetry.freshness import (
+    FRESH_HOP_CAP,
+    FRESH_MAX_BYTES,
+    FreshnessTracker,
+    age_ms,
+    append_hop,
+    birth_wall_local,
+    freshness_flow_events,
+    hop_latencies_ms,
+    load_fresh_rows,
+    pack_birth,
+    total_skew_s,
+    unpack_trailer,
+    visible_latency_ms,
+)
+
+TMPL = {"a": np.zeros((700, 4), np.float32),
+        "b": np.zeros((13,), np.float32)}
+N = 700 * 4 + 13
+KW = {"ring": 4, "admission_depth": 64, "retry_after_s": 0.005,
+      "delta_bucket_mb": 0.002}
+
+
+def flat_of(seed) -> np.ndarray:
+    return np.random.RandomState(seed).randn(N).astype(np.float32)
+
+
+def make_core(**cfg_extra):
+    cfg = {"serving": True, "serving_kw": dict(KW)}
+    cfg.update(cfg_extra)
+    return ServingCore(None, cfg, template=TMPL)
+
+
+# -- trailer codec -----------------------------------------------------------
+
+def test_trailer_roundtrip_and_hop_cap_saturates():
+    blob = pack_birth(42, 1000.5, root_gen=3)
+    assert len(blob) == 32
+    doc = unpack_trailer(blob)
+    assert (doc["version"], doc["publish_wall"], doc["root_gen"]) \
+        == (42, 1000.5, 3)
+    assert doc["hop_count"] == 0 and doc["hops"] == []
+    # appends past the cap saturate: the trailer comes back UNCHANGED
+    for i in range(FRESH_HOP_CAP + 4):
+        blob = append_hop(blob, i + 1, 1000.5 + 0.001 * (i + 1),
+                          skew_ms=0.25 * (i + 1))
+    assert len(blob) == FRESH_MAX_BYTES <= 255
+    doc = unpack_trailer(blob)
+    assert doc["hop_count"] == FRESH_HOP_CAP
+    assert [h["hop_index"] for h in doc["hops"]] \
+        == list(range(1, FRESH_HOP_CAP + 1))
+    # hop payload survives the roundtrip (f32 skew: compare loosely)
+    assert doc["hops"][0]["arrival_wall"] == pytest.approx(1000.501)
+    assert doc["hops"][0]["skew_ms"] == pytest.approx(0.25, abs=1e-4)
+
+
+def test_truncated_and_corrupt_trailers_rejected():
+    blob = append_hop(pack_birth(7, 2000.0), 1, 2000.001)
+    for bad in (blob[:-1],            # truncated hop record
+                blob[:10],            # short header
+                blob + b"\x00",       # trailing bytes
+                b"XXXX" + blob[4:]):  # bad magic
+        with pytest.raises(ValueError):
+            unpack_trailer(bad)
+    # b"" is also malformed — the no-trailer case is length 0 on the
+    # wire and callers never call unpack on it
+    with pytest.raises(ValueError):
+        unpack_trailer(b"")
+
+
+# -- clock algebra -----------------------------------------------------------
+
+def test_hop_latencies_skew_corrected_including_negative_offset():
+    pw = 5000.0
+    blob = pack_birth(1, pw)
+    # hop 1: clock runs 2ms AHEAD of root, arrival stamped 5000.005
+    #   local → root clock: 5000.005 - 0.002 = 5000.003 → 3ms of wire
+    blob = append_hop(blob, 1, pw + 0.005, skew_ms=2.0)
+    # hop 2: clock 3ms BEHIND hop 1 (negative offset), stamped at
+    #   5000.004 local = 5000.004 - (0.002 - 0.003) = 5000.005 root
+    #   → 2ms after hop 1's corrected arrival
+    blob = append_hop(blob, 2, pw + 0.004, skew_ms=-3.0)
+    doc = unpack_trailer(blob)
+    lats = hop_latencies_ms(doc)
+    assert lats[0] == pytest.approx(3.0, abs=1e-3)
+    assert lats[1] == pytest.approx(2.0, abs=1e-3)
+    # cumulative skew re-expresses the birth wall in the LAST hop's
+    # clock: -1ms total
+    assert total_skew_s(doc) == pytest.approx(-0.001, abs=1e-6)
+    assert birth_wall_local(doc) == pytest.approx(pw - 0.001, abs=1e-6)
+    # visible latency = last corrected arrival - birth, in root clock
+    assert visible_latency_ms(doc) == pytest.approx(5.0, abs=1e-3)
+    # a skew mis-estimate can't yield a negative age
+    assert age_ms(doc, now=pw - 1.0) == 0.0
+
+
+def test_age_monotone_between_publishes_and_resets_on_publish():
+    core = make_core()
+    try:
+        core.publish(flat=flat_of(0))
+        ages = core.fresh_ages_ms()
+        assert set(ages) == {core.default_tenant}
+        a1 = core.serving_age_ms()
+        time.sleep(0.03)
+        a2 = core.serving_age_ms()
+        time.sleep(0.03)
+        a3 = core.serving_age_ms()
+        assert a1 < a2 < a3  # age grows monotonically between publishes
+        core.publish(flat=flat_of(1))
+        assert core.serving_age_ms() < a3  # new birth record: age resets
+    finally:
+        core.close()
+
+
+# -- two-hop propagation end to end -----------------------------------------
+
+def test_two_hop_chain_edge_age_matches_publish_wall_delta():
+    """root -> replica A -> replica B -> reader: the trailer gains one
+    hop per relay and the edge reader's age equals the wall delta since
+    the root publish within the clock-jitter bound (one host, so the
+    only error is the lower-envelope fit absorbing poll delay)."""
+    from pytorch_ps_mpi_tpu.serving import FollowerLoop
+
+    root = make_core(read_port=0)
+    core_a = make_core(read_port=0)
+    core_b = make_core(read_port=0)
+    fa = FollowerLoop(core_a, "127.0.0.1", root.read_port, template=TMPL,
+                      poll_s=0.01, serving_kw=KW)
+    fb = FollowerLoop(core_b, "127.0.0.1", core_a.read_port,
+                      template=TMPL, poll_s=0.01, serving_kw=KW)
+    reader = ServingReader("127.0.0.1", core_b.read_port, TMPL,
+                           serving_kw=KW)
+    try:
+        t_pub = time.time()
+        root.publish(flat=flat_of(0))
+        assert fa.step()["outcome"] == "republished"
+        row_b = fb.step()
+        assert row_b["outcome"] == "republished"
+        # the follower's reader_round row carries the pull-time age
+        assert row_b["age_ms"] >= 0.0
+        _, ver = reader.read_params()
+        assert ver == 1
+        doc = reader.fresh
+        assert doc is not None and doc["version"] == 1
+        assert doc["hop_count"] == 2  # one record per relay
+        assert [h["hop_index"] for h in doc["hops"]] == [1, 2]
+        true_age_ms = (time.time() - t_pub) * 1e3
+        edge_age = reader.fresh_age_ms()
+        # same-host clocks: the skew estimates only absorb poll delay,
+        # so the reported age tracks the true wall delta closely
+        assert abs(edge_age - true_age_ms) < 250.0
+        drow = reader.fresh_delivery_row(reader="edge")
+        assert drow["version"] == 1 and drow["hop_count"] == 2
+        assert drow["age_ms"] == pytest.approx(edge_age, abs=50.0)
+        # edge core's age gauge is live too (native or python tier)
+        assert core_b.serving_age_ms() > 0.0
+        # canonical keys on the read-metrics schema surface
+        m = core_b.read_metrics()
+        for k in ("read_fresh_p50_ms", "read_fresh_p95_ms",
+                  "serving_age_ms", "fresh_hop_count"):
+            assert k in m
+        assert m["fresh_hop_count"] == 2.0
+    finally:
+        reader.close()
+        fb.close()
+        fa.close()
+        core_b.close()
+        core_a.close()
+        root.close()
+        time.sleep(0.05)
+
+
+def test_relay_without_trailer_ships_no_trailer_and_no_reject():
+    """A follower whose upstream sent no trailer republishes WITHOUT
+    one (no spurious rejects, no fabricated birth records)."""
+    from pytorch_ps_mpi_tpu.serving import FollowerLoop
+
+    root = make_core(read_port=0)
+    core_a = make_core(read_port=0)
+    fa = FollowerLoop(core_a, "127.0.0.1", root.read_port, template=TMPL,
+                      poll_s=0.01, serving_kw=KW)
+    reader = ServingReader("127.0.0.1", core_a.read_port, TMPL,
+                           serving_kw=KW)
+    try:
+        # publish WITHOUT a freshness stamp: fresh=b"" suppresses the
+        # root birth record (the relay-no-trailer path)
+        root.publish(flat=flat_of(0), fresh=b"")
+        assert fa.step()["outcome"] == "republished"
+        _, ver = reader.read_params()
+        assert ver == 1
+        assert reader.fresh is None and reader.fresh_rejects == 0
+    finally:
+        reader.close()
+        fa.close()
+        core_a.close()
+        root.close()
+        time.sleep(0.05)
+
+
+# -- tracker rows + flow events ----------------------------------------------
+
+def test_tracker_rows_persist_and_flow_events_join_lineage(tmp_path):
+    trk = FreshnessTracker(name="t", dir=str(tmp_path))
+    pw = 3000.0
+    blob = append_hop(append_hop(pack_birth(5, pw), 1, pw + 0.004,
+                                 skew_ms=1.0), 2, pw + 0.007, skew_ms=0.5)
+    doc = unpack_trailer(blob)
+    trk.note_publish("default", doc, now=pw + 0.008)
+    trk.note_delivery({"reader": "edge", "tenant": "default",
+                       "version": 5, "age_ms": 9.5, "hop_count": 2,
+                       "t": pw + 0.009})
+    trk.note_reject()
+    snap = trk.snapshot()
+    assert (snap["publishes"], snap["deliveries"], snap["dropped"]) \
+        == (1, 1, 1)
+    assert snap["visible_p50_ms"] > 0.0
+    assert set(snap["hops"]) == {"1", "2"}
+    trk.close()
+    rows = load_fresh_rows(str(tmp_path / "freshness-t.jsonl"))
+    assert [r["kind"] for r in rows] == ["publish", "delivery"]
+    assert rows[0]["hops"] == doc["hops"]
+    # flow events: one s (publish) + one t per hop + one f (delivery),
+    # all sharing the fresh:<tenant>/<version> flow id; lineage publish
+    # rows donate their push trace_ids to the start event
+    lineage = [{"kind": "publish", "version": 5,
+                "pushes": [{"trace_id": "w0-s1-q1"}]}]
+    ev = freshness_flow_events(rows, lineage, t0_wall=pw)
+    assert [e["ph"] for e in ev] == ["s", "t", "t", "f"]
+    assert len({e["id"] for e in ev}) == 1
+    assert ev[0]["args"]["trace_ids"] == ["w0-s1-q1"]
+    # t0_wall-relative microsecond stamps (not absolute epoch)
+    assert all(0.0 <= e["ts"] < 1e6 for e in ev)
+
+
+def test_tracker_window_bounds_hop_history():
+    trk = FreshnessTracker(name="w", window=8)
+    pw = 100.0
+    for i in range(50):
+        doc = unpack_trailer(append_hop(pack_birth(i + 1, pw + i),
+                                        1, pw + i + 0.001))
+        trk.note_publish("default", doc, now=pw + i + 0.002)
+    q = trk.hop_quantiles_ms()
+    assert q[1]["n"] == 8.0  # bounded by the window, not the run length
+
+
+# -- SLO replay identity over the persisted freshness history ----------------
+
+def test_slo_edge_age_verdicts_replay_byte_identically(tmp_path):
+    """serving_age_ms rides the TSDB like every canonical key: a
+    sustained edge-age burn latches exactly one breach verdict live,
+    and SLOWatchdog.replay over the persisted rows re-derives the
+    byte-identical verdict sequence."""
+    from pytorch_ps_mpi_tpu.telemetry.slo import SLOWatchdog
+    from pytorch_ps_mpi_tpu.telemetry.timeseries import (
+        MetricsHistory,
+        load_timeseries_rows,
+    )
+
+    rules = [{"name": "serving_age", "key": "serving_age_ms",
+              "mode": "value", "target": 50.0}]
+    h = MetricsHistory(name="fresh", dir=str(tmp_path), flush_every=8)
+    wd = SLOWatchdog(history=h, rules=rules, name="fresh",
+                     short_window_s=5.0, long_window_s=20.0,
+                     eval_every_s=0.2, dir=str(tmp_path))
+    live = []
+    t = 1000.0
+    # healthy edge (age ~ poll cadence), then a stalled follower (age
+    # ramps unbounded), then recovery after it catches back up
+    ages = [10.0] * 150 + [400.0 + 10.0 * i for i in range(150)] \
+        + [10.0] * 200
+    for v in ages:
+        t += 0.2
+        h.sample({"serving_age_ms": v}, now=t)
+        live.extend(wd.evaluate(now=t))
+    h.close()
+    wd.close()
+    assert [x["kind"] for x in live] == ["breach", "recover"]
+    rows = load_timeseries_rows(str(tmp_path / "timeseries-fresh.jsonl"))
+    replayed = SLOWatchdog.replay(rows, rules=rules, short_window_s=5.0,
+                                  long_window_s=20.0, eval_every_s=0.2)
+    strip = lambda xs: json.dumps(
+        [{k: x[k] for k in ("kind", "rule", "key", "t", "burn_short",
+                            "burn_long", "target")} for x in xs])
+    assert strip(replayed) == strip(live)
+    # the persisted slo sidecar carries the same latched events
+    with open(tmp_path / "slo-fresh.jsonl") as f:
+        persisted = [json.loads(ln) for ln in f if ln.strip()]
+    assert strip(persisted) == strip(live)
+
+
+# -- offline report section --------------------------------------------------
+
+def test_telemetry_report_freshness_section(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.telemetry_report import summarize
+
+    trk = FreshnessTracker(name="r", dir=str(tmp_path))
+    pw = 4000.0
+    doc = unpack_trailer(append_hop(pack_birth(2, pw), 1, pw + 0.003,
+                                    skew_ms=0.2))
+    trk.note_publish("default", doc, now=pw + 0.004)
+    trk.note_delivery({"reader": "edge", "tenant": "default",
+                       "version": 2, "age_ms": 6.0, "hop_count": 1,
+                       "t": pw + 0.005})
+    trk.close()
+    s = summarize([str(tmp_path / "freshness-r.jsonl")])
+    fr = s["freshness"]
+    assert fr["publishes"] == 1 and fr["deliveries"] == 1
+    assert fr["hops"][0]["hop"] == 1
+    assert fr["readers"][0]["reader"] == "edge"
+    assert fr["readers"][0]["age_ms_p95"] == pytest.approx(6.0)

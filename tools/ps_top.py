@@ -36,8 +36,12 @@ the frame grows an ``anatomy`` pane: per-stage critical-path shares
 When the parameter-serving read tier is armed the frame grows a
 ``serving`` block: a reader rollup line (reads/s, read p50/p95, shed,
 coalesce hits, queue depth) and one row per tenant namespace (ring
-occupancy, latest version, read count) — the ``reads`` sort key orders
-the tenant rows by read count.
+occupancy, latest version, read count, and — when the freshness plane
+has stamped a birth record — the live age-of-information ``age``
+column: wall age of the version this tenant is serving, skew-corrected
+back to the root's publish clock). A ``fresh`` line above the tenant
+rows carries the publish→visible latency p50/p95 and trailer-reply
+volume. The ``reads`` sort key orders the tenant rows by read count.
 
 Keybindings (when stdin is a tty): ``q`` quit · ``p`` pause/resume ·
 ``s`` cycle the sort column (worker → verdict → interarrival → e2e →
@@ -170,15 +174,18 @@ def render_fleet(snap: Dict[str, Any],
                      key=lambda m: m.get("name", ""))
     replicas = [m for m in members if m.get("role") == "replica"]
     if replicas:
-        # follower-tree rollup: tree freshness is its laggiest hop
+        # follower-tree rollup: tree freshness is its laggiest hop —
+        # edge_age is the worst served-version wall age across the tree
         lag_max = fleet.get("replica_lag_versions_max", 0.0)
         relayed = fleet.get("follower_bytes_relayed", 0.0)
         lines.append(
             f"  replicas: {len(replicas)}  lag_max={lag_max:.0f}v  "
+            f"edge_age={fleet.get('serving_age_ms_max', 0):.0f}ms  "
             f"relayed={int(relayed)}B  "
             f"conns={int(fleet.get('native_read_conns', 0))}")
     cols = ["member", "role", "grp", "ok", "verdict", "grads", "version",
-            "lag", "stale-p95", "e2e-p95", "reads", "up", "age"]
+            "lag", "edge-age", "stale-p95", "e2e-p95", "reads", "up",
+            "age"]
     rows = []
     for m in members:
         mm = m.get("metrics") or {}
@@ -191,6 +198,8 @@ def render_fleet(snap: Dict[str, Any],
             str(int(mm.get("publish_version", 0))),
             (f"{mm.get('replica_lag_versions', 0):.0f}"
              if m.get("role") == "replica" else "-"),
+            ("-" if "serving_age_ms" not in mm
+             else f"{mm['serving_age_ms']:.0f}ms"),
             f"{mm.get('staleness_p95', 0):.1f}",
             f"{mm.get('push_e2e_p95_ms', 0):.1f}",
             str(int(mm.get("reads_total", 0))),
@@ -379,14 +388,26 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
             f"q={serving.get('queue_depth', 0)}  "
             f"conns={serving.get('connections', 0)}"
         )
+        fresh = serving.get("freshness") or {}
+        if fresh.get("fresh_replies") or fresh.get("tenants"):
+            # freshness plane: publish→edge-visible latency quantiles
+            # + trailer-reply volume (the age column below is live AoI)
+            lines.append(
+                f"fresh    "
+                f"p50/p95={fresh.get('read_fresh_p50_ms', 0):.1f}/"
+                f"{fresh.get('read_fresh_p95_ms', 0):.1f}ms  "
+                f"replies={int(fresh.get('fresh_replies', 0))}")
+        fresh_t = fresh.get("tenants") or {}
         tenants = list((serving.get("tenants") or {}).items())
         if sort == "reads":
             tenants.sort(key=lambda kv: -int(kv[1].get("reads", 0)))
         for tname, t in tenants:
+            age = (fresh_t.get(tname) or {}).get("age_ms")
             lines.append(
                 f"  tenant {tname}: reads={t.get('reads', 0)}  "
                 f"ring={t.get('occupancy', 0)}/{t.get('ring', 0)}  "
                 f"latest=v{t.get('latest', 0)}  "
+                f"age={'-' if age is None else f'{age:.0f}ms'}  "
                 f"refs_out={t.get('refs_out', 0)}"
             )
     control = health.get("control")

@@ -163,6 +163,11 @@ CFG_KEYS = {
                   "arm the SLO burn-rate watchdog (implies timeseries)"),
     "slo_kw": CfgKey("dict", "cli",
                      "SLO targets/knob overrides ({'targets': {...}})"),
+    "freshness": CfgKey("bool", "cli",
+                        "arm the read-path freshness tracker "
+                        "(publish→edge propagation rows + age plane)"),
+    "freshness_kw": CfgKey("dict", "caller",
+                           "FreshnessTracker knobs (window, ...)"),
     "profile": CfgKey("bool", "cli",
                       "arm the continuous sampling profiler"),
     "profile_dir": CfgKey("str", "caller",

@@ -437,27 +437,32 @@ class AbiDriftRule(Rule):
         cpp = ctx.source(TCPPS_CPP)
         if tree is None or cpp is None:
             return findings
-        c_fields = parse_c_struct(cpp, "ReadStats")
-        py_fields = _ctypes_fields(tree, "_ReadStats")
-        if c_fields is None or py_fields is None:
-            findings.append(Finding(
-                self.name, NATIVE_READ_PY, 1,
-                "ReadStats (C) or _ReadStats (ctypes) struct not found "
-                "— the read-plane stats mirror is gone"))
-            return findings
-        if [(n, t) for n, t in c_fields] != [(n, t) for n, t in py_fields]:
-            findings.append(Finding(
-                self.name, NATIVE_READ_PY, 1,
-                f"ReadStats layout drifted: C has {c_fields}, ctypes "
-                f"mirror has {py_fields}"))
-        size = sum(_SIZES.get(t, 0) for _n, t in c_fields)
-        m = re.search(r"sizeof\(ReadStats\)\s*==\s*(\d+)", cpp)
-        asserted = int(m.group(1)) if m else None
-        if asserted is not None and size != asserted:
-            findings.append(Finding(
-                self.name, NATIVE_READ_PY, 1,
-                f"ReadStats packs to {size} bytes but {TCPPS_CPP} "
-                f"asserts {asserted}"))
+        # both read-plane mirrors: the counter block and the per-tenant
+        # freshness export ride the same static_assert/ctypes discipline
+        for c_name, py_name in (("ReadStats", "_ReadStats"),
+                                ("ReadFreshStats", "_ReadFreshStats")):
+            c_fields = parse_c_struct(cpp, c_name)
+            py_fields = _ctypes_fields(tree, py_name)
+            if c_fields is None or py_fields is None:
+                findings.append(Finding(
+                    self.name, NATIVE_READ_PY, 1,
+                    f"{c_name} (C) or {py_name} (ctypes) struct not "
+                    "found — the read-plane stats mirror is gone"))
+                continue
+            if [(n, t) for n, t in c_fields] != \
+                    [(n, t) for n, t in py_fields]:
+                findings.append(Finding(
+                    self.name, NATIVE_READ_PY, 1,
+                    f"{c_name} layout drifted: C has {c_fields}, ctypes "
+                    f"mirror has {py_fields}"))
+            size = sum(_SIZES.get(t, 0) for _n, t in c_fields)
+            m = re.search(r"sizeof\(%s\)\s*==\s*(\d+)" % c_name, cpp)
+            asserted = int(m.group(1)) if m else None
+            if asserted is not None and size != asserted:
+                findings.append(Finding(
+                    self.name, NATIVE_READ_PY, 1,
+                    f"{c_name} packs to {size} bytes but {TCPPS_CPP} "
+                    f"asserts {asserted}"))
         net_tree = ctx.tree(NET_PY)
         if net_tree is not None:
             py_magic = _module_const(net_tree, "MAGIC")

@@ -87,6 +87,10 @@ INSTRUMENT_MAP: Dict[str, Optional[str]] = {
     "topo_actions": "ps_topo_actions_total",
     "replicas_live": "ps_replicas_live",
     "group_replans": "ps_group_replans_total",
+    "read_fresh_p50_ms": "ps_read_fresh_p50_ms",
+    "read_fresh_p95_ms": "ps_read_fresh_p95_ms",
+    "serving_age_ms": "ps_serving_age_ms",
+    "fresh_hop_count": "ps_fresh_hop_count",
 }
 
 

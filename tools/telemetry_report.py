@@ -38,9 +38,13 @@ routed AWAY from the recorder-span merge: ``timeseries-*.jsonl``
 first/last/min/max/p95 over the retained samples),
 ``profile-*.txt`` (``telemetry.profiler`` collapsed stacks) → the
 **profile** section (profiles from every process MERGED, top-N
-self-time table + native fold/pump cycle counters), and
-``slo-*.jsonl`` (``telemetry.slo``) → the **slo** section (verdict
-counts per rule, breach/recover listing).
+self-time table + native fold/pump cycle counters), ``slo-*.jsonl``
+(``telemetry.slo``) → the **slo** section (verdict counts per rule,
+breach/recover listing), and ``freshness-*.jsonl``
+(``telemetry.freshness``) → the **freshness** section: read-path
+propagation rebuilt offline from the persisted FRS1 rows — per-hop
+skew-corrected latency quantiles, publish→visible latency, and
+per-reader delivery-age tables.
 """
 
 from __future__ import annotations
@@ -362,6 +366,60 @@ def _summarize_slo(rows: List[Dict[str, Any]]
     }
 
 
+def _summarize_freshness(rows: List[Dict[str, Any]]
+                         ) -> Optional[Dict[str, Any]]:
+    """The freshness section: read-path propagation rebuilt offline
+    from ``freshness-*.jsonl`` publish/delivery rows — per-hop
+    skew-corrected latency quantiles, publish→visible latency, and
+    per-reader delivery-age tables.  Same math as the live
+    :class:`~pytorch_ps_mpi_tpu.telemetry.freshness.FreshnessTracker`
+    (the hop chains replay through ``hop_latencies_ms``)."""
+    if not rows:
+        return None
+    from pytorch_ps_mpi_tpu.telemetry.freshness import hop_latencies_ms
+
+    publishes = [r for r in rows if r.get("kind") == "publish"]
+    deliveries = [r for r in rows if r.get("kind") == "delivery"]
+    per_hop: Dict[int, List[float]] = {}
+    visible: List[float] = []
+    for r in publishes:
+        try:
+            lats = hop_latencies_ms(r)
+        except (KeyError, TypeError):
+            continue
+        for h, lat in zip(r.get("hops") or [], lats):
+            per_hop.setdefault(int(h["hop_index"]), []).append(lat)
+        if r.get("visible_ms") is not None:
+            visible.append(float(r["visible_ms"]))
+    hops = []
+    for idx, lats in sorted(per_hop.items()):
+        s = sorted(lats)
+        hops.append({"hop": idx, "n": len(s),
+                     "lat_ms_p50": _percentile(s, 0.50),
+                     "lat_ms_p95": _percentile(s, 0.95)})
+    per_reader: Dict[Any, List[float]] = {}
+    for r in deliveries:
+        if r.get("age_ms") is not None:
+            per_reader.setdefault(r.get("reader"), []).append(
+                float(r["age_ms"]))
+    readers = []
+    for who, ages in sorted(per_reader.items(), key=lambda kv: str(kv[0])):
+        s = sorted(ages)
+        readers.append({"reader": who, "deliveries": len(s),
+                        "age_ms_p50": _percentile(s, 0.50),
+                        "age_ms_p95": _percentile(s, 0.95),
+                        "age_ms_max": s[-1]})
+    vis = sorted(visible)
+    return {
+        "publishes": len(publishes),
+        "deliveries": len(deliveries),
+        "visible_ms_p50": _percentile(vis, 0.50) if vis else None,
+        "visible_ms_p95": _percentile(vis, 0.95) if vis else None,
+        "hops": hops,
+        "readers": readers,
+    }
+
+
 def _summarize_actions(rows: List[Dict[str, Any]],
                        flap_window_s: float = 10.0
                        ) -> Optional[Dict[str, Any]]:
@@ -429,6 +487,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     ts_rows: List[Dict[str, Any]] = []
     slo_rows: List[Dict[str, Any]] = []
     action_rows: List[Dict[str, Any]] = []
+    fresh_rows: List[Dict[str, Any]] = []
     profile_paths: List[str] = []
     for path in files:
         base = os.path.basename(path)
@@ -472,6 +531,15 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
                         action_rows.append(json.loads(line))
                     except ValueError:
                         continue
+            continue
+        if base.startswith("freshness-") and path.endswith(".jsonl"):
+            # read-path FRS1 propagation rows (telemetry.freshness) —
+            # routed to the freshness section, never the span merge
+            from pytorch_ps_mpi_tpu.telemetry.freshness import (
+                load_fresh_rows,
+            )
+
+            fresh_rows.extend(load_fresh_rows(path))
             continue
         if base.startswith("postmortem-") and path.endswith(".json"):
             # a divergence postmortem dump (telemetry.numerics) — one
@@ -583,6 +651,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
         "profile": _summarize_profiles(profile_paths),
         "slo": _summarize_slo(slo_rows),
         "actions": _summarize_actions(action_rows),
+        "freshness": _summarize_freshness(fresh_rows),
         "dropped_total": sum(m.get("dropped") or 0 for m in meta),
     }
 
@@ -787,6 +856,25 @@ def format_table(summary: Dict[str, Any]) -> str:
                 f"  {e.get('kind')} {e.get('rule')} "
                 f"burn_short={e.get('burn_short')} "
                 f"burn_long={e.get('burn_long')} t={e.get('t')}")
+    fresh = summary.get("freshness")
+    if fresh:
+        lines.append("")
+        v50, v95 = fresh.get("visible_ms_p50"), fresh.get("visible_ms_p95")
+        vis_txt = ("" if v50 is None else
+                   f"  visible p50/p95={v50:.1f}/{v95:.1f}ms")
+        lines.append(
+            f"freshness ({fresh['publishes']} publishes, "
+            f"{fresh['deliveries']} deliveries):{vis_txt}")
+        for h in fresh.get("hops", []):
+            lines.append(
+                f"  hop {h['hop']}: n={h['n']}  "
+                f"lat p50/p95={h['lat_ms_p50']:.2f}/"
+                f"{h['lat_ms_p95']:.2f}ms")
+        for r in fresh.get("readers", []):
+            lines.append(
+                f"  reader {r['reader']}: {r['deliveries']} deliveries  "
+                f"age p50/p95/max={r['age_ms_p50']:.1f}/"
+                f"{r['age_ms_p95']:.1f}/{r['age_ms_max']:.1f}ms")
     act = summary.get("actions")
     if act:
         lines.append("")
