@@ -21,6 +21,7 @@ test:
 	$(MAKE) topo-smoke
 	$(MAKE) whatif-smoke
 	$(MAKE) fresh-smoke
+	$(MAKE) hop-smoke
 
 # Flat-bucket aggregation gate: bit-exact parity of bucketed vs per-leaf
 # steps (identity/cast codecs, both topologies) plus the CPU-backend
@@ -262,6 +263,16 @@ whatif-smoke:
 	JAX_PLATFORMS=cpu python tools/whatif_smoke.py
 	python tools/telemetry_smoke.py
 
+# Hop-anatomy gate (in the default `make test` path): an A/B tree run
+# with a known slow_leader fold widening asserting the hop timeline
+# measures it within ±30%, serial attribution reproduces the measured
+# round wall, the streaming-headroom projection replays byte-
+# identically from persisted hop-*.jsonl rows, and the root-side hop
+# bookkeeping stays within the ≤5% telemetry budget. Appends a
+# bench_gate trajectory row to benchmarks/results/hop_smoke.jsonl.
+hop-smoke:
+	JAX_PLATFORMS=cpu python tools/hop_smoke.py
+
 # Static-analysis gate (in the default `make test` path): analyze_smoke
 # runs `python -m tools.psanalyze` on the tree (must be SILENT — the
 # six rules: thread-affinity, cfg-schema, metrics-surface,
@@ -338,4 +349,4 @@ bench-protocol:
 	python benchmarks/staleness_bench.py
 	python benchmarks/convergence_bench.py
 
-.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-native-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke topo-smoke whatif-smoke fresh-smoke
+.PHONY: test bench bench-protocol native tpu-watch telemetry-smoke bucket-smoke chaos-smoke diag-smoke numerics-smoke trace-smoke read-smoke read-native-smoke read-bench agg-smoke agg-bench native-smoke obs-smoke tree-smoke tree-bench analyze native-asan native-ubsan native-tsan control-smoke topo-smoke whatif-smoke fresh-smoke hop-smoke

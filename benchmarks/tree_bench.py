@@ -238,8 +238,32 @@ def run_star(n_workers: int, pushes: int, rtt_ms: float, timeout: float,
     }
 
 
+def _hop_summary(leader_stats: list) -> dict:
+    """Leader-pipeline occupancy headline from the leaders' scraped
+    ``ps_hop_*`` gauges (RESULTS.md's occupancy/headroom table): the
+    hottest leader's busy fraction, the biggest streaming-headroom
+    ratio, total hop rounds and ring drops across the tree."""
+    busy = [s.get("ps_hop_busy_frac") for s in leader_stats]
+    busy = [b for b in busy if b is not None]
+    if not busy:
+        return {}
+    ratio = [s.get("ps_hop_stream_headroom_ratio", 1.0)
+             for s in leader_stats]
+    return {
+        "busy_frac_max": max(busy),
+        "headroom_ratio_max": max(ratio),
+        "serial_ms": [s.get("ps_hop_serial_ms") for s in leader_stats],
+        "ingest_wait_ms": [s.get("ps_hop_ingest_wait_ms")
+                           for s in leader_stats],
+        "rounds": sum(s.get("ps_hop_rounds_total", 0.0)
+                      for s in leader_stats),
+        "ring_drops": sum(s.get("ps_hop_ring_drops_total", 0.0)
+                          for s in leader_stats),
+    }
+
+
 def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float,
-             anatomy_dir=None):
+             anatomy_dir=None, hop=False):
     """Tree leg: real leaders (one per pod) fold the pods' pushes and
     ship ONE compressed frame per round to the root over the emulated
     DCN; pod pushers ride the cheap intra-pod link (no RTT)."""
@@ -267,6 +291,12 @@ def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float,
         # root-side lineage + round anatomy: composed trailers expand
         # the leader hops, the leaders' hop logs land beside the root's
         cfg.update(lineage=True, lineage_dir=anatomy_dir)
+    if hop:
+        # leader-pipeline occupancy tracing: each leader's HopAnatomy
+        # reconstructs its round into sub-stages and publishes the
+        # ps_hop_* gauges this bench scrapes (min_rounds=1: the quick
+        # leg folds few rounds and the gauges must still arm)
+        cfg.update(hop_anatomy=True, hop_anatomy_kw={"min_rounds": 1})
     groups = group_plan(n_workers, group_size)
     assert len(groups) == PODS
     _, params0, _, _ = make_problem(cfg)
@@ -335,6 +365,7 @@ def run_tree(n_workers: int, pushes: int, rtt_ms: float, timeout: float,
         "leader_upstream_pushes": [
             s.get("ps_tree_upstream_pushes_total") for s in leader_stats],
         "anatomy": _anatomy_summary(m),
+        "hop": _hop_summary(leader_stats) if hop else {},
         "wall_s": wall,
     }
 
@@ -350,6 +381,11 @@ def main(argv=None) -> int:
                     help="arm root-side lineage + round anatomy per "
                     "leg and record per-stage critical-path shares "
                     "(RESULTS.md's star-vs-tree anatomy table)")
+    ap.add_argument("--hop-anatomy", action="store_true",
+                    help="arm per-leader hop occupancy tracing on the "
+                    "tree legs and commit busy-fraction / streaming-"
+                    "headroom headline numbers to the trajectory "
+                    "(RESULTS.md's occupancy table)")
     ap.add_argument("--out", default=RESULTS)
     args = ap.parse_args(argv)
     assert args.rtt_ms > 0, "tree_bench requires a nonzero emulated RTT"
@@ -372,7 +408,8 @@ def main(argv=None) -> int:
                       for k, v in results["star"][n].items()}, flush=True)
         print(f"== tree  {n:3d} workers ({PODS} pods)", flush=True)
         results["tree"][n] = run_tree(n, pushes, args.rtt_ms, timeout,
-                                      anatomy_dir=_adir(f"tree{n}"))
+                                      anatomy_dir=_adir(f"tree{n}"),
+                                      hop=args.hop_anatomy)
         print("   ", {k: (round(v, 3) if isinstance(v, float) else v)
                       for k, v in results["tree"][n].items()}, flush=True)
 
@@ -421,6 +458,29 @@ def main(argv=None) -> int:
         },
         "legs": results,
     }
+    if args.hop_anatomy:
+        hop8 = results["tree"][8].get("hop") or {}
+        hop64 = results["tree"][64].get("hop") or {}
+        assert hop64.get("rounds", 0) > 0, (
+            "--hop-anatomy armed but no leader published hop rounds — "
+            f"scrapes: {results['tree'][64].get('hop')}")
+        print(f"hop occupancy 64w: busy_max="
+              f"{hop64.get('busy_frac_max', 0) * 100:.0f}%  "
+              f"headroom_max={hop64.get('headroom_ratio_max', 1.0):.2f}x"
+              f"  rounds={hop64.get('rounds', 0):.0f}  "
+              f"drops={hop64.get('ring_drops', 0):.0f}")
+        row["metrics"].update({
+            "tree_bench.hop_busy_frac_8w": round(
+                hop8.get("busy_frac_max", 0.0), 4),
+            "tree_bench.hop_busy_frac_64w": round(
+                hop64.get("busy_frac_max", 0.0), 4),
+            "tree_bench.hop_headroom_ratio_8w": round(
+                hop8.get("headroom_ratio_max", 1.0), 4),
+            "tree_bench.hop_headroom_ratio_64w": round(
+                hop64.get("headroom_ratio_max", 1.0), 4),
+            "tree_bench.hop_ring_drops_64w": float(
+                hop64.get("ring_drops", 0.0)),
+        })
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "a") as f:
         f.write(json.dumps(row) + "\n")

@@ -168,6 +168,13 @@ def main(argv=None):
                          "serving_age_ms age-of-information gauge, and "
                          "freshness-server.jsonl propagation rows in "
                          "--telemetry-dir")
+    ap.add_argument("--hop-anatomy", action="store_true",
+                    help="arm leader-hop occupancy tracing (tree "
+                         "topology): per-round sub-stage timelines "
+                         "(ingest_wait/validate/fold/finalize/encode/"
+                         "push) from bounded native interval rings, "
+                         "hop-leaderN.jsonl rows, the hop_busy_frac / "
+                         "hop_stream_headroom_ratio scoreboard")
     ap.add_argument("--control", action="store_true",
                     help="arm the self-driving controller (requires "
                          "--telemetry-dir for its action/replay rows): "
@@ -316,6 +323,8 @@ def main(argv=None):
         cfg["profile"] = True
     if args.freshness:
         cfg["freshness"] = True
+    if args.hop_anatomy:
+        cfg["hop_anatomy"] = True
     if args.control:
         if not args.telemetry_dir:
             ap.error("--control needs --telemetry-dir (action rows, "
@@ -556,10 +565,18 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     for f in lineage_files:
         lineage_rows.extend(load_lineage_rows(f))
     offsets = clock_offsets_from_rows(lineage_rows) if lineage_rows else None
+    # hop-anatomy rows add one trace track per tree leader (sub-stage
+    # spans the composed lineage arrows thread through)
+    from pytorch_ps_mpi_tpu.telemetry import load_hop_rows
+
+    hop_rows = []
+    for f in sorted(glob.glob(os.path.join(tdir, "hop-*.jsonl"))):
+        hop_rows.extend(load_hop_rows(f))
     trace_path, counts = export_chrome_trace(
         os.path.join(tdir, "trace.json"), events,
         device_trace_dir=device_trace_dir, device_t0_wall=device_t0_wall,
         lineage_rows=lineage_rows or None, clock_offsets=offsets,
+        hop_rows=hop_rows or None,
     )
     # every sidecar with a report route joins the printed report through
     # its own section (numerics/lineage/anatomy/history/slo/actions),
@@ -584,6 +601,8 @@ def _export_telemetry(tdir: str, device_trace_dir, device_t0_wall) -> dict:
     if lineage_rows:
         out["telemetry_trace_flow_events"] = counts["flow"]
         out["clock_offsets"] = offsets
+    if hop_rows:
+        out["telemetry_trace_hop_events"] = counts["hop"]
     return out
 
 

@@ -49,6 +49,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <new>
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
@@ -637,6 +638,89 @@ struct BatchMeta {
 #pragma pack(pop)
 static_assert(sizeof(BatchMeta) == 48, "BatchMeta must be 48 bytes");
 
+// -- per-frame ingest stamp ring (hop anatomy) ------------------------------
+// The pump counters above aggregate; the hop-anatomy plane
+// (telemetry/hop_anatomy.py) needs per-frame timing, so an armed process
+// records one stamp per frame popped by tps_server_pop_grad_batch:
+// when it left the queue, how long its PSF2 validation took, its payload
+// size and verdict. Bounded ring, overflow drops-and-counts — the pop
+// hot path never blocks or reallocates. Thread affinity matches
+// tps_server_read_stats: arm/drain ONLY from the pump-owning thread.
+#pragma pack(push, 1)
+struct HopStamp {
+  uint64_t t_ns;         // CLOCK_MONOTONIC when the frame was popped
+  uint64_t validate_ns;  // ns inside validate_frame (0: check unarmed)
+  uint64_t bytes;        // validated payload byte length (0 on reject)
+  uint32_t worker;
+  uint32_t status;       // FrameStatus: 0 ok, else rejection reason
+};
+#pragma pack(pop)
+static_assert(sizeof(HopStamp) == 32, "HopStamp must be 32 bytes");
+
+static HopStamp* g_stamp_ring = nullptr;
+static uint32_t g_stamp_cap = 0;
+static std::atomic<uint32_t> g_stamp_len{0};
+static std::atomic<uint64_t> g_stamp_dropped{0};
+
+static inline uint64_t hop_now_ns() {
+  timespec t;
+  clock_gettime(CLOCK_MONOTONIC, &t);
+  return (uint64_t)t.tv_sec * 1000000000ull + (uint64_t)t.tv_nsec;
+}
+
+static void hop_stamp_record(uint32_t worker, uint32_t status,
+                             uint64_t bytes, uint64_t validate_ns) {
+  if (g_stamp_ring == nullptr) return;
+  uint32_t len = g_stamp_len.load(std::memory_order_relaxed);
+  if (len >= g_stamp_cap) {
+    g_stamp_dropped.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  HopStamp& st = g_stamp_ring[len];
+  st.t_ns = hop_now_ns();
+  st.validate_ns = validate_ns;
+  st.bytes = bytes;
+  st.worker = worker;
+  st.status = status;
+  g_stamp_len.store(len + 1, std::memory_order_release);
+}
+
+// ABI self-description for the load-time size check (ctypes twin in
+// parallel/tcp.py asserts its sizeof against this before first use).
+uint32_t tps_abi_hop_stamp_bytes() { return (uint32_t)sizeof(HopStamp); }
+
+// Arm (capacity > 0) or disarm (capacity 0) the stamp ring. Returns 0 on
+// success, -1 on allocation failure. Resets length + drop counter.
+int tps_hop_stamps_arm(uint32_t capacity) {
+  delete[] g_stamp_ring;
+  g_stamp_ring = nullptr;
+  g_stamp_cap = 0;
+  g_stamp_len.store(0, std::memory_order_relaxed);
+  g_stamp_dropped.store(0, std::memory_order_relaxed);
+  if (capacity == 0) return 0;
+  g_stamp_ring = new (std::nothrow) HopStamp[capacity];
+  if (g_stamp_ring == nullptr) return -1;
+  g_stamp_cap = capacity;
+  return 0;
+}
+
+// Batched drain: copy out up to max stamps (oldest first), reset the
+// ring, report (and reset) the overflow-drop count since the previous
+// drain. Returns stamps written. Pump-owning thread only.
+uint32_t tps_hop_stamps_drain(HopStamp* out, uint32_t max,
+                              uint64_t* dropped) {
+  uint32_t len = g_stamp_len.load(std::memory_order_acquire);
+  uint32_t n = len < max ? len : max;
+  if (g_stamp_ring != nullptr && n > 0)
+    std::memcpy(out, g_stamp_ring, (size_t)n * sizeof(HopStamp));
+  if (len > n)
+    g_stamp_dropped.fetch_add(len - n, std::memory_order_relaxed);
+  g_stamp_len.store(0, std::memory_order_relaxed);
+  if (dropped != nullptr)
+    *dropped = g_stamp_dropped.exchange(0, std::memory_order_relaxed);
+  return n;
+}
+
 // Batched pop: drain up to max_frames queued gradients in ONE call,
 // validating each inner PSF2 frame in C++ when armed
 // (tps_server_set_frame_check) — magic/version, declared vs expected
@@ -662,9 +746,12 @@ int tps_server_pop_grad_batch(void* sv, uint8_t* buf, uint64_t cap,
     const uint8_t* payload = m.bytes.data();
     uint64_t plen = m.bytes.size();
     uint32_t status = FRAME_OK;
+    uint64_t v_ns = 0;
     if (s->frame_check) {
       PsfHeader h{};
+      uint64_t v_t0 = g_stamp_ring != nullptr ? hop_now_ns() : 0;
       status = validate_frame(s, m, &payload, &plen, &h);
+      if (g_stamp_ring != nullptr) v_ns = hop_now_ns() - v_t0;
       if (status == FRAME_OK) {
         g_frames_validated.fetch_add(1, std::memory_order_relaxed);
         meta.step = h.step;
@@ -676,6 +763,7 @@ int tps_server_pop_grad_batch(void* sv, uint8_t* buf, uint64_t cap,
       meta.status = status;
       meta.off = 0;
       meta.len = 0;
+      hop_stamp_record(m.worker, status, 0, v_ns);
       s->grads.pop_front();
       ++n;
       continue;
@@ -685,6 +773,7 @@ int tps_server_pop_grad_batch(void* sv, uint8_t* buf, uint64_t cap,
     meta.status = FRAME_OK;
     meta.off = off;
     meta.len = plen;
+    hop_stamp_record(m.worker, FRAME_OK, plen, v_ns);
     off += plen;
     s->grads.pop_front();
     ++n;

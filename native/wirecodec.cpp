@@ -34,6 +34,7 @@
 #include <cstdint>
 #include <cstring>
 #include <ctime>
+#include <new>
 
 // -- fold cycle counters (continuous profiling, telemetry/profiler.py) ----
 // A Python stack sampler cannot see inside one opaque ctypes call, so the
@@ -44,6 +45,26 @@
 static std::atomic<uint64_t> g_fold_calls{0};
 static std::atomic<uint64_t> g_fold_elems{0};
 static std::atomic<uint64_t> g_fold_ns{0};
+
+// -- per-fold-call interval ring (hop anatomy) ------------------------------
+// The counters above answer "how much fold work happened"; the hop-anatomy
+// plane (telemetry/hop_anatomy.py) also needs WHEN each fold ran, so armed
+// processes additionally record one (start_ns, end_ns, elems) span per
+// wc_fold_* call into a bounded ring. Overflow drops the span and counts
+// the drop — the ring never blocks or reallocates on the fold hot path.
+// Single-writer discipline: arm/drain only from the fold-calling thread
+// (the leader loop), same affinity rule as tps_server_read_stats.
+struct FoldSpan {
+  uint64_t start_ns;  // CLOCK_MONOTONIC at fold entry
+  uint64_t end_ns;    // CLOCK_MONOTONIC at fold return
+  uint64_t elems;     // elements folded by this call
+};
+static_assert(sizeof(FoldSpan) == 24, "FoldSpan must be 24 bytes");
+
+static FoldSpan* g_span_ring = nullptr;
+static uint32_t g_span_cap = 0;
+static std::atomic<uint32_t> g_span_len{0};
+static std::atomic<uint64_t> g_span_dropped{0};
 
 namespace {
 struct FoldProf {
@@ -57,6 +78,19 @@ struct FoldProf {
     g_fold_calls.fetch_add(1, std::memory_order_relaxed);
     g_fold_elems.fetch_add((uint64_t)n, std::memory_order_relaxed);
     g_fold_ns.fetch_add(ns, std::memory_order_relaxed);
+    if (g_span_ring != nullptr) {
+      uint32_t len = g_span_len.load(std::memory_order_relaxed);
+      if (len < g_span_cap) {
+        FoldSpan& s = g_span_ring[len];
+        s.start_ns = (uint64_t)t0.tv_sec * 1000000000ull +
+                     (uint64_t)t0.tv_nsec;
+        s.end_ns = s.start_ns + ns;
+        s.elems = (uint64_t)n;
+        g_span_len.store(len + 1, std::memory_order_release);
+      } else {
+        g_span_dropped.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
   }
 };
 }  // namespace
@@ -76,6 +110,43 @@ void wc_profile_reset() {
   g_fold_calls.store(0, std::memory_order_relaxed);
   g_fold_elems.store(0, std::memory_order_relaxed);
   g_fold_ns.store(0, std::memory_order_relaxed);
+}
+
+// ABI self-description for the load-time size check (the ctypes twin in
+// utils/native.py asserts its sizeof against this before first use).
+uint32_t wc_abi_fold_span_bytes() { return (uint32_t)sizeof(FoldSpan); }
+
+// Arm (or resize/disarm with capacity 0) the fold-span capture ring.
+// Returns 0 on success, -1 on allocation failure. Arming resets length
+// and the drop counter; call only from the fold thread.
+int wc_fold_spans_arm(uint32_t capacity) {
+  delete[] g_span_ring;
+  g_span_ring = nullptr;
+  g_span_cap = 0;
+  g_span_len.store(0, std::memory_order_relaxed);
+  g_span_dropped.store(0, std::memory_order_relaxed);
+  if (capacity == 0) return 0;
+  g_span_ring = new (std::nothrow) FoldSpan[capacity];
+  if (g_span_ring == nullptr) return -1;
+  g_span_cap = capacity;
+  return 0;
+}
+
+// Copy out up to max recorded spans (oldest first), reset the ring, and
+// report (then reset) the spans dropped to overflow since the previous
+// drain. Returns the number of spans written to out. Fold thread only.
+uint32_t wc_fold_spans_drain(FoldSpan* out, uint32_t max, uint64_t* dropped) {
+  uint32_t len = g_span_len.load(std::memory_order_acquire);
+  uint32_t n = len < max ? len : max;
+  if (g_span_ring != nullptr && n > 0)
+    std::memcpy(out, g_span_ring, (size_t)n * sizeof(FoldSpan));
+  // entries beyond max are surrendered as drops, never silently lost
+  if (len > n)
+    g_span_dropped.fetch_add(len - n, std::memory_order_relaxed);
+  g_span_len.store(0, std::memory_order_relaxed);
+  if (dropped != nullptr)
+    *dropped = g_span_dropped.exchange(0, std::memory_order_relaxed);
+  return n;
 }
 
 // acc[i] += scale * q[i] — int8/qsgd scale-folded integer family.

@@ -144,6 +144,7 @@ CONTROL_KNOBS: Dict[str, Any] = {
     "replan_max": 1,             # group splits per run (spare wid slots)
     "replan_cooldown_s": 20.0,   # min gap between structural replans
     "leader_fold_hot_frac": 0.2,  # advisor saving_frac flagging a hop hot
+    "hop_streaming_headroom": 1.2,  # serial/overlap ratio => fix:streaming
     "leader_churn_replan": 2.0,  # leader respawns before a churn replan
     "replica_min": 0,            # read-tier floor (scale-out bootstraps)
     "replica_max": 4,            # read-tier ceiling
@@ -673,6 +674,21 @@ class ControlEngine:
                 if fold_hot:
                     verdict = {"kind": "leader_fold_hot", "group": hot,
                                "saving_frac": _r(saving)}
+                    if row.get("hop_rounds", 0.0) > 0:
+                        # hop anatomy refines the verdict: a serial
+                        # pipeline with real streaming headroom wants
+                        # an overlapped hop, not more leaders; a busy
+                        # pipeline with no headroom wants the split
+                        headroom = float(
+                            row.get("hop_headroom_ratio", 1.0))
+                        verdict["fix"] = (
+                            "streaming"
+                            if headroom
+                            >= float(k["hop_streaming_headroom"])
+                            else "split")
+                        verdict["hop_busy_frac"] = _r(
+                            row.get("hop_busy_frac", 0.0))
+                        verdict["hop_headroom_ratio"] = _r(headroom)
                 else:
                     verdict = {"kind": "leader_churn",
                                "group": churn_grp,
@@ -1140,6 +1156,19 @@ class Controller:
         out["lf_top"] = lf_top
         out["lf_saving_frac"] = lf_saving
         out["hot_group"] = hot_group
+        # hop-anatomy occupancy plane (0.0 / 1.0 neutral when unarmed —
+        # hop_rounds==0 keeps the topo rule byte-identical to a run
+        # without hop tracing)
+        ha = getattr(server, "hop_anatomy", None)
+        hop_rounds = hop_busy = 0.0
+        hop_headroom = 1.0
+        if ha is not None and ha.rounds:
+            hop_rounds = float(ha.rounds)
+            hop_busy = float(ha.busy_frac())
+            hop_headroom = float(ha.headroom_ratio())
+        out["hop_rounds"] = hop_rounds
+        out["hop_busy_frac"] = hop_busy
+        out["hop_headroom_ratio"] = hop_headroom
         out["replicas_live"] = float(self.replicas_live)
         lag = skew = skew_hot = shards = edge_age = 0.0
         fm = getattr(server, "fleet_monitor", None)

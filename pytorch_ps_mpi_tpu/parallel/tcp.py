@@ -73,6 +73,26 @@ class _BatchMeta(ctypes.Structure):
 assert ctypes.sizeof(_BatchMeta) == 48
 
 
+class _HopStamp(ctypes.Structure):
+    """Mirror of native/tcpps.cpp HopStamp (32 bytes, packed) — one
+    per-frame validate/ingest stamp from the batched pop, drained by the
+    hop-anatomy plane through ``tps_hop_stamps_drain`` (pump-owning
+    thread only). Size-checked at load via ``tps_abi_hop_stamp_bytes``
+    and diffed field-for-field by the psanalyze ABI-drift rule."""
+
+    _pack_ = 1
+    _fields_ = [
+        ("t_ns", ctypes.c_uint64),
+        ("validate_ns", ctypes.c_uint64),
+        ("bytes", ctypes.c_uint64),
+        ("worker", ctypes.c_uint32),
+        ("status", ctypes.c_uint32),
+    ]
+
+
+assert ctypes.sizeof(_HopStamp) == 32
+
+
 def get_lib() -> Optional[ctypes.CDLL]:
     """Build (once) and load native/tcpps.cpp; None without a toolchain."""
     global _lib
@@ -134,6 +154,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib._has_batch = True
     except AttributeError:
         lib._has_batch = False
+    # per-frame ingest stamp ring (hop anatomy) — own probe, so a stale
+    # library with batch but no ring degrades only the ring
+    try:
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.tps_abi_hop_stamp_bytes.argtypes = []
+        lib.tps_abi_hop_stamp_bytes.restype = ctypes.c_uint32
+        lib.tps_hop_stamps_arm.argtypes = [ctypes.c_uint32]
+        lib.tps_hop_stamps_arm.restype = ctypes.c_int
+        lib.tps_hop_stamps_drain.argtypes = [
+            ctypes.POINTER(_HopStamp), ctypes.c_uint32, u64p]
+        lib.tps_hop_stamps_drain.restype = ctypes.c_uint32
+        lib._has_hop_stamps = True
+    except AttributeError:
+        lib._has_hop_stamps = False
     _verify_abi(lib)
     _lib = lib
     return _lib
@@ -166,6 +200,9 @@ def _verify_abi(lib: ctypes.CDLL) -> None:
         ("BatchMeta bytes", int(lib.tps_abi_batch_meta_bytes()),
          ctypes.sizeof(_BatchMeta)),
     )
+    if getattr(lib, "_has_hop_stamps", False):
+        checks += (("HopStamp bytes", int(lib.tps_abi_hop_stamp_bytes()),
+                    ctypes.sizeof(_HopStamp)),)
     for what, native_v, py_v in checks:
         if native_v != py_v:
             raise RuntimeError(
@@ -328,6 +365,41 @@ class TcpPSServer(PSServerTelemetry):
         self._lib.tps_server_read_stats(self._h, ctypes.byref(total),
                                         ctypes.byref(nm))
         self._native_read_stats = (int(total.value), int(nm.value))
+
+    def hop_stamps_arm(self, capacity: int) -> bool:
+        """Arm (capacity > 0) or disarm (0) the native per-frame ingest
+        stamp ring the hop-anatomy plane drains. Returns True when the
+        ring is live. PS_NO_NATIVE keeps the pure-Python stamp fallback
+        in charge; call only from the pump-owning thread (the same
+        thread-affinity contract as ``tps_server_read_stats``)."""
+        from pytorch_ps_mpi_tpu.utils import native as _native
+
+        if _native.fast_path_disabled():
+            return False
+        if not getattr(self._lib, "_has_hop_stamps", False):
+            return False
+        ok = int(self._lib.tps_hop_stamps_arm(int(capacity))) == 0
+        self._hop_stamps_armed = ok and capacity > 0
+        return self._hop_stamps_armed
+
+    def drain_hop_stamps(self, max_stamps: int = 4096
+                         ) -> Optional[Tuple[list, int]]:
+        """Batched drain of the armed stamp ring: ``([(t_ns,
+        validate_ns, bytes, worker, status), ...], dropped)`` — oldest
+        first, overflow-drop counter reset per drain — or None when the
+        ring is unarmed/unavailable. Pump-owning thread only; callers
+        mirror the result into plain Python state before any other
+        thread reads it (the ``_native_read_stats`` discipline)."""
+        if not getattr(self, "_hop_stamps_armed", False):
+            return None
+        buf = (_HopStamp * int(max_stamps))()
+        dropped = ctypes.c_uint64()
+        n = int(self._lib.tps_hop_stamps_drain(
+            buf, int(max_stamps), ctypes.byref(dropped)))
+        stamps = [(int(buf[i].t_ns), int(buf[i].validate_ns),
+                   int(buf[i].bytes), int(buf[i].worker),
+                   int(buf[i].status)) for i in range(n)]
+        return stamps, int(dropped.value)
 
     def publish(self, params: PyTree) -> None:
         self.publish_flat(_flatten(params))

@@ -248,6 +248,23 @@ def leader_main(upstream: Sequence[str], group_id: int,
         http_port = None
     server.arm_observability(ocfg, name=f"leader{group_id}")
     reg = server.scrape_registry()
+    # hop anatomy (cfg["hop_anatomy"]): arm_observability attached the
+    # profiler; arm the bounded native interval rings behind its
+    # timeline — per-frame validate stamps (tcpps) and per-fold-call
+    # spans (wirecodec). Both are drop-and-count on overflow and both
+    # arms are no-ops under PS_NO_NATIVE or the shm transport: the
+    # timeline then falls back to the Python stage walls alone
+    # (validate time stays inside ingest_wait).
+    from pytorch_ps_mpi_tpu.utils import native as wc_native
+
+    hop_an = getattr(server, "hop_anatomy", None)
+    hop_stamps_on = hop_spans_on = False
+    if hop_an is not None:
+        ring_cap = int(hop_an.knobs["ring_capacity"])
+        stamp_arm = getattr(server, "hop_stamps_arm", None)
+        hop_stamps_on = (bool(stamp_arm(ring_cap))
+                         if stamp_arm is not None else False)
+        hop_spans_on = bool(wc_native.fold_spans_arm(ring_cap))
     state = {"upstream_pushes": 0, "partial_rounds": 0, "composed": 0}
 
     def _collect(r):
@@ -428,8 +445,14 @@ def leader_main(upstream: Sequence[str], group_id: int,
                             "seq": int(meta.get("seq", 0)),
                             "send_wall": float(meta.get("send_wall", 0.0))})
             root_vs.append(vs)
+        t_fin0 = time.monotonic()
         summed = agg.finalize()
-        fold_s = time.monotonic() - t_fold0
+        t_fin1 = time.monotonic()
+        fin_s = t_fin1 - t_fin0
+        # fold_s keeps its historical meaning (fold loop + finalize) —
+        # the hop row below and the offline round anatomy join on it;
+        # the hop-anatomy row splits finalize into its own sub-stage
+        fold_s = t_fin1 - t_fold0
         # conservative per-shard version tag: the OLDEST snapshot any
         # folded gradient was computed against — staleness is never
         # under-reported upstream
@@ -492,6 +515,47 @@ def leader_main(upstream: Sequence[str], group_id: int,
             **hops[0].probe(),
         })
         log.flush()
+        if hop_an is not None:
+            # the hop-anatomy round: drain the native rings (owned by
+            # THIS thread — the same one that pumps the transport and
+            # runs the folds), attribute the round window to sub-stages
+            # and feed the streaming-headroom scoreboard. The window
+            # opens at the previous round's push end (round_t0).
+            t_done = time.monotonic()
+            validate_s = 0.0
+            ring_drops = 0
+            if hop_stamps_on:
+                got = server.drain_hop_stamps()
+                if got is not None:
+                    stamps, lost = got
+                    validate_s = sum(s[1] for s in stamps) / 1e9
+                    ring_drops += int(lost)
+            fold_calls = 0
+            fold_busy_s = 0.0
+            if hop_spans_on:
+                got = wc_native.fold_spans_drain()
+                if got is not None:
+                    spans, lost = got
+                    fold_calls = len(spans)
+                    fold_busy_s = sum(e - s for s, e, _ in spans) / 1e9
+                    ring_drops += int(lost)
+            hop_an.observe_round(
+                leader=int(group_id), round=rounds,
+                frames=len(entries),
+                stages={
+                    "ingest_wait": max(
+                        t_fold0 - round_t0 - validate_s, 0.0),
+                    "validate": validate_s,
+                    "fold": max(fold_s - fin_s, 0.0),
+                    "finalize": fin_s,
+                    "encode": enc_s,
+                    "upstream_push": push_s,
+                },
+                round_s=max(t_done - round_t0, 0.0),
+                drops=ring_drops,
+                native=bool(hop_stamps_on or hop_spans_on),
+                fold_calls=fold_calls, fold_busy_s=fold_busy_s)
+            hop_an.flush()  # the root's tailer reads rows live
         rounds += 1
         up_seq += 1
         round_t0 = time.monotonic()
@@ -986,6 +1050,22 @@ def run_tree(cfg: Dict[str, Any], *, total_pushes: Optional[int] = None,
         # anatomy advisor (the engine's hot_group input)
         actuator = None
         tailer = None
+        hop_tailer = None
+        # hop anatomy at the root: the leaders WRITE hop-leaderN.jsonl;
+        # this tailer replays their rows into the root's own HopAnatomy
+        # (armed by serve()'s arm_observability) — the fleet scoreboard
+        # the /health hop section, ps_top and the topo controller read
+        if cfg.get("hop_anatomy"):
+            from pytorch_ps_mpi_tpu.control.topo import HopTailer
+
+            hop_dir = cfg.get("lineage_dir") or cfg.get("telemetry_dir")
+            if hop_dir:
+                hop_tailer = HopTailer(
+                    hop_dir,
+                    lambda row: (root.hop_anatomy.observe_row(row)
+                                 if getattr(root, "hop_anatomy", None)
+                                 is not None else None),
+                    pattern="hop-*.jsonl")
         if cfg.get("topo_actions"):
             from pytorch_ps_mpi_tpu.control.topo import (
                 HopTailer,
@@ -1041,6 +1121,8 @@ def run_tree(cfg: Dict[str, Any], *, total_pushes: Optional[int] = None,
                 }
             if tailer is not None:
                 tailer.poll()
+            if hop_tailer is not None:
+                hop_tailer.poll()
 
         def stop_when():
             if total_pushes is not None and root.tree_composed >= total_pushes:

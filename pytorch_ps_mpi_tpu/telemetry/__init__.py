@@ -65,6 +65,12 @@ dicts). One system, three faces:
   :class:`FreshnessTracker` turns them into publish→visible latency
   distributions, the age-of-information gauge, and flow events joined
   to write-path lineage.
+- :mod:`hop anatomy <.hop_anatomy>` — the layer that opens the LEADER:
+  :class:`HopAnatomy` reconstructs each leader hop round into sub-stage
+  intervals (ingest_wait / validate / fold / finalize / encode /
+  upstream_push / idle) from bounded native interval rings, computes
+  per-leader busy fractions, and projects the streaming-headroom ratio
+  — what a pipelined (ingest ⇄ fold ⇄ encode overlapped) hop would buy.
 - :mod:`fleet <.fleet>` — the layer that merges the PANES:
   :class:`FleetMonitor` polls every registered endpoint (sharded
   servers, supervisor generations, the read tier) into one ``/fleet``
@@ -103,6 +109,7 @@ SIDECAR_PREFIXES: Dict[str, Optional[str]] = {
     "slo-": "slo",            # SLO verdict events
     "control-": "actions",    # controller action rows
     "freshness-": "freshness",  # publish→edge propagation + delivery rows
+    "hop-": "hop",            # leader hop sub-stage occupancy rows
 }
 
 
@@ -202,6 +209,12 @@ from pytorch_ps_mpi_tpu.telemetry.freshness import (
     freshness_flow_events,
     load_fresh_rows,
 )
+from pytorch_ps_mpi_tpu.telemetry.hop_anatomy import (
+    HopAnatomy,
+    hop_anatomy_from_rows,
+    hop_trace_events,
+    load_hop_rows,
+)
 
 __all__ = [
     "SIDECAR_PREFIXES",
@@ -259,4 +272,8 @@ __all__ = [
     "FreshnessTracker",
     "freshness_flow_events",
     "load_fresh_rows",
+    "HopAnatomy",
+    "hop_anatomy_from_rows",
+    "hop_trace_events",
+    "load_hop_rows",
 ]

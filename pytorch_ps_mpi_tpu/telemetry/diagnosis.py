@@ -493,6 +493,11 @@ class HealthMonitor:
             # critical-path shares, the ranked what-if advisor — the
             # pane ps_top renders and the report tabulates
             out["anatomy"] = an.snapshot()
+        ha = getattr(self.server, "hop_anatomy", None)
+        if ha is not None:
+            # the hop section: leader-pipeline sub-stage occupancy,
+            # per-leader busy fractions, the streaming-headroom board
+            out["hop"] = ha.snapshot()
         sc = getattr(self.server, "serving_core", None)
         if sc is not None and sc.armed:
             # the serving section: snapshot-ring occupancy, read queue

@@ -68,6 +68,9 @@ _SUM_KEYS: Dict[str, str] = {
     "topo_actions": "ps_topo_actions_total",
     "replicas_live": "ps_replicas_live",
     "group_replans": "ps_group_replans_total",
+    # hop anatomy: fleet-wide decomposed leader rounds (each leader
+    # only counts its OWN hop rounds)
+    "hop_rounds": "ps_hop_rounds_total",
 }
 
 #: gauges rolled up as the fleet max (worst member)
@@ -88,6 +91,11 @@ _MAX_KEYS: Dict[str, str] = {
     # the tree (the freshness plane's fleet rollup — what "how stale is
     # the model a reader at the edge sees" actually maxes out at)
     "serving_age_ms": "ps_serving_age_ms",
+    # the HOTTEST leader pipeline: occupancy and streaming headroom are
+    # per-leader verdict inputs, so the rollup takes the fleet max —
+    # one saturated (or one serial) hop is where the next fix goes
+    "hop_busy_frac": "ps_hop_busy_frac",
+    "hop_stream_headroom_ratio": "ps_hop_stream_headroom_ratio",
 }
 
 #: per-member gauges the skew detector compares across shards

@@ -424,6 +424,21 @@ PS_SERVER_METRIC_KEYS: Tuple[str, ...] = (
     "read_fresh_p95_ms",
     "serving_age_ms",
     "fresh_hop_count",
+    # leader hop anatomy (telemetry.hop_anatomy): the leader-pipeline
+    # occupancy plane. All neutral (0.0; headroom 1.0) until hop rounds
+    # land. hop_busy_frac is the median share of the hop window spent
+    # WORKING (validate/fold/finalize/encode/push vs waiting);
+    # hop_ingest_wait_ms the median per-round wait for group pushes;
+    # hop_stream_headroom_ratio the median serial/overlapped projection
+    # (≫1 = a streaming leader hop would pay, ≈1 = pipeline already
+    # busy — split instead); hop_ring_drops counts native interval-ring
+    # entries surrendered to overflow (bounded rings never block)
+    "hop_rounds",
+    "hop_busy_frac",
+    "hop_ingest_wait_ms",
+    "hop_stream_headroom_ratio",
+    "hop_serial_ms",
+    "hop_ring_drops",
 )
 
 #: The canonical-key subset the ``/health`` fleet rollup republishes
@@ -446,6 +461,8 @@ HEALTH_FLEET_ROLLUP_KEYS: Tuple[str, ...] = (
     "native_read_conns",
     "replica_lag_versions",
     "follower_bytes_relayed",
+    "hop_busy_frac",
+    "hop_stream_headroom_ratio",
 )
 assert set(HEALTH_FLEET_ROLLUP_KEYS) <= set(PS_SERVER_METRIC_KEYS)
 
@@ -494,6 +511,7 @@ def ps_server_metrics(server) -> Dict[str, float]:
     nm = getattr(server, "numerics_monitor", None)
     lt = getattr(server, "lineage_tracker", None)
     an = getattr(server, "anatomy", None)
+    ha = getattr(server, "hop_anatomy", None)
     sc = getattr(server, "serving_core", None)
     cl = getattr(server, "controller", None)
     rm = sc.read_metrics() if (sc is not None and sc.armed) else {}
@@ -572,6 +590,17 @@ def ps_server_metrics(server) -> Dict[str, float]:
         "read_fresh_p95_ms": rm.get("read_fresh_p95_ms", 0.0),
         "serving_age_ms": rm.get("serving_age_ms", 0.0),
         "fresh_hop_count": rm.get("fresh_hop_count", 0.0),
+        "hop_rounds": float(ha.rounds if ha is not None else 0.0),
+        "hop_busy_frac": float(
+            ha.busy_frac() if ha is not None else 0.0),
+        "hop_ingest_wait_ms": float(
+            ha.ingest_wait_ms() if ha is not None else 0.0),
+        "hop_stream_headroom_ratio": float(
+            ha.headroom_ratio() if ha is not None else 1.0),
+        "hop_serial_ms": float(
+            ha.serial_ms() if ha is not None else 0.0),
+        "hop_ring_drops": float(
+            ha.ring_drops if ha is not None else 0.0),
     }
 
 
@@ -755,6 +784,11 @@ class PSServerTelemetry:
     #: the age-of-information plane), set by :meth:`arm_observability`
     #: — see :mod:`.freshness`
     freshness_tracker: Optional[Any] = None
+    #: the attached leader-hop occupancy profiler (the ``hop_*``
+    #: canonical keys' source: per-round sub-stage intervals + the
+    #: streaming-headroom projection), set by :meth:`arm_observability`
+    #: when ``cfg["hop_anatomy"]`` is armed — see :mod:`.hop_anatomy`
+    hop_anatomy: Optional[Any] = None
 
     @property
     def frames_rejected(self) -> Dict[int, int]:
@@ -812,6 +846,10 @@ class PSServerTelemetry:
                 # the monitor-less route still reports the round
                 # anatomy: critical-path shares + the what-if advisor
                 doc["anatomy"] = self.anatomy.snapshot()
+            if self.hop_anatomy is not None:
+                # the monitor-less route still reports the hop anatomy:
+                # sub-stage occupancy + the streaming-headroom board
+                doc["hop"] = self.hop_anatomy.snapshot()
             if self.timeseries_db is not None:
                 doc["history"] = self.timeseries_db.snapshot()
             return json.dumps(doc)
@@ -920,6 +958,17 @@ class PSServerTelemetry:
             # attaches itself to self.freshness_tracker + scrape
             # registry; freshness_kw overrides come through the cfg
             FreshnessTracker(self, cfg, name=name, dir=out_dir)
+        if cfg.get("hop_anatomy") or cfg.get("hop_anatomy_kw"):
+            from pytorch_ps_mpi_tpu.telemetry.hop_anatomy import (
+                HopAnatomy,
+            )
+
+            # attaches itself to self.hop_anatomy + scrape registry;
+            # hop_anatomy_kw knob overrides come through the cfg. A
+            # tree leader FEEDS it per-round (parallel.tree._hop_push);
+            # the root arms it too and replays the leaders' tailed
+            # hop-*.jsonl rows into it (the fleet scoreboard)
+            HopAnatomy(self, cfg, name=name)
         if cfg.get("profile") or cfg.get("profile_dir"):
             from pytorch_ps_mpi_tpu.telemetry.profiler import (
                 SamplingProfiler,
@@ -991,6 +1040,10 @@ class PSServerTelemetry:
         if ft is not None:
             ft.close()
             out["freshness"] = ft.snapshot()
+        ha = self.hop_anatomy
+        if ha is not None:
+            ha.close()
+            out["hop"] = ha.snapshot()
         return out
 
     def close_observability(self) -> Dict[str, Any]:

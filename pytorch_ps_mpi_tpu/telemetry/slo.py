@@ -73,6 +73,10 @@ DEFAULT_TARGETS: Dict[str, float] = {
     # serves a correctly-aging snapshot — that is not an incident);
     # smokes/tests that want a tight edge-staleness gate override this
     "serving_age_ms": 60000.0,
+    # leader hop occupancy: a pipeline pinned near-saturation round
+    # after round is paying a structural cost (split or stream it);
+    # 0.95 leaves bursty rounds alone and catches the sustained burn
+    "hop_busy_frac": 0.95,
 }
 
 #: map a measured artifact field -> the SLO target key it calibrates
@@ -178,6 +182,11 @@ def default_rules(targets: Dict[str, float]) -> List[Dict[str, Any]]:
          "mode": "value", "target": t["serving_age_ms"],
          "help": "age-of-information of the served version (freshness "
                  "plane; worst tenant)"},
+        {"name": "hop_occupancy", "key": "hop_busy_frac",
+         "mode": "value", "target": t["hop_busy_frac"],
+         "help": "leader hop-pipeline occupancy (hop anatomy; "
+                 "sustained saturation wants a split or a streaming "
+                 "hop — read hop_stream_headroom_ratio for which)"},
     ]
 
 

@@ -194,6 +194,7 @@ def merged_trace_events(
     lineage_rows: Optional[Iterable[Dict[str, Any]]] = None,
     clock_offsets: Optional[Dict[Any, float]] = None,
     freshness_rows: Optional[Iterable[Dict[str, Any]]] = None,
+    hop_rows: Optional[Iterable[Dict[str, Any]]] = None,
 ) -> List[Dict[str, Any]]:
     """FlightRecorder records (+ optional jax trace dir) → Chrome
     ``traceEvents`` list, all timestamps relative to the earliest host
@@ -202,7 +203,11 @@ def merged_trace_events(
     events linking push spans to consume spans; ``freshness_rows``
     (delivery rows from ``freshness-*.jsonl``) add read-path flow
     arrows from the root publish through each follower hop to the edge
-    reader, joined to write-path lineage when both are given."""
+    reader, joined to write-path lineage when both are given;
+    ``hop_rows`` (``hop_round`` rows from ``hop-*.jsonl``) add one
+    track per tree leader with the hop's sub-stage spans, whose fold
+    spans the composed pushes' lineage arrows thread through (flow
+    STEP events, joined by the leaders' lineage hop rows)."""
     host_events = apply_clock_offsets(host_events, clock_offsets)
     walls = [e["wall"] for e in host_events if "wall" in e]
     t0_wall = min(walls) if walls else (device_t0_wall or 0.0)
@@ -217,6 +222,14 @@ def merged_trace_events(
 
         out.extend(freshness_flow_events(
             freshness_rows, lineage_rows, t0_wall=t0_wall
+        ))
+    if hop_rows is not None:
+        from pytorch_ps_mpi_tpu.telemetry.hop_anatomy import (
+            hop_trace_events,
+        )
+
+        out.extend(hop_trace_events(
+            hop_rows, lineage_rows, t0_wall=t0_wall
         ))
     if device_trace_dir is not None:
         out.extend(_device_events(
@@ -233,16 +246,18 @@ def export_chrome_trace(
     lineage_rows: Optional[Iterable[Dict[str, Any]]] = None,
     clock_offsets: Optional[Dict[Any, float]] = None,
     freshness_rows: Optional[Iterable[Dict[str, Any]]] = None,
+    hop_rows: Optional[Iterable[Dict[str, Any]]] = None,
 ) -> Tuple[str, Dict[str, int]]:
     """Write the merged timeline to ``path``; returns ``(path, {"host":
-    n, "device": m, "flow": k, "fresh_flow": j})`` so callers can
-    assert every side actually landed in the artifact (``flow`` counts
-    the lineage flow START events — each is half of one cross-process
-    arrow; ``fresh_flow`` the read-path publish→edge flow starts)."""
+    n, "device": m, "flow": k, "fresh_flow": j, "hop": h})`` so callers
+    can assert every side actually landed in the artifact (``flow``
+    counts the lineage flow START events — each is half of one
+    cross-process arrow; ``fresh_flow`` the read-path publish→edge flow
+    starts; ``hop`` the leader-track sub-stage spans)."""
     events = merged_trace_events(
         host_events, device_trace_dir, device_t0_wall,
         lineage_rows=lineage_rows, clock_offsets=clock_offsets,
-        freshness_rows=freshness_rows,
+        freshness_rows=freshness_rows, hop_rows=hop_rows,
     )
     counts = {
         "host": sum(1 for e in events
@@ -253,6 +268,8 @@ def export_chrome_trace(
                     and e.get("cat") != "freshness"),
         "fresh_flow": sum(1 for e in events if e.get("ph") == "s"
                           and e.get("cat") == "freshness"),
+        "hop": sum(1 for e in events
+                   if e.get("cat") == "hop" and e["ph"] == "X"),
     }
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)

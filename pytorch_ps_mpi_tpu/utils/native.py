@@ -29,6 +29,24 @@ _HDR = struct.Struct("<4sBBQI")
 _lib: Optional[ctypes.CDLL] = None
 _BUILD_FAILURES: set = set()
 
+
+class _FoldSpan(ctypes.Structure):
+    """ctypes mirror of ``wirecodec.cpp``'s ``FoldSpan`` — one
+    (start_ns, end_ns, elems) interval per ``wc_fold_*`` call, captured
+    by the armed native span ring for the hop-anatomy plane. Layout is
+    size-checked at load against ``wc_abi_fold_span_bytes`` and diffed
+    field-for-field by the psanalyze ABI-drift rule."""
+
+    _pack_ = 1
+    _fields_ = [
+        ("start_ns", ctypes.c_uint64),
+        ("end_ns", ctypes.c_uint64),
+        ("elems", ctypes.c_uint64),
+    ]
+
+
+assert ctypes.sizeof(_FoldSpan) == 24, "FoldSpan ctypes mirror drifted"
+
 #: ``PS_NATIVE_SANITIZE`` → extra g++ flags. The sanitized builds land
 #: in ``native/_build/<mode>/`` so they never clobber the normal cache;
 #: ``make native-asan``/``native-ubsan`` (tools/native_sanitize.py) run
@@ -156,6 +174,27 @@ def _build_lib() -> Optional[ctypes.CDLL]:
         lib._has_folds = True
     except AttributeError:
         lib._has_folds = False
+    # fold-span capture ring (hop anatomy) — own probe so a stale .so
+    # built with folds but before the ring degrades only the ring
+    try:
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.wc_abi_fold_span_bytes.argtypes = []
+        lib.wc_abi_fold_span_bytes.restype = ctypes.c_uint32
+        lib.wc_fold_spans_arm.argtypes = [ctypes.c_uint32]
+        lib.wc_fold_spans_arm.restype = ctypes.c_int
+        lib.wc_fold_spans_drain.argtypes = [ctypes.POINTER(_FoldSpan),
+                                            ctypes.c_uint32, u64p]
+        lib.wc_fold_spans_drain.restype = ctypes.c_uint32
+        # load-time ABI twin: the native struct size must equal the
+        # ctypes mirror's before ANY drain call is allowed
+        if int(lib.wc_abi_fold_span_bytes()) != ctypes.sizeof(_FoldSpan):
+            raise RuntimeError(
+                "FoldSpan ABI drift: wirecodec.cpp packs "
+                f"{int(lib.wc_abi_fold_span_bytes())} bytes, the ctypes "
+                f"mirror {ctypes.sizeof(_FoldSpan)}")
+        lib._has_fold_spans = True
+    except AttributeError:
+        lib._has_fold_spans = False
     return lib
 
 
@@ -187,6 +226,38 @@ def fold_profile_stats() -> Optional[dict]:
     return {"fold_calls": int(calls.value),
             "fold_elems": int(elems.value),
             "fold_ns": int(ns.value)}
+
+
+def fold_spans_arm(capacity: int) -> bool:
+    """Arm (capacity > 0) or disarm (0) the native per-fold-call span
+    ring the hop-anatomy plane drains. Returns True when the ring is
+    live. Honors ``PS_NO_NATIVE`` (the Python fallback times folds
+    itself); call only from the fold-running thread."""
+    if fast_path_disabled():
+        return False
+    lib = get_lib()
+    if lib is None or not getattr(lib, "_has_fold_spans", False):
+        return False
+    return int(lib.wc_fold_spans_arm(int(capacity))) == 0
+
+
+def fold_spans_drain(max_spans: int = 4096
+                     ) -> Optional[tuple]:
+    """Drain the armed span ring: ``([(start_ns, end_ns, elems), ...],
+    dropped_count)`` — oldest first, drop counter reset per drain — or
+    None when the ring is unavailable. Reads the ALREADY-loaded library
+    only, from the fold-running thread (same affinity discipline as
+    ``tps_server_read_stats``)."""
+    lib = _lib
+    if lib is None or not getattr(lib, "_has_fold_spans", False):
+        return None
+    buf = (_FoldSpan * int(max_spans))()
+    dropped = ctypes.c_uint64()
+    n = int(lib.wc_fold_spans_drain(buf, int(max_spans),
+                                    ctypes.byref(dropped)))
+    spans = [(int(buf[i].start_ns), int(buf[i].end_ns), int(buf[i].elems))
+             for i in range(n)]
+    return spans, int(dropped.value)
 
 
 def _u8(arr: np.ndarray):

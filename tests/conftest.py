@@ -90,10 +90,12 @@ TIER1_XFAIL = {
         "statically infer out_specs replication for the MoE dispatch; "
         "the check_vma machinery this codebase targets (current jax) "
         "can",
-    "tests/test_tp.py::test_dp_tp_train_step_matches_single_device":
-        "pre-existing: jax 0.4.37 shard_map replication inference "
-        "rejects the dp×tp out_specs (same class as "
-        "test_moe_grads_match_dense_oracle)",
+    # test_tp.py::test_dp_tp_train_step_matches_single_device was
+    # burned down in ISSUE 20: the step now runs check_vma=False with
+    # every reduction explicit — local_grads=True keeps the forward's
+    # 'model' psum identity in the backward and a hand-rolled pmean
+    # over 'data' replaces the inferred replication the 0.4.37 checker
+    # rejected.
     "tests/test_ps_model_parallel.py::test_mpips_step_equals_hand_rolled_vma_step":
         "pre-existing: jax 0.4.37 shard_map replication inference "
         "rejects the hand-rolled VMA spmd out_specs (same class as "

@@ -1,0 +1,276 @@
+"""Hop-anatomy plane: timeline reconstruction, the streaming-headroom
+projection, bounded native interval rings, and the unarmed surfaces.
+
+What's pinned here:
+
+1. **Projection arithmetic** on hand-built traces: a perfectly serial
+   pipeline (three equal legs back to back) projects real streaming
+   headroom; a single-leg (already-overlapped-equivalent) trace
+   projects none. The projection is pure arithmetic over the row's
+   rounded fields, so a replay from persisted rows is byte-identical.
+2. **Timeline reconstruction** from synthetic rows: idle derivation,
+   busy fractions, per-leader windows, the hot-leader call.
+3. **Native ring bounds**: the wirecodec fold-span ring at capacity N
+   keeps exactly N spans and counts the overflow as drops — never
+   silently; the TCP hop-stamp ring arms/drains through the same
+   batched ABI; both degrade to a clean no-op under ``PS_NO_NATIVE=1``
+   (the Python fallback's timing feeds the same engine).
+4. **Unarmed surfaces** read as neutral (0.0 / headroom 1.0), both on
+   the engine and on the scrape gauges, so dashboards never mistake
+   "not enough rounds" for "perfectly idle with headroom".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pytorch_ps_mpi_tpu.telemetry.hop_anatomy import (
+    BUSY_STAGES,
+    HOP_STAGES,
+    HopAnatomy,
+    hop_anatomy_from_rows,
+    hop_trace_events,
+    load_hop_rows,
+)
+from pytorch_ps_mpi_tpu.utils import native
+
+# three equal 30 ms legs: ingest(20+10) | fold(20+10) | encode(20+10)
+SERIAL_STAGES = {"ingest_wait": 0.020, "validate": 0.010,
+                 "fold": 0.020, "finalize": 0.010,
+                 "encode": 0.020, "upstream_push": 0.010}
+# the same 90 ms of work all in ONE leg — nothing left to overlap
+LOPSIDED_STAGES = {"ingest_wait": 0.0, "validate": 0.0,
+                   "fold": 0.080, "finalize": 0.010,
+                   "encode": 0.0, "upstream_push": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# the projection
+# ---------------------------------------------------------------------------
+
+
+def test_projection_serial_pipeline_has_headroom():
+    serial, overlap, ratio = HopAnatomy.project(SERIAL_STAGES, frames=3)
+    assert serial == pytest.approx(0.090)
+    # bottleneck leg 0.030 + fill/drain tail (0.060 / 3 frames)
+    assert overlap == pytest.approx(0.050)
+    assert ratio == pytest.approx(0.090 / 0.050)
+
+
+def test_projection_overlapped_equivalent_has_none():
+    serial, overlap, ratio = HopAnatomy.project(LOPSIDED_STAGES, frames=3)
+    assert serial == pytest.approx(0.090)
+    # one leg IS the round: tail 0, overlap == serial, ratio 1.0
+    assert overlap == pytest.approx(0.090)
+    assert ratio == pytest.approx(1.0)
+
+
+def test_projection_more_frames_amortize_the_tail():
+    _, o3, r3 = HopAnatomy.project(SERIAL_STAGES, frames=3)
+    _, o30, r30 = HopAnatomy.project(SERIAL_STAGES, frames=30)
+    assert o30 < o3 and r30 > r3  # deeper rounds pipeline better
+
+
+def test_projection_empty_round_is_neutral():
+    serial, overlap, ratio = HopAnatomy.project({}, frames=0)
+    assert (serial, overlap, ratio) == (0.0, 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# timeline reconstruction
+# ---------------------------------------------------------------------------
+
+
+def _feed(eng, leader, n, stages, round_s, t0=1000.0):
+    for i in range(n):
+        eng.observe_round(leader=leader, round=i, frames=3,
+                          stages=stages, round_s=round_s,
+                          t=t0 + i)
+
+
+def test_timeline_reconstruction_and_idle():
+    eng = HopAnatomy(min_rounds=1)
+    rec = eng.observe_round(leader=0, round=0, frames=3,
+                            stages=SERIAL_STAGES, round_s=0.120, t=1.0)
+    # idle = wall - attributed, never negative
+    assert rec["stages"]["idle"] == pytest.approx(0.030)
+    assert rec["busy_frac"] == pytest.approx(
+        sum(SERIAL_STAGES[s] for s in BUSY_STAGES) / 0.120, abs=1e-4)
+    snap = eng.snapshot()
+    assert snap["rounds"] == 1 and snap["frames"] == 3
+    assert set(snap["stages"]) <= set(HOP_STAGES)
+    assert snap["stages"]["fold"]["p50_ms"] == pytest.approx(20.0)
+    assert snap["serial_ms"] == pytest.approx(90.0)
+
+
+def test_hot_leader_needs_two_and_picks_the_busier():
+    eng = HopAnatomy(min_rounds=1)
+    _feed(eng, 0, 4, LOPSIDED_STAGES, round_s=0.100)
+    assert eng.hot_leader() is None  # one leader has no "hotter"
+    _feed(eng, 1, 4, SERIAL_STAGES, round_s=0.500)  # mostly idle
+    assert eng.hot_leader() == 0
+    snap = eng.snapshot()
+    assert snap["hot_leader"] == 0
+    assert set(snap["leaders"]) == {0, 1}
+    assert (snap["leaders"][0]["busy_frac"]
+            > snap["leaders"][1]["busy_frac"])
+
+
+def test_persist_replay_byte_identical(tmp_path):
+    eng = HopAnatomy(cfg={"lineage_dir": str(tmp_path)},
+                     name="leader0", min_rounds=1, flush_every=1)
+    _feed(eng, 0, 5, SERIAL_STAGES, round_s=0.100)
+    eng.close()
+    rows = load_hop_rows(str(tmp_path / "hop-leader0.jsonl"))
+    assert len(rows) == 5
+    for r in rows:
+        # the projection recomputes exactly from the row's own fields
+        s, o, h = HopAnatomy.project(r["stages"], r["frames"])
+        assert (s, o, h) == (r["serial_s"], r["overlap_s"],
+                             r["headroom_ratio"])
+    off = hop_anatomy_from_rows(rows, min_rounds=1)
+    live, replay = eng.snapshot(), off.snapshot()
+    live.pop("overhead_s"), replay.pop("overhead_s")
+    assert live == replay
+
+
+def test_ring_drop_counts_accumulate():
+    eng = HopAnatomy(min_rounds=1)
+    eng.observe_round(leader=0, round=0, frames=1,
+                      stages=SERIAL_STAGES, round_s=0.1, drops=3)
+    eng.observe_round(leader=0, round=1, frames=1,
+                      stages=SERIAL_STAGES, round_s=0.1, drops=2)
+    assert eng.snapshot()["ring_drops"] == 5
+
+
+def test_trace_events_per_leader_tracks():
+    eng = HopAnatomy(min_rounds=1)
+    rows = [eng.observe_round(leader=g, round=i, frames=2,
+                              stages=SERIAL_STAGES, round_s=0.1,
+                              t=10.0 + i)
+            for g in (0, 1) for i in range(2)]
+    events = hop_trace_events(rows, t0_wall=10.0)
+    spans = [e for e in events if e.get("ph") == "X"]
+    # one span per non-idle stage per row, one track (pid) per leader
+    assert len(spans) == 4 * (len(HOP_STAGES) - 1)
+    assert len({e["pid"] for e in spans}) == 2
+
+
+# ---------------------------------------------------------------------------
+# unarmed surfaces stay neutral
+# ---------------------------------------------------------------------------
+
+
+def test_unarmed_engine_reads_neutral():
+    eng = HopAnatomy(min_rounds=2)
+    eng.observe_round(leader=0, round=0, frames=1,
+                      stages=SERIAL_STAGES, round_s=0.1)
+    assert eng.busy_frac() == 0.0
+    assert eng.headroom_ratio() == 1.0
+    assert eng.ingest_wait_ms() == 0.0
+    assert eng.serial_ms() == 0.0
+
+
+def test_unarmed_scrape_gauges_neutral():
+    from pytorch_ps_mpi_tpu.telemetry.registry import MetricsRegistry
+
+    eng = HopAnatomy(min_rounds=2)
+    reg = MetricsRegistry()
+    eng.register(reg)
+    text = reg.prometheus_text()
+    vals = {}
+    for line in text.splitlines():
+        if line.startswith("ps_hop_") and "{" not in line:
+            k, v = line.split()
+            vals[k] = float(v)
+    assert vals["ps_hop_rounds_total"] == 0.0
+    assert vals["ps_hop_busy_frac"] == 0.0
+    assert vals["ps_hop_stream_headroom_ratio"] == 1.0
+    assert vals["ps_hop_ring_drops_total"] == 0.0
+
+
+def test_ps_top_renders_hop_pane():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from tools.ps_top import render_hop
+
+    eng = HopAnatomy(min_rounds=1)
+    _feed(eng, 0, 3, LOPSIDED_STAGES, round_s=0.100)
+    _feed(eng, 1, 3, SERIAL_STAGES, round_s=0.500)
+    lines = render_hop(eng.snapshot())
+    assert lines[0].startswith("hop ")
+    assert any("leader 0" in ln and "[hot]" in ln for ln in lines)
+    assert sum("leader" in ln for ln in lines) == 2
+
+
+# ---------------------------------------------------------------------------
+# native interval rings
+# ---------------------------------------------------------------------------
+
+
+def test_fold_span_ring_bounds_and_overflow():
+    lib = native.fold_lib()
+    if lib is None:
+        pytest.skip("native fold kernels unavailable")
+    if not native.fold_spans_arm(4):
+        pytest.skip("fold-span ring unavailable in this build")
+    try:
+        acc = np.zeros(64, np.float32)
+        q = np.ones(64, np.int8)
+        for _ in range(6):
+            native.fold_scaled_i8(lib, acc, q, np.float32(0.5))
+        spans, dropped = native.fold_spans_drain()
+        # capacity 4 + 6 folds: 4 kept, 2 surrendered as counted drops
+        assert len(spans) == 4 and dropped == 2
+        for start_ns, end_ns, elems in spans:
+            assert end_ns >= start_ns > 0 and elems == 64
+        # drain resets: an empty ring drains clean
+        spans, dropped = native.fold_spans_drain()
+        assert spans == [] and dropped == 0
+    finally:
+        native.fold_spans_arm(0)
+
+
+def test_fold_span_ring_noop_under_ps_no_native(monkeypatch):
+    monkeypatch.setenv("PS_NO_NATIVE", "1")
+    assert native.fold_spans_arm(8) is False
+
+
+def test_hop_stamp_ring_arm_drain_cycle():
+    tcp = pytest.importorskip("pytorch_ps_mpi_tpu.parallel.tcp")
+    if native.fast_path_disabled() or tcp.get_lib() is None:
+        pytest.skip("native tcp transport unavailable")
+    template = {"w": np.zeros(4, np.float32)}
+    server = tcp.TcpPSServer(0, num_workers=1, template=template,
+                             max_staleness=10 ** 9)
+    try:
+        if not server.hop_stamps_arm(8):
+            pytest.skip("hop-stamp ring unavailable in this build")
+        got = server.drain_hop_stamps()
+        assert got == ([], 0)  # armed, nothing ingested yet
+        server.hop_stamps_arm(0)
+        assert server.drain_hop_stamps() is None  # disarmed => None
+    finally:
+        server.close()
+
+
+def test_native_flag_does_not_change_the_math():
+    """PS_NO_NATIVE parity: the fallback times the same windows in
+    Python, so rows differing only in ``native`` replay identically."""
+    a = HopAnatomy(min_rounds=1)
+    b = HopAnatomy(min_rounds=1)
+    ra = a.observe_round(leader=0, round=0, frames=3,
+                         stages=SERIAL_STAGES, round_s=0.1, t=1.0,
+                         native=True)
+    rb = b.observe_round(leader=0, round=0, frames=3,
+                         stages=SERIAL_STAGES, round_s=0.1, t=1.0,
+                         native=False)
+    for k in ("serial_s", "overlap_s", "headroom_ratio", "busy_frac",
+              "stages"):
+        assert ra[k] == rb[k]
+    sa, sb = a.snapshot(), b.snapshot()
+    sa.pop("overhead_s"), sb.pop("overhead_s")
+    assert sa == sb

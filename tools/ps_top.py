@@ -159,6 +159,15 @@ def render_fleet(snap: Dict[str, Any],
             f"  skew[{key}]: min={s.get('min', 0):g} "
             f"max={s.get('max', 0):g} "
             f"spread={s.get('spread_frac', 0) * 100:.0f}% [{flag}]")
+    if fleet.get("hop_rounds"):
+        # hop-anatomy rollup: the max across members is the hottest
+        # leader's occupancy and the biggest streaming-headroom win —
+        # the two numbers the split-vs-streaming call needs
+        lines.append(
+            f"  hop: rounds={int(fleet.get('hop_rounds', 0))}  "
+            f"busy_max={fleet.get('hop_busy_frac_max', 0) * 100:.0f}%  "
+            f"headroom_max="
+            f"{fleet.get('hop_stream_headroom_ratio_max', 1.0):.2f}x")
     for g, row in sorted((snap.get("groups") or {}).items()):
         # aggregation-tree per-group rollup: which pod is behind, which
         # leader is down, how many worker pushes its hop composed
@@ -342,6 +351,31 @@ def render_anatomy(anatomy: Dict[str, Any]) -> List[str]:
     return lines
 
 
+def render_hop(hop: Dict[str, Any]) -> List[str]:
+    """The hop-anatomy pane lines from a ``/health`` ``hop`` section
+    (pure — the testable core): fleet-of-leaders occupancy header plus
+    one column row per leader — who is busy, who would a streaming hop
+    actually help (headroom), who is the hot leader."""
+    rounds = int(hop.get("rounds", 0))
+    lines = [
+        f"hop      rounds={rounds}  "
+        f"busy={hop.get('busy_frac', 0) * 100:.0f}%  "
+        f"headroom={hop.get('headroom_ratio', 1.0):.2f}x  "
+        f"serial p50={hop.get('serial_ms', 0):.1f}ms  "
+        f"ingest-wait p50={hop.get('ingest_wait_ms', 0):.1f}ms  "
+        f"drops={int(hop.get('ring_drops', 0))}"]
+    hot = hop.get("hot_leader")
+    for g, row in sorted((hop.get("leaders") or {}).items(),
+                         key=lambda kv: str(kv[0])):
+        lines.append(
+            f"  leader {g}: rounds={int(row.get('rounds', 0))}  "
+            f"busy={row.get('busy_frac', 0) * 100:.0f}%  "
+            f"headroom={row.get('headroom_ratio', 1.0):.2f}x  "
+            f"round p50={row.get('round_ms', 0):.1f}ms"
+            + ("  [hot]" if str(g) == str(hot) else ""))
+    return lines
+
+
 def render_table(health: Dict[str, Any], sort: str = "worker",
                  color: bool = False) -> str:
     """One dashboard frame from a ``/health`` document (pure — the
@@ -416,6 +450,9 @@ def render_table(health: Dict[str, Any], sort: str = "worker",
     anatomy = health.get("anatomy")
     if anatomy:
         lines.extend(render_anatomy(anatomy))
+    hop = health.get("hop")
+    if hop and hop.get("rounds"):
+        lines.extend(render_hop(hop))
     cols = ["wk", "verdict", "cause", "grads", "inter-ewma", "inter-p95",
             "stale-ewma", "stale-x", "e2e-ms", "gnorm", "nan", "relerr",
             "anom", "gate-rounds", "gate-s", "retry", "reconn", "rej",

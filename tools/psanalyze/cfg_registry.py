@@ -153,6 +153,12 @@ CFG_KEYS = {
     "anatomy_kw": CfgKey("dict", "caller",
                          "RoundAnatomy knobs (window, stage_window, "
                          "min_rounds, ...)"),
+    "hop_anatomy": CfgKey("bool", "cli",
+                          "arm leader-hop occupancy tracing: sub-stage "
+                          "timelines + the streaming-headroom board"),
+    "hop_anatomy_kw": CfgKey("dict", "caller",
+                             "HopAnatomy knobs (window, flush_every, "
+                             "ring_capacity, min_rounds, ...)"),
     "timeseries": CfgKey("bool", "cli",
                          "arm the in-process metrics TSDB (/history)"),
     "timeseries_dir": CfgKey("str", "caller",

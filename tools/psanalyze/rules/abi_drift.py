@@ -45,6 +45,8 @@ TCPPS_CPP = "native/tcpps.cpp"
 TCP_PY = "pytorch_ps_mpi_tpu/parallel/tcp.py"
 NET_PY = "pytorch_ps_mpi_tpu/serving/net.py"
 NATIVE_READ_PY = "pytorch_ps_mpi_tpu/serving/native_read.py"
+WIRECODEC_CPP = "native/wirecodec.cpp"
+NATIVE_PY = "pytorch_ps_mpi_tpu/utils/native.py"
 
 _NATIVE_RE = re.compile(r"\b(?:wc|tps|psq)_[A-Za-z0-9_]+")
 
@@ -293,6 +295,7 @@ class AbiDriftRule(Rule):
         findings.extend(self._check_frame_constants(ctx))
         findings.extend(self._check_batch_meta(ctx))
         findings.extend(self._check_read_stats(ctx))
+        findings.extend(self._check_hop_rings(ctx))
         findings.extend(self._check_reason_enum(ctx))
         return findings
 
@@ -473,6 +476,46 @@ class AbiDriftRule(Rule):
                     self.name, NET_PY, 1,
                     f"PSR1 magic is 0x{py_magic:08x} in net.py but "
                     f"kPsrMagic is 0x{c_magic:08x} in {TCPPS_CPP}"))
+        return findings
+
+    # -- hop-anatomy interval rings ----------------------------------------
+    def _check_hop_rings(self, ctx: AnalysisContext) -> List[Finding]:
+        """The occupancy plane's twin pair: the per-frame validate
+        stamp (``HopStamp``, tcpps) and the per-fold-call span
+        (``FoldSpan``, wirecodec) ride bounded native rings drained
+        into ctypes mirrors — same static_assert/ctypes discipline as
+        ``BatchMeta``/``ReadStats``, plus the runtime ``*_abi_*_bytes``
+        size re-check at library load."""
+        findings: List[Finding] = []
+        for c_name, py_name, py_path, cpp_path in (
+                ("HopStamp", "_HopStamp", TCP_PY, TCPPS_CPP),
+                ("FoldSpan", "_FoldSpan", NATIVE_PY, WIRECODEC_CPP)):
+            tree = ctx.tree(py_path)
+            cpp = ctx.source(cpp_path)
+            if tree is None or cpp is None:
+                continue
+            c_fields = parse_c_struct(cpp, c_name)
+            py_fields = _ctypes_fields(tree, py_name)
+            if c_fields is None or py_fields is None:
+                findings.append(Finding(
+                    self.name, py_path, 1,
+                    f"{c_name} (C) or {py_name} (ctypes) struct not "
+                    "found — the hop-anatomy ring mirror is gone"))
+                continue
+            if [(n, t) for n, t in c_fields] != \
+                    [(n, t) for n, t in py_fields]:
+                findings.append(Finding(
+                    self.name, py_path, 1,
+                    f"{c_name} layout drifted: C has {c_fields}, "
+                    f"ctypes mirror has {py_fields}"))
+            size = sum(_SIZES.get(t, 0) for _n, t in c_fields)
+            m = re.search(r"sizeof\(%s\)\s*==\s*(\d+)" % c_name, cpp)
+            asserted = int(m.group(1)) if m else None
+            if asserted is not None and size != asserted:
+                findings.append(Finding(
+                    self.name, py_path, 1,
+                    f"{c_name} packs to {size} bytes but {cpp_path} "
+                    f"asserts {asserted}"))
         return findings
 
     # -- FrameStatus reason enum ------------------------------------------

@@ -91,6 +91,12 @@ INSTRUMENT_MAP: Dict[str, Optional[str]] = {
     "read_fresh_p95_ms": "ps_read_fresh_p95_ms",
     "serving_age_ms": "ps_serving_age_ms",
     "fresh_hop_count": "ps_fresh_hop_count",
+    "hop_rounds": "ps_hop_rounds_total",
+    "hop_busy_frac": "ps_hop_busy_frac",
+    "hop_ingest_wait_ms": "ps_hop_ingest_wait_ms",
+    "hop_stream_headroom_ratio": "ps_hop_stream_headroom_ratio",
+    "hop_serial_ms": "ps_hop_serial_ms",
+    "hop_ring_drops": "ps_hop_ring_drops_total",
 }
 
 

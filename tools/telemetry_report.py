@@ -420,6 +420,27 @@ def _summarize_freshness(rows: List[Dict[str, Any]]
     }
 
 
+def _summarize_hop(rows: List[Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    """The hop-anatomy section: leader-pipeline occupancy rebuilt
+    offline from ``hop-*.jsonl`` rows by replaying them through the
+    SAME engine the leaders ran live
+    (:func:`~pytorch_ps_mpi_tpu.telemetry.hop_anatomy.
+    hop_anatomy_from_rows`) — per-leader busy fractions, sub-stage
+    medians, and the streaming-headroom projection, byte-identical to
+    the live scoreboard."""
+    if not rows:
+        return None
+    from pytorch_ps_mpi_tpu.telemetry.hop_anatomy import (
+        hop_anatomy_from_rows,
+    )
+
+    eng = hop_anatomy_from_rows(rows)
+    if not eng.rounds:
+        return None
+    return eng.snapshot()
+
+
 def _summarize_actions(rows: List[Dict[str, Any]],
                        flap_window_s: float = 10.0
                        ) -> Optional[Dict[str, Any]]:
@@ -488,6 +509,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
     slo_rows: List[Dict[str, Any]] = []
     action_rows: List[Dict[str, Any]] = []
     fresh_rows: List[Dict[str, Any]] = []
+    hop_rows: List[Dict[str, Any]] = []
     profile_paths: List[str] = []
     for path in files:
         base = os.path.basename(path)
@@ -540,6 +562,16 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
             )
 
             fresh_rows.extend(load_fresh_rows(path))
+            continue
+        if base.startswith("hop-") and path.endswith(".jsonl"):
+            # leader hop sub-stage occupancy rows
+            # (telemetry.hop_anatomy) — routed to the hop-anatomy
+            # section, never the recorder-span merge
+            from pytorch_ps_mpi_tpu.telemetry.hop_anatomy import (
+                load_hop_rows,
+            )
+
+            hop_rows.extend(load_hop_rows(path))
             continue
         if base.startswith("postmortem-") and path.endswith(".json"):
             # a divergence postmortem dump (telemetry.numerics) — one
@@ -652,6 +684,7 @@ def summarize(files: List[str], by_worker: bool = False) -> Dict[str, Any]:
         "slo": _summarize_slo(slo_rows),
         "actions": _summarize_actions(action_rows),
         "freshness": _summarize_freshness(fresh_rows),
+        "hop": _summarize_hop(hop_rows),
         "dropped_total": sum(m.get("dropped") or 0 for m in meta),
     }
 
@@ -808,6 +841,37 @@ def format_table(summary: Dict[str, Any]) -> str:
             lines.append("    " + afmt.format(*acols))
             for r in arows:
                 lines.append("    " + afmt.format(*r))
+    hop = summary.get("hop")
+    if hop:
+        lines.append("")
+        lines.append(
+            f"hop anatomy ({hop['rounds']} leader rounds, "
+            f"{hop['frames']} frames folded, "
+            f"{hop['ring_drops']} ring drops):")
+        lines.append(
+            f"  occupancy: busy={hop['busy_frac'] * 100:.0f}%  "
+            f"ingest-wait p50={hop['ingest_wait_ms']:.1f}ms  "
+            f"serial p50={hop['serial_ms']:.1f}ms  "
+            f"streaming headroom={hop['headroom_ratio']:.2f}x")
+        st = hop.get("stages") or {}
+        if st:
+            scols = ["stage", "p50 ms", "p95 ms"]
+            srows = [[name, f"{d['p50_ms']:.2f}", f"{d['p95_ms']:.2f}"]
+                     for name, d in st.items()]
+            sw = [max(len(c), *(len(r[i]) for r in srows)) if srows
+                  else len(c) for i, c in enumerate(scols)]
+            sfmt = "  ".join(f"{{:<{w}}}" if i == 0 else f"{{:>{w}}}"
+                             for i, w in enumerate(sw))
+            lines.append("    " + sfmt.format(*scols))
+            for r in srows:
+                lines.append("    " + sfmt.format(*r))
+        for g, lw in (hop.get("leaders") or {}).items():
+            hot = " [hot]" if g == hop.get("hot_leader") else ""
+            lines.append(
+                f"  leader {g}: {lw['rounds']} rounds  "
+                f"busy={lw['busy_frac'] * 100:.0f}%  "
+                f"headroom={lw['headroom_ratio']:.2f}x  "
+                f"round p50={lw['round_ms']:.1f}ms{hot}")
     hist = summary.get("history")
     if hist:
         lines.append("")
